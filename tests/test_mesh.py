@@ -1,0 +1,53 @@
+import jax
+import numpy as np
+import pytest
+
+from tpucfn.mesh import ALL_AXES, MeshSpec, build_mesh
+
+
+def test_axis_order_ici_innermost():
+    # tensor must be innermost so TP collectives ride adjacent-device ICI.
+    assert ALL_AXES[-1] == "tensor"
+    assert ALL_AXES[0] == "pipeline"
+
+
+def test_for_devices_fills_data_axis():
+    spec = MeshSpec.for_devices(8, tensor=2)
+    assert spec.data == 4 and spec.tensor == 2
+    assert spec.num_devices == 8
+    assert spec.dp_size == 4
+
+
+def test_for_devices_rejects_indivisible():
+    with pytest.raises(ValueError):
+        MeshSpec.for_devices(8, tensor=3)
+
+
+def test_spec_rejects_bad_axis():
+    with pytest.raises(ValueError):
+        MeshSpec(data=0)
+
+
+def test_build_mesh_shape_and_names():
+    mesh = build_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
+    assert mesh.axis_names == ALL_AXES
+    assert mesh.devices.shape == (1, 2, 2, 1, 1, 2)
+    assert mesh.devices.size == 8
+
+
+def test_build_mesh_validates_device_count():
+    with pytest.raises(ValueError):
+        build_mesh(MeshSpec(data=4))  # 4 != 8 available
+
+
+def test_tensor_axis_gets_adjacent_device_ids():
+    mesh = build_mesh(MeshSpec(data=4, tensor=2))
+    dev = mesh.devices.reshape(4, 2)
+    ids = np.vectorize(lambda d: d.id)(dev)
+    # innermost (tensor) axis strides over adjacent ids
+    assert (ids[:, 1] - ids[:, 0] == 1).all()
+
+
+def test_default_mesh_is_pure_dp():
+    mesh = build_mesh()
+    assert mesh.shape["data"] == len(jax.devices())

@@ -1,0 +1,103 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpucfn.parallel import (
+    ShardingRules,
+    dense_rules,
+    make_partition_spec,
+    named_sharding_tree,
+    shard_batch,
+    transformer_rules,
+)
+
+
+def _specs(rules, tree):
+    return make_partition_spec(rules, tree)
+
+
+def test_first_match_wins():
+    rules = ShardingRules(
+        (
+            (r"a/kernel$", P("tensor")),
+            (r"kernel$", P("fsdp")),
+            (r".*", P()),
+        )
+    )
+    tree = {"a": {"kernel": jnp.zeros((4,))}, "b": {"kernel": jnp.zeros((4,))}}
+    specs = _specs(rules, tree)
+    assert specs["a"]["kernel"] == P("tensor")
+    assert specs["b"]["kernel"] == P("fsdp")
+
+
+def test_short_spec_accepted_for_higher_rank():
+    rules = ShardingRules(((r"kernel$", P("fsdp", "tensor")), (r".*", P())))
+    tree = {"kernel": jnp.zeros((2, 2, 2, 2))}
+    assert _specs(rules, tree)["kernel"] == P("fsdp", "tensor")
+
+
+def test_overlong_spec_raises():
+    rules = ShardingRules(((r"kernel$", P("fsdp", "tensor")), (r".*", P())))
+    with pytest.raises(ValueError, match="rank"):
+        _specs(rules, {"kernel": jnp.zeros((4,))})
+
+
+def test_unmatched_defaults_replicated():
+    rules = ShardingRules(((r"kernel$", P("fsdp")),))
+    assert _specs(rules, {"odd": jnp.zeros((4,))})["odd"] == P()
+
+
+def test_transformer_preset_tp_fsdp_composition():
+    rules = transformer_rules()
+    tree = {
+        "layers_0": {
+            "attn": {
+                "qkv": {"kernel": jnp.zeros((64, 192)), "bias": jnp.zeros((192,))},
+                "o_proj": {"kernel": jnp.zeros((64, 64))},
+            },
+            "mlp": {
+                "up_proj": {"kernel": jnp.zeros((64, 256))},
+                "gate_proj": {"kernel": jnp.zeros((64, 256))},
+                "down_proj": {"kernel": jnp.zeros((256, 64))},
+            },
+            "norm": {"scale": jnp.zeros((64,))},
+        },
+        "embed_tokens": {"embedding": jnp.zeros((1000, 64))},
+    }
+    specs = _specs(rules, tree)
+    l0 = specs["layers_0"]
+    assert l0["attn"]["qkv"]["kernel"] == P("fsdp", "tensor")
+    assert l0["attn"]["qkv"]["bias"] == P("tensor")
+    assert l0["attn"]["o_proj"]["kernel"] == P("tensor", "fsdp")
+    assert l0["mlp"]["up_proj"]["kernel"] == P("fsdp", "tensor")
+    assert l0["mlp"]["down_proj"]["kernel"] == P("tensor", "fsdp")
+    assert l0["norm"]["scale"] == P()
+    assert specs["embed_tokens"]["embedding"] == P("tensor", "fsdp")
+
+
+def test_dense_rules_dp_replicates_all():
+    specs = _specs(dense_rules(fsdp=False), {"conv1": {"kernel": jnp.zeros((3, 3, 4, 8))}})
+    assert specs["conv1"]["kernel"] == P()
+
+
+def test_dense_rules_fsdp_shards_cout():
+    specs = _specs(dense_rules(fsdp=True), {"conv1": {"kernel": jnp.zeros((3, 3, 4, 8))}})
+    assert specs["conv1"]["kernel"] == P(None, None, None, "fsdp")
+
+
+def test_named_sharding_tree_binds_mesh(mesh8):
+    tree = {"w": {"kernel": jnp.zeros((8, 8))}}
+    sh = named_sharding_tree(mesh8, transformer_rules(), tree)
+    assert isinstance(sh["w"]["kernel"], NamedSharding)
+    assert sh["w"]["kernel"].mesh.axis_names == mesh8.axis_names
+
+
+def test_shard_batch_places_on_batch_axes(mesh8):
+    batch = {"x": np.ones((16, 4), np.float32), "y": np.ones((16,), np.int32)}
+    out = shard_batch(mesh8, batch)
+    assert out["x"].sharding.spec == P(("data", "fsdp"))
+    # 4-way batch split (data=2 * fsdp=2): each device holds 4 rows.
+    assert out["x"].addressable_shards[0].data.shape == (4, 4)
+    assert isinstance(out["y"], jax.Array)

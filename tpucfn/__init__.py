@@ -1,0 +1,26 @@
+"""tpucfn — TPU-native distributed training harness.
+
+A from-scratch, TPU-first framework with the capability surface of
+``awslabs/deeplearning-cfn`` (a CloudFormation cluster-provisioning +
+distributed-launch harness; see SURVEY.md for the full behavioral contract —
+the reference mount was empty at survey time, so parity citations are to the
+contract in SURVEY.md §1-§5 rather than file:line).
+
+Layer map (reference → tpucfn; modules marked * are in progress and land
+in later milestones of this build):
+
+* CloudFormation template / ASGs   → ``tpucfn.spec`` + ``tpucfn.provision`` *
+* cfn-init bootstrap scripts       → ``tpucfn.bootstrap`` (env contract) *
+* ``launch.py`` / ``mpirun``       → ``tpucfn.launch`` (SPMD fan-out +
+  ``jax.distributed`` rendezvous) *
+* ps-lite / NCCL / Horovod         → XLA collectives over ICI, wrapped in
+  :mod:`tpucfn.collectives`, driven by :mod:`tpucfn.mesh` +
+  :mod:`tpucfn.parallel`
+* AMI-shipped MXNet/TF examples    → :mod:`tpucfn.models` + ``examples/``
+* S3 data staging                  → ``tpucfn.data`` *
+* EFS checkpoints                  → ``tpucfn.ckpt`` (Orbax, sharding-aware) *
+"""
+
+__version__ = "0.1.0"
+
+from tpucfn.mesh import MeshSpec, build_mesh  # noqa: F401

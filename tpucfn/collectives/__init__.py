@@ -1,0 +1,11 @@
+from tpucfn.collectives.ops import (  # noqa: F401
+    psum,
+    pmean,
+    pmax,
+    all_gather,
+    reduce_scatter,
+    ring_permute,
+    all_to_all,
+    axis_index,
+    axis_size,
+)

@@ -1,0 +1,78 @@
+"""Typed, named-axis collective wrappers.
+
+One comm stack instead of the reference's three (ps-lite ZMQ for PS traffic,
+OpenMPI for rendezvous, NCCL for the collective data path — SURVEY.md §2.4):
+everything here lowers to XLA collectives that ride ICI inside a slice and
+DCN across slices. These wrappers only run inside ``shard_map``/``pmap``
+axis contexts; under plain ``jit`` + ``NamedSharding``, XLA inserts the
+equivalent collectives automatically and user code never calls these.
+
+They exist because raw ``lax`` collectives have sharp edges we want checked
+once (tuple axes, tiled vs stacked all_gather, ppermute's pair format), and
+so the parallelism layers (ring attention, pipeline, MoE dispatch) read as
+intent rather than lax incantations.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+from jax import lax
+
+AxisName = str | Sequence[str]
+
+
+def axis_index(axis: str) -> jax.Array:
+    """This shard's coordinate along ``axis`` (0-based)."""
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str) -> int:
+    """Static size of a mesh axis from inside a mapped computation."""
+    return lax.axis_size(axis)
+
+
+def psum(x, axis: AxisName):
+    """Sum across ``axis``. The gradient all-reduce that replaces both of
+    the reference's DP flavors: ps-lite push/pull and NCCL ring all-reduce
+    (SURVEY.md §3.2/§3.3 hot loops)."""
+    return lax.psum(x, axis)
+
+
+def pmean(x, axis: AxisName):
+    """Mean across ``axis`` — gradient averaging, metric reduction."""
+    return lax.pmean(x, axis)
+
+
+def pmax(x, axis: AxisName):
+    return lax.pmax(x, axis)
+
+
+def all_gather(x, axis: str, *, tiled: bool = True, gather_axis: int = 0):
+    """Gather shards along ``axis``; ``tiled=True`` concatenates on
+    ``gather_axis`` (FSDP param gather), ``tiled=False`` stacks a new
+    leading axis."""
+    return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: str, *, scatter_axis: int = 0):
+    """Sum across ``axis`` then keep this shard's slice of ``scatter_axis``
+    — FSDP gradient reduction at 1/N the bytes of a full psum."""
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
+
+
+def ring_permute(x, axis: str, *, shift: int = 1):
+    """Rotate shards around ``axis`` as a ring: shard i's value goes to
+    shard (i + shift) % N. The building block of ring attention's KV
+    rotation and pipeline stage hand-off; maps to neighbor ICI hops on the
+    torus."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def all_to_all(x, axis: str, *, split_axis: int, concat_axis: int):
+    """Scatter ``split_axis`` across ``axis`` while gathering the axis into
+    ``concat_axis`` — Ulysses head-scatter and MoE expert dispatch."""
+    return lax.all_to_all(x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True)

@@ -1,0 +1,13 @@
+from tpucfn.mesh.mesh import (  # noqa: F401
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_TENSOR,
+    AXIS_CONTEXT,
+    AXIS_PIPELINE,
+    AXIS_EXPERT,
+    ALL_AXES,
+    BATCH_AXES,
+    MeshSpec,
+    build_mesh,
+    local_mesh_devices,
+)

@@ -1,0 +1,133 @@
+"""Device mesh construction with named parallelism axes.
+
+The reference's only "mesh" was a flat hostfile consumed by ``mpirun`` /
+``launch.py`` (SURVEY.md §1 L3/L4: ``$DEEPLEARNING_WORKERS_PATH`` +
+``$DEEPLEARNING_WORKERS_COUNT``); all parallelism was 1-D data parallelism
+over that list. On TPU the mesh is the first-class object: every parallelism
+strategy is an axis of one ``jax.sharding.Mesh``, and XLA emits the
+collectives (SURVEY.md §2.3, §2.4).
+
+Axis order encodes the fabric hierarchy: axes that move the most bytes per
+step sit innermost so they map to ICI neighbors; axes that communicate
+rarely (pipeline bubbles, DP gradient reduction once per step) sit outermost
+and may ride DCN in multi-slice deployments.
+
+    (pipeline, data, fsdp, expert, context, tensor)
+     outermost / DCN-tolerant  ......  innermost / ICI-hungry
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_PIPELINE = "pipeline"
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_EXPERT = "expert"
+AXIS_CONTEXT = "context"
+AXIS_TENSOR = "tensor"
+
+# Outermost→innermost. Tensor parallelism is the most latency/bandwidth
+# sensitive (collectives inside every layer), so it gets the innermost —
+# physically closest — ICI neighbors. Pipeline only ppermutes activations at
+# stage boundaries, so it tolerates the outermost placement (DCN between
+# slices in a multislice job).
+ALL_AXES = (
+    AXIS_PIPELINE,
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_EXPERT,
+    AXIS_CONTEXT,
+    AXIS_TENSOR,
+)
+
+# Axes over which the global batch is split. FSDP is "data parallelism with
+# sharded state", so the batch dimension shards over both.
+BATCH_AXES = (AXIS_DATA, AXIS_FSDP)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape — the analogue of the reference's
+    ``WorkerCount`` CFN parameter, generalized to six named axes.
+
+    Any axis left at 1 is still present in the mesh so sharding rules can
+    mention it unconditionally; XLA elides collectives over size-1 axes.
+    """
+
+    pipeline: int = 1
+    data: int = 1
+    fsdp: int = 1
+    expert: int = 1
+    context: int = 1
+    tensor: int = 1
+
+    def __post_init__(self):
+        for name in ALL_AXES:
+            v = getattr(self, name)
+            if not isinstance(v, int) or v < 1:
+                raise ValueError(f"mesh axis {name!r} must be a positive int, got {v!r}")
+
+    @property
+    def axis_sizes(self) -> tuple[int, ...]:
+        return tuple(getattr(self, name) for name in ALL_AXES)
+
+    @property
+    def num_devices(self) -> int:
+        return math.prod(self.axis_sizes)
+
+    @property
+    def dp_size(self) -> int:
+        """Total data-parallel degree (batch shards)."""
+        return self.data * self.fsdp
+
+    @classmethod
+    def for_devices(cls, n: int, **overrides: int) -> "MeshSpec":
+        """Fill the ``data`` axis with whatever devices the explicit axes
+        leave over — the common "just do DP over everything" default that
+        matches the reference's behavior of using every GPU in the fleet.
+        """
+        if "data" in overrides:
+            raise ValueError("pass data= via the constructor, not for_devices")
+        fixed = math.prod(overrides.values()) if overrides else 1
+        if n % fixed != 0:
+            raise ValueError(f"{n} devices not divisible by explicit axes product {fixed}")
+        return cls(data=n // fixed, **overrides)
+
+    def validate(self, n_devices: int) -> None:
+        if self.num_devices != n_devices:
+            raise ValueError(
+                f"MeshSpec wants {self.num_devices} devices "
+                f"({dict(zip(ALL_AXES, self.axis_sizes))}) but {n_devices} are available"
+            )
+
+
+def build_mesh(
+    spec: MeshSpec | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build the 6-axis :class:`jax.sharding.Mesh` for ``spec``.
+
+    Devices are laid out so that the innermost spec axes stride over
+    adjacent device ids — on a real slice, adjacent ids are ICI neighbors,
+    which is exactly where the tensor/context axes belong.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if spec is None:
+        spec = MeshSpec.for_devices(len(devices))
+    spec.validate(len(devices))
+    dev_array = np.asarray(devices).reshape(spec.axis_sizes)
+    return Mesh(dev_array, ALL_AXES)
+
+
+def local_mesh_devices(mesh: Mesh) -> list[jax.Device]:
+    """Devices of ``mesh`` attached to this process (host-local shard of the
+    fleet — the analogue of one row of the reference's hostfile)."""
+    return [d for d in mesh.devices.flat if d.process_index == jax.process_index()]

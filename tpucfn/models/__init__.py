@@ -1,0 +1,1 @@
+from tpucfn.models.resnet import ResNet, ResNetConfig  # noqa: F401

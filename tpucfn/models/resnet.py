@@ -1,0 +1,134 @@
+"""ResNet family — CIFAR (ResNet-20/32/56) and ImageNet (ResNet-50) variants.
+
+Capability parity: the reference's two bundled examples were MXNet
+``train_cifar10.py --network resnet`` and ImageNet ResNet-50 (SURVEY.md
+§2.1 "Example" rows; BASELINE.md configs 1-2). Those scripts lived on the
+AMI and ran on cuDNN; this is a from-scratch flax implementation designed
+for the MXU instead:
+
+* NHWC layout (TPU-native; cuDNN preferred NCHW) so XLA lowers convs to
+  MXU matmuls without transposes.
+* bf16 activations / fp32 params + fp32 batch-norm statistics: the MXU's
+  native mixed precision.
+* Static shapes everywhere; stride-2 projection shortcuts (post-activation
+  "v1.5" ResNet, the variant the 76%-top-1 target assumes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Sequence[int]
+    num_classes: int
+    bottleneck: bool = True
+    width: int = 64
+    cifar_stem: bool = False  # 3x3 stem, no maxpool (CIFAR) vs 7x7/s2 + pool
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @classmethod
+    def resnet20_cifar(cls, num_classes: int = 10) -> "ResNetConfig":
+        # The reference CIFAR example's default network (SURVEY.md §3.2).
+        return cls(stage_sizes=(3, 3, 3), num_classes=num_classes,
+                   bottleneck=False, width=16, cifar_stem=True)
+
+    @classmethod
+    def resnet32_cifar(cls, num_classes: int = 10) -> "ResNetConfig":
+        return cls(stage_sizes=(5, 5, 5), num_classes=num_classes,
+                   bottleneck=False, width=16, cifar_stem=True)
+
+    @classmethod
+    def resnet50(cls, num_classes: int = 1000) -> "ResNetConfig":
+        # The north-star model: 76% top-1 target (BASELINE.md).
+        return cls(stage_sizes=(3, 4, 6, 3), num_classes=num_classes,
+                   bottleneck=True, width=64)
+
+    @classmethod
+    def resnet18(cls, num_classes: int = 1000) -> "ResNetConfig":
+        return cls(stage_sizes=(2, 2, 2, 2), num_classes=num_classes,
+                   bottleneck=False, width=64)
+
+
+class ResNetBlock(nn.Module):
+    filters: int
+    strides: int
+    bottleneck: bool
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = functools.partial(
+            nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype
+        )
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype, param_dtype=self.param_dtype,
+        )
+        residual = x
+        if self.bottleneck:
+            y = conv(self.filters, (1, 1), name="conv1")(x)
+            y = nn.relu(norm(name="bn1")(y))
+            y = conv(self.filters, (3, 3), strides=(self.strides,) * 2, name="conv2")(y)
+            y = nn.relu(norm(name="bn2")(y))
+            y = conv(self.filters * 4, (1, 1), name="conv3")(y)
+            # Zero-init the last BN scale so each block starts as identity —
+            # standard for the 76%-top-1 recipe.
+            y = norm(name="bn3", scale_init=nn.initializers.zeros)(y)
+            out_filters = self.filters * 4
+        else:
+            y = conv(self.filters, (3, 3), strides=(self.strides,) * 2, name="conv1")(x)
+            y = nn.relu(norm(name="bn1")(y))
+            y = conv(self.filters, (3, 3), name="conv2")(y)
+            y = norm(name="bn2", scale_init=nn.initializers.zeros)(y)
+            out_filters = self.filters
+        if residual.shape[-1] != out_filters or self.strides != 1:
+            residual = conv(out_filters, (1, 1), strides=(self.strides,) * 2,
+                            name="conv_proj")(residual)
+            residual = norm(name="bn_proj")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    config: ResNetConfig
+
+    @nn.compact
+    def __call__(self, images, train: bool = True):
+        cfg = self.config
+        x = images.astype(cfg.dtype)
+        conv = functools.partial(
+            nn.Conv, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype
+        )
+        if cfg.cifar_stem:
+            x = conv(cfg.width, (3, 3), name="conv_stem")(x)
+        else:
+            x = conv(cfg.width, (7, 7), strides=(2, 2), name="conv_stem")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9, epsilon=1e-5,
+                         dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="bn_stem")(x)
+        x = nn.relu(x)
+        if not cfg.cifar_stem:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for stage, num_blocks in enumerate(cfg.stage_sizes):
+            for block in range(num_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = ResNetBlock(
+                    filters=cfg.width * (2 ** stage),
+                    strides=strides,
+                    bottleneck=cfg.bottleneck,
+                    dtype=cfg.dtype,
+                    param_dtype=cfg.param_dtype,
+                    name=f"stage{stage}_block{block}",
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        # Classifier head in fp32 for a stable softmax.
+        x = nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                     param_dtype=cfg.param_dtype, name="head")(x)
+        return x

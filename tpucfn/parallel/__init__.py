@@ -1,0 +1,14 @@
+from tpucfn.parallel.sharding import (  # noqa: F401
+    Rule,
+    ShardingRules,
+    batch_spec,
+    make_partition_spec,
+    named_sharding_tree,
+    partition_spec_tree,
+    shard_batch,
+)
+from tpucfn.parallel.presets import (  # noqa: F401
+    PRESETS,
+    dense_rules,
+    transformer_rules,
+)

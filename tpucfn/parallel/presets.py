@@ -1,0 +1,99 @@
+"""Sharding-rule presets for the supported parallelism strategies.
+
+SURVEY.md §2.3 is the contract: the reference shipped synchronous DP in two
+flavors (parameter-server ``dist_sync`` and Horovod ring all-reduce); the
+rebuild must additionally provide FSDP, TP, PP, SP and EP as first-class
+axes. DP/FSDP/TP/EP are pure sharding-rule presets (this module); PP and SP
+need program structure too and live in :mod:`tpucfn.parallel.pipeline` /
+:mod:`tpucfn.kernels.ring_attention`.
+
+Conventions the rules match against (models in :mod:`tpucfn.models` follow
+them):
+
+* dense / conv kernels: ``.../kernel`` with shape ``(..., in, out)``
+* attention projections: ``qkv`` or ``q_proj|k_proj|v_proj`` (out = heads),
+  ``o_proj`` (in = heads)
+* MLP: ``up_proj|gate_proj`` (out = ffn), ``down_proj`` (in = ffn)
+* embeddings: ``embedding`` with shape ``(vocab, model)``
+* MoE experts: ``experts/...`` with a leading expert dim
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from tpucfn.mesh import AXIS_EXPERT, AXIS_FSDP, AXIS_TENSOR
+from tpucfn.parallel.sharding import ShardingRules
+
+_REPLICATED_TAIL = ((r".*", P()),)
+
+
+def dense_rules(fsdp: bool = False) -> ShardingRules:
+    """Rules for conv/dense vision models (ResNet family).
+
+    Pure DP replicates everything — the TPU equivalent of the reference's
+    ``dist_sync``/Horovod placement (SURVEY.md §2.3 rows 1-2). With
+    ``fsdp=True``, the largest dim of each kernel shards over the fsdp axis
+    (ZeRO-3 style); XLA all-gathers per layer and reduce-scatters grads.
+    """
+    if not fsdp:
+        return ShardingRules(_REPLICATED_TAIL)
+    return ShardingRules(
+        (
+            # conv kernels (H, W, Cin, Cout): shard Cout.
+            (r"conv.*/kernel$", P(None, None, None, AXIS_FSDP)),
+            (r"(dense|head|fc).*/kernel$", P(None, AXIS_FSDP)),
+        )
+        + _REPLICATED_TAIL
+    )
+
+
+def transformer_rules(
+    fsdp: bool = True,
+    tensor: bool = True,
+    expert: bool = True,
+) -> ShardingRules:
+    """Megatron-style TP composed with FSDP for transformer families
+    (BERT, Llama, and the UNet's attention blocks).
+
+    TP: column-parallel qkv/up projections (shard out-features over
+    ``tensor``), row-parallel o/down projections (shard in-features) so the
+    only TP collective per block is the psum XLA inserts after the
+    row-parallel matmul. FSDP shards the *other* kernel dim, composing
+    orthogonally. Embedding shards vocab over tensor (XLA handles the
+    masked gather + psum that Megatron hand-codes).
+    """
+    t = AXIS_TENSOR if tensor else None
+    f = AXIS_FSDP if fsdp else None
+    e = AXIS_EXPERT if expert else None
+    return ShardingRules(
+        (
+            # MoE experts: leading expert dim over the expert axis, then the
+            # usual TP split on the trailing matmul dims.
+            (r"experts/.*(up|gate)_proj/kernel$", P(e, f, t)),
+            (r"experts/.*down_proj/kernel$", P(e, t, f)),
+            (r"router/kernel$", P(f, None)),
+            # Attention: qkv column-parallel (heads on tensor), o row-parallel.
+            (r"(qkv|q_proj|k_proj|v_proj)/kernel$", P(f, t)),
+            (r"o_proj/kernel$", P(t, f)),
+            # MLP: up/gate column-parallel, down row-parallel.
+            (r"(up_proj|gate_proj|fc1|wi(_\d+)?)/kernel$", P(f, t)),
+            (r"(down_proj|fc2|wo)/kernel$", P(t, f)),
+            # Embedding + unembed: vocab over tensor, model dim over fsdp.
+            (r"(embedding|embed_tokens).*/embedding$", P(t, f)),
+            (r"(lm_head|unembed)/kernel$", P(f, t)),
+            # Biases / norm scales attached to a TP-sharded output.
+            (r"(qkv|q_proj|k_proj|v_proj|up_proj|gate_proj|fc1|wi(_\d+)?)/bias$", P(t)),
+            # Everything else (norm scales, small biases): replicated.
+        )
+        + _REPLICATED_TAIL
+    )
+
+
+PRESETS = {
+    "dp": lambda: dense_rules(fsdp=False),
+    "fsdp_dense": lambda: dense_rules(fsdp=True),
+    "transformer": lambda: transformer_rules(),
+    "transformer_tp_only": lambda: transformer_rules(fsdp=False),
+    "transformer_fsdp_only": lambda: transformer_rules(tensor=False),
+}

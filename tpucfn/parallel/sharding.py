@@ -1,0 +1,118 @@
+"""Regex-path → PartitionSpec sharding-rule engine.
+
+The reference had exactly one placement decision — which hosts appear in the
+hostfile (SURVEY.md §1 L3) — because every parameter lived replicated on
+every GPU (PS) or all-reduced (Horovod). Here placement is per-parameter:
+a rule list maps parameter tree paths (``"blocks_3/attn/qkv/kernel"``) to
+:class:`jax.sharding.PartitionSpec` over the named mesh axes. This is the
+single mechanism behind DP (trivial specs), FSDP, TP, and EP; the presets in
+:mod:`tpucfn.parallel.presets` are just rule lists.
+
+First matching rule wins; a catch-all ``(".*", P())`` should terminate every
+rule list so unmatched params are explicitly replicated.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Iterable
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpucfn.mesh import BATCH_AXES
+
+Rule = tuple[str, P]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for k in path:
+        if isinstance(k, jax.tree_util.DictKey):
+            parts.append(str(k.key))
+        elif isinstance(k, jax.tree_util.SequenceKey):
+            parts.append(str(k.idx))
+        elif isinstance(k, jax.tree_util.GetAttrKey):
+            parts.append(str(k.name))
+        elif isinstance(k, jax.tree_util.FlattenedIndexKey):
+            parts.append(str(k.key))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingRules:
+    """An ordered rule list, applied first-match-wins to tree paths."""
+
+    rules: tuple[Rule, ...]
+
+    def __post_init__(self):
+        for pat, spec in self.rules:
+            re.compile(pat)
+            if not isinstance(spec, P):
+                raise TypeError(f"rule {pat!r} maps to {spec!r}, want PartitionSpec")
+
+    def spec_for(self, path: str, ndim: int) -> P:
+        for pat, spec in self.rules:
+            if re.search(pat, path):
+                return _fit_spec(spec, ndim, path)
+        return P()
+
+    def extended(self, head: Iterable[Rule]) -> "ShardingRules":
+        """New rules with ``head`` prepended (higher precedence)."""
+        return ShardingRules(tuple(head) + self.rules)
+
+
+def _fit_spec(spec: P, ndim: int, path: str) -> P:
+    """Reject over-long specs loudly instead of letting jit produce an
+    inscrutable error later. Short specs are fine — NamedSharding treats
+    missing trailing entries as None."""
+    if len(spec) > ndim:
+        raise ValueError(
+            f"rule spec {spec} has {len(spec)} entries but {path!r} has rank {ndim}"
+        )
+    return spec
+
+
+def make_partition_spec(rules: ShardingRules, tree: Any) -> Any:
+    """Map a pytree of arrays/ShapeDtypeStructs to a pytree of PartitionSpec."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, x: rules.spec_for(_path_str(path), getattr(x, "ndim", len(getattr(x, "shape", ())))),
+        tree,
+    )
+
+
+partition_spec_tree = make_partition_spec  # alias
+
+
+def named_sharding_tree(mesh: Mesh, rules: ShardingRules, tree: Any) -> Any:
+    """PartitionSpecs bound to a concrete mesh, ready for jit in_shardings."""
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        make_partition_spec(rules, tree),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def batch_spec(extra_axes: tuple[str | None, ...] = ()) -> P:
+    """PartitionSpec for a batch: leading dim over (data, fsdp), optional
+    trailing axes (e.g. ``("context",)`` for sequence-parallel inputs)."""
+    return P(BATCH_AXES, *extra_axes)
+
+
+def shard_batch(mesh: Mesh, batch: Any, extra_axes: tuple[str | None, ...] = ()) -> Any:
+    """Place a host-local batch onto the mesh, sharded over the batch axes.
+
+    The analogue of the reference's per-worker DataIter partitioning
+    (SURVEY.md §3.2: each worker reads its own RecordIO shard), expressed
+    as an explicit device placement. Each process passes only its local
+    rows; ``make_array_from_process_local_data`` assembles the global
+    array, so the same call works single-process (tests, one chip) and
+    multi-host (each host feeds its slice of the fleet).
+    """
+    sharding = NamedSharding(mesh, batch_spec(extra_axes))
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, x), batch
+    )

@@ -1,0 +1,2 @@
+from tpucfn.train.state import TrainState  # noqa: F401
+from tpucfn.train.trainer import Trainer, TrainerConfig  # noqa: F401

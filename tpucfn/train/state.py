@@ -1,0 +1,48 @@
+"""Training state pytree.
+
+The reference's "state" was scattered across processes: weights on ps-lite
+servers, optimizer state wherever the server shard lived, step count in each
+worker's loop (SURVEY.md §3.2). Here it is one pytree, sharded by the same
+rule engine as the params, so checkpointing, resume, and fault recovery all
+see a single coherent object.
+
+``model_state`` carries non-differentiated model collections (flax
+``batch_stats`` for BatchNorm, etc.). Because the whole step runs as one
+GSPMD program over the global batch, BN statistics computed inside it are
+*cross-replica by construction* — the sync-BN that needed a dedicated
+NCCL/Horovod code path on the reference stack falls out of the sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+
+@struct.dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    model_state: Any  # e.g. {"batch_stats": ...}; {} when unused
+    opt_state: optax.OptState
+    rng: jax.Array
+
+    @classmethod
+    def create(
+        cls,
+        params: Any,
+        tx: optax.GradientTransformation,
+        rng: jax.Array,
+        model_state: Any = None,
+    ) -> "TrainState":
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            model_state={} if model_state is None else model_state,
+            opt_state=tx.init(params),
+            rng=rng,
+        )

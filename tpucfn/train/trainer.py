@@ -1,0 +1,175 @@
+"""The SPMD trainer: one jit-compiled program per step.
+
+This collapses the reference's entire per-step pipeline — forward/backward
+in the MXNet/TF C++ engine, gradients handed to ps-lite push/pull or
+Horovod's fusion queue + NCCL ring (SURVEY.md §3.2-§3.4) — into a single
+XLA program. The batch arrives sharded over the (data, fsdp) mesh axes,
+params/optimizer state live wherever the sharding rules put them, and XLA
+inserts every collective (grad all-reduce, FSDP all-gather/reduce-scatter,
+TP psum) as part of the same fused computation. There is no framework-owned
+wire protocol: the compiler owns the data path (SURVEY.md §5 last row).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpucfn.parallel.sharding import (
+    ShardingRules,
+    batch_spec,
+    make_partition_spec,
+    named_sharding_tree,
+)
+from tpucfn.train.state import TrainState
+
+# loss_fn(params, model_state, batch, rng)
+#   -> (loss, (metrics_dict, new_model_state))
+# ``model_state`` carries mutable collections (batch_stats); return it
+# unchanged (or {}) for stateless models.
+LossFn = Callable[[Any, Any, Any, jax.Array], tuple[jax.Array, tuple[dict, Any]]]
+
+# init_fn(rng) -> (params, model_state)
+InitFn = Callable[[jax.Array], tuple[Any, Any]]
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainerConfig:
+    donate_state: bool = True
+    # Extra sharded batch dims after the leading batch axis, e.g.
+    # ("context",) when sequence parallelism is on.
+    batch_extra_axes: tuple[str | None, ...] = ()
+
+
+class Trainer:
+    """Binds (mesh, sharding rules, loss, optimizer) into jitted init/step.
+
+    Usage::
+
+        trainer = Trainer(mesh, rules, loss_fn, optax.adamw(1e-3), init_fn)
+        state = trainer.init(jax.random.key(0))
+        state, metrics = trainer.step(state, batch)   # batch: host-local
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        rules: ShardingRules,
+        loss_fn: LossFn,
+        tx: optax.GradientTransformation,
+        init_fn: InitFn,
+        config: TrainerConfig = TrainerConfig(),
+        eval_loss_fn: LossFn | None = None,
+    ):
+        """``eval_loss_fn`` runs inference-mode semantics (BN running stats,
+        no dropout); models with train/eval divergence must supply it or
+        eval metrics are computed in train mode."""
+        self.mesh = mesh
+        self.rules = rules
+        self.loss_fn = loss_fn
+        self.eval_loss_fn = eval_loss_fn if eval_loss_fn is not None else loss_fn
+        self.tx = tx
+        self.init_fn = init_fn
+        self.config = config
+        self._jit_step = None
+        self._jit_eval = None
+        self._state_shardings = None
+        self._abstract_state = None
+
+    # ---- init ----------------------------------------------------------
+
+    def _state_rules(self) -> ShardingRules:
+        # Scalars and rng keys replicate; params/opt_state follow the param
+        # rules (optax state mirrors the param tree structure under mu/nu/
+        # etc., so path-regex rules written for params still match).
+        return self.rules.extended([(r"(^|/)(step|rng|count)($|/)", P())])
+
+    def _create_state(self, rng: jax.Array) -> TrainState:
+        params_rng, step_rng = jax.random.split(rng)
+        params, model_state = self.init_fn(params_rng)
+        return TrainState.create(params, self.tx, step_rng, model_state)
+
+    def _abstract(self) -> Any:
+        if self._abstract_state is None:
+            self._abstract_state = jax.eval_shape(self._create_state, jax.random.key(0))
+        return self._abstract_state
+
+    def state_shardings(self) -> Any:
+        if self._state_shardings is None:
+            self._state_shardings = named_sharding_tree(
+                self.mesh, self._state_rules(), self._abstract()
+            )
+        return self._state_shardings
+
+    def init(self, rng: jax.Array) -> TrainState:
+        """Initialize the state directly into its target sharding — params
+        are *born sharded* on their owner devices (no host staging, no
+        broadcast; the analogue of the reference's rank-0-initializes-then-
+        KVStore-pushes startup, minus the wire traffic)."""
+        return jax.jit(self._create_state, out_shardings=self.state_shardings())(rng)
+
+    def abstract_state(self) -> Any:
+        """ShapeDtypeStructs with shardings attached — what checkpoint
+        restore needs to re-materialize the state on a (possibly different)
+        mesh (SURVEY.md §5 checkpoint/resume row)."""
+        sh = self.state_shardings()
+        return jax.tree.map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            self._abstract(), sh,
+        )
+
+    # ---- step ----------------------------------------------------------
+
+    def _step_fn(self, state: TrainState, batch: Any):
+        step_rng = jax.random.fold_in(state.rng, state.step)
+        (loss, (aux, new_model_state)), grads = jax.value_and_grad(
+            self.loss_fn, has_aux=True
+        )(state.params, state.model_state, batch, step_rng)
+        updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            step=state.step + 1,
+            params=new_params,
+            model_state=new_model_state,
+            opt_state=new_opt,
+            rng=state.rng,
+        )
+        return new_state, {"loss": loss, **aux}
+
+    def batch_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, batch_spec(self.config.batch_extra_axes))
+
+    def step(self, state: TrainState, batch: Any):
+        if self._jit_step is None:
+            shardings = self.state_shardings()
+            metric_spec = NamedSharding(self.mesh, P())
+            self._jit_step = jax.jit(
+                self._step_fn,
+                in_shardings=(shardings, self.batch_sharding()),
+                out_shardings=(shardings, metric_spec),
+                donate_argnums=(0,) if self.config.donate_state else (),
+            )
+        return self._jit_step(state, batch)
+
+    # ---- eval ----------------------------------------------------------
+
+    def eval_step(self, state: TrainState, batch: Any) -> dict[str, jax.Array]:
+        if self._jit_eval is None:
+            def _eval(state, batch):
+                loss, (aux, _) = self.eval_loss_fn(
+                    state.params, state.model_state, batch, state.rng
+                )
+                return {"loss": loss, **aux}
+            self._jit_eval = jax.jit(
+                _eval,
+                in_shardings=(self.state_shardings(), self.batch_sharding()),
+                out_shardings=NamedSharding(self.mesh, P()),
+            )
+        return self._jit_eval(state, batch)
+
+    def param_spec(self) -> Any:
+        return make_partition_spec(self._state_rules(), self._abstract())
