@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Distributed CIFAR-10 ResNet training — the minimum end-to-end slice.
+
+Capability parity with the reference's flagship walkthrough (SURVEY.md
+§3.2; BASELINE config 1):
+
+    reference:  ../../tools/launch.py -n $DEEPLEARNING_WORKERS_COUNT \
+                   -H $DEEPLEARNING_WORKERS_PATH \
+                   python train_cifar10.py --network resnet --kv-store dist_sync
+    tpucfn:     tpucfn launch examples/cifar10_resnet20.py -- \
+                   --network resnet20 --kv-store dist_sync
+
+Same UX; under the hood the per-batch kvstore.push/pull against parameter
+servers is replaced by one jit-compiled SPMD step whose gradient psum XLA
+emits over ICI. ``--kv-store dist_sync`` is accepted (and means what it
+meant: synchronous data parallelism); there is simply no server process to
+run anymore.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+from common import (  # noqa: E402
+    add_cluster_args,
+    build_example_mesh,
+    per_process_batch,
+    stage_synthetic,
+)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    add_cluster_args(p)
+    p.add_argument("--network", default="resnet20", choices=["resnet20", "resnet32"])
+    p.add_argument("--num-examples", type=int, default=2048,
+                   help="synthetic dataset size to stage")
+    args = p.parse_args()
+
+    from tpucfn.launch import initialize_runtime
+
+    initialize_runtime()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpucfn.ckpt import CheckpointManager
+    from tpucfn.data import ShardedDataset, prefetch_to_mesh
+    from tpucfn.models import ResNet, ResNetConfig
+    from tpucfn.obs import MetricLogger, StepTimer, profile_steps
+    from tpucfn.parallel import dense_rules
+    from tpucfn.train import Trainer
+
+    run_dir = Path(args.run_dir)
+    shards = stage_synthetic(
+        "cifar10", run_dir / "data", n=args.num_examples,
+        num_shards=max(8, jax.process_count()), seed=args.seed,
+    )
+
+    mesh = build_example_mesh(args)
+    cfg = {
+        "resnet20": ResNetConfig.resnet20_cifar,
+        "resnet32": ResNetConfig.resnet32_cifar,
+    }[args.network]()
+    model = ResNet(cfg)
+    sample = jnp.zeros((1, 32, 32, 3))
+
+    def init_fn(rng):
+        v = model.init(rng, sample, train=True)
+        return v["params"], {"batch_stats": v["batch_stats"]}
+
+    def loss_fn(params, mstate, batch, rng):
+        logits, upd = model.apply(
+            {"params": params, **mstate}, batch["image"], train=True,
+            mutable=["batch_stats"],
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]
+        ).mean()
+        acc = jnp.mean(jnp.argmax(logits, -1) == batch["label"])
+        return loss, ({"accuracy": acc}, dict(upd))
+
+    def eval_loss_fn(params, mstate, batch, rng):
+        logits = model.apply({"params": params, **mstate}, batch["image"], train=False)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]
+        ).mean()
+        acc = jnp.mean(jnp.argmax(logits, -1) == batch["label"])
+        return loss, ({"accuracy": acc}, mstate)
+
+    tx = optax.sgd(args.lr, momentum=0.9, nesterov=True)
+    trainer = Trainer(mesh, dense_rules(fsdp=args.fsdp > 1), loss_fn, tx, init_fn,
+                      eval_loss_fn=eval_loss_fn)
+
+    ds = ShardedDataset(shards, batch_size_per_process=per_process_batch(args),
+                        seed=args.seed)
+    logger = MetricLogger(run_dir / "logs", stdout_every=args.log_every)
+    timer = StepTimer()
+
+    with CheckpointManager(run_dir / "ckpt",
+                           save_interval_steps=args.ckpt_every) as ckpt:
+        if args.resume and ckpt.latest_step() is not None:
+            state = ckpt.restore(trainer.abstract_state())
+            print(f"resumed from step {int(state.step)}", flush=True)
+        else:
+            state = trainer.init(jax.random.key(args.seed))
+
+        total = args.steps or len(ds) * args.num_epochs
+        batches = prefetch_to_mesh(ds.batches(None), mesh)
+        with profile_steps(run_dir / "profile", enabled=args.profile):
+            for batch in batches:
+                if int(state.step) >= total:
+                    break
+                state, metrics = trainer.step(state, batch)
+                step = int(state.step)  # blocks on the step -> honest timing
+                timer.tick()
+                if step % args.log_every == 0 or step == total:
+                    logger.log(step, {**{k: float(v) for k, v in metrics.items()},
+                                      "step_time": timer._last or 0.0})
+                ckpt.save(step, state)
+        ckpt.save(int(state.step), state, force=True)
+
+    ips = timer.throughput(args.batch_size)
+    if ips and jax.process_index() == 0:
+        print(f"final: step={int(state.step)} loss={float(metrics['loss']):.4f} "
+              f"images/sec={ips:.1f} images/sec/chip={ips / jax.device_count():.1f}",
+              flush=True)
+    logger.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,69 @@
+"""Shared plumbing for the bundled examples.
+
+The reference's examples were AMI-shipped scripts driven by README
+commands (SURVEY.md §2.1); tpucfn ships them in-repo. Each example is a
+normal script that works single-host (`python examples/x.py`) and
+multi-host (`tpucfn launch examples/x.py`) with no code change — the
+runtime initialization no-ops outside a cluster.
+"""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+
+import jax
+
+
+def add_cluster_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--run-dir", default="/tmp/tpucfn-run",
+                   help="checkpoints, metrics, staged data land here (≈ the EFS mount)")
+    p.add_argument("--batch-size", type=int, default=256, help="GLOBAL batch size")
+    p.add_argument("--steps", type=int, default=0,
+                   help="hard step cap (0 = run the full epoch budget)")
+    p.add_argument("--num-epochs", type=int, default=1)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--ckpt-every", type=int, default=200)
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the latest checkpoint in --run-dir")
+    p.add_argument("--profile", action="store_true",
+                   help="capture a jax.profiler trace of steps 10-20")
+    # Parallelism surface (reference exposed only worker count; SURVEY §2.3
+    # mandates the full set as first-class flags).
+    p.add_argument("--kv-store", default="dist_sync",
+                   choices=["dist_sync", "device"],
+                   help="compat shim: the reference's MXNet flag; both map to "
+                        "synchronous DP via psum over ICI")
+    p.add_argument("--fsdp", type=int, default=1, help="fsdp axis size")
+    p.add_argument("--tensor", type=int, default=1, help="tensor-parallel axis size")
+
+
+def build_example_mesh(args):
+    from tpucfn.mesh import MeshSpec, build_mesh
+
+    n = jax.device_count()
+    return build_mesh(MeshSpec.for_devices(n, fsdp=args.fsdp, tensor=args.tensor))
+
+
+def per_process_batch(args) -> int:
+    if args.batch_size % jax.process_count():
+        raise SystemExit(
+            f"--batch-size {args.batch_size} not divisible by "
+            f"{jax.process_count()} processes"
+        )
+    return args.batch_size // jax.process_count()
+
+
+def stage_synthetic(kind: str, data_dir: Path, *, n: int, num_shards: int, seed: int = 0):
+    """Stage synthetic data once (≈ `aws s3 sync` in the reference README;
+    real datasets go through the identical write_dataset_shards path)."""
+    from tpucfn.data import synthetic_cifar10, synthetic_imagenet, write_dataset_shards
+
+    data_dir.mkdir(parents=True, exist_ok=True)
+    existing = sorted(data_dir.glob("*.tpurec"))
+    if existing:
+        return existing
+    gen = {"cifar10": synthetic_cifar10, "imagenet": synthetic_imagenet}[kind]
+    return write_dataset_shards(gen(n, seed=seed), data_dir, num_shards=num_shards)

@@ -1,0 +1,172 @@
+import subprocess
+
+import pytest
+
+from tpucfn.bootstrap import EnvContract, converge
+from tpucfn.launch import Launcher, LocalTransport, SSHTransport
+from tpucfn.provision import FakeControlPlane, Provisioner
+from tpucfn.provision.provisioner import ProvisioningError
+from tpucfn.spec import ACCELERATOR_TYPES, ClusterSpec
+
+
+def _spec(name="test-cluster", acc="v4-32"):
+    return ClusterSpec(name=name, accelerator=acc)
+
+
+# ---- spec ---------------------------------------------------------------
+
+
+def test_spec_json_roundtrip(tmp_path):
+    s = ClusterSpec(name="my-pod", accelerator="v5p-64",
+                    storage_path="gs://bkt/run", env=(("A", "1"),))
+    path = tmp_path / "cluster.json"
+    s.save(path)
+    assert ClusterSpec.load(path) == s
+
+
+def test_spec_rejects_unknown_accelerator():
+    with pytest.raises(ValueError, match="unknown accelerator"):
+        ClusterSpec(name="x-c", accelerator="v99-1")
+
+
+def test_spec_rejects_bad_name():
+    with pytest.raises(ValueError, match="name"):
+        ClusterSpec(name="Bad_Name!")
+
+
+def test_spec_rejects_unknown_json_fields():
+    with pytest.raises(ValueError, match="unknown ClusterSpec fields"):
+        ClusterSpec.from_json('{"name": "a-b", "worker_count": 4}')
+
+
+def test_sku_registry_consistency():
+    for sku in ACCELERATOR_TYPES.values():
+        assert sku.chips == sku.hosts * sku.chips_per_host
+        assert sku.default_mesh().num_devices == sku.chips
+
+
+# ---- provision ----------------------------------------------------------
+
+
+def test_create_stack_lifecycle():
+    cp = FakeControlPlane(steps_to_provision=3)
+    prov = Provisioner(cp)
+    rec = prov.create(_spec())
+    assert rec.state.value == "ACTIVE"
+    assert len(rec.hosts) == 4  # v4-32 = 4 hosts
+    assert rec.generation == 1
+
+
+def test_create_duplicate_rejected():
+    cp = FakeControlPlane()
+    prov = Provisioner(cp)
+    prov.create(_spec())
+    with pytest.raises(ValueError, match="already exists"):
+        prov.create(_spec())
+
+
+def test_failed_creation_raises():
+    cp = FakeControlPlane(fail_creation=True)
+    prov = Provisioner(cp)
+    with pytest.raises(ProvisioningError, match="no capacity"):
+        prov.create(_spec())
+
+
+def test_resize_reacquires_with_new_topology():
+    cp = FakeControlPlane()
+    prov = Provisioner(cp)
+    prov.create(_spec(acc="v4-16"))
+    rec = prov.resize("test-cluster", "v4-64")
+    assert rec.spec.accelerator == "v4-64"
+    assert len(rec.hosts) == 8
+    assert rec.generation == 2  # fencing token bumped
+
+
+def test_dead_host_triggers_reacquire():
+    cp = FakeControlPlane()
+    prov = Provisioner(cp)
+    rec1 = prov.create(_spec())
+    cp.kill_host("test-cluster", 2)
+    assert prov.unhealthy_hosts("test-cluster") == [2]
+    rec2 = prov.ensure_healthy("test-cluster")
+    assert rec2.generation > rec1.generation
+    assert all(h.healthy for h in rec2.hosts)
+
+
+# ---- bootstrap ----------------------------------------------------------
+
+
+def test_converge_writes_contract(tmp_path):
+    cp = FakeControlPlane()
+    rec = Provisioner(cp).create(_spec())
+    c = converge(rec, tmp_path, host_id=2)
+    assert c.workers_count == 4
+    assert c.host_id == 2
+    assert len(c.hosts()) == 4
+    assert c.coordinator.startswith("10.0.0.1:")
+    env_sh = (tmp_path / "env.sh").read_text()
+    assert "TPUCFN_WORKERS_COUNT" in env_sh
+    assert "DEEPLEARNING_WORKERS_COUNT" in env_sh  # legacy alias
+
+
+def test_contract_env_roundtrip(tmp_path):
+    cp = FakeControlPlane()
+    rec = Provisioner(cp).create(_spec())
+    c = converge(rec, tmp_path)
+    assert EnvContract.from_env(c.to_env()) == c
+
+
+def test_contract_missing_env_message():
+    with pytest.raises(EnvironmentError, match="not inside a converged"):
+        EnvContract.from_env({})
+
+
+# ---- launch -------------------------------------------------------------
+
+
+def test_ssh_transport_argv(tmp_path):
+    cp = FakeControlPlane()
+    rec = Provisioner(cp).create(_spec())
+    c = converge(rec, tmp_path)
+    t = SSHTransport()
+    argv = t.argv_for("10.0.0.3:8471", ["python", "train.py", "--lr", "0.1"],
+                      {"TPUCFN_HOST_ID": "2"})
+    assert argv[0] == "ssh"
+    assert "10.0.0.3" in argv
+    remote = argv[-1]
+    assert "TPUCFN_HOST_ID='2'" in remote or "TPUCFN_HOST_ID=2" in remote
+    assert "python train.py --lr 0.1" in remote
+
+
+def test_local_launch_fans_out_all_hosts(tmp_path):
+    cp = FakeControlPlane()
+    rec = Provisioner(cp).create(_spec())  # 4 hosts
+    c = converge(rec, tmp_path)
+    launcher = Launcher(c, LocalTransport())
+    marker = tmp_path / "out"
+    marker.mkdir()
+    procs = launcher.launch(
+        ["python", "-c",
+         "import os,pathlib;pathlib.Path("
+         f"r'{marker}'"
+         ").joinpath(os.environ['TPUCFN_HOST_ID']).write_text('ok')"]
+    )
+    assert launcher.wait(procs) == 0
+    assert sorted(p.name for p in marker.iterdir()) == ["0", "1", "2", "3"]
+
+
+def test_launch_wait_fails_fast_on_bad_rank(tmp_path):
+    cp = FakeControlPlane()
+    rec = Provisioner(cp).create(_spec())
+    c = converge(rec, tmp_path)
+    launcher = Launcher(c, LocalTransport())
+    procs = launcher.launch(
+        ["python", "-c",
+         "import os,sys,time\n"
+         "rc = 3 if os.environ['TPUCFN_HOST_ID']=='1' else 0\n"
+         "time.sleep(0 if rc else 30)\n"
+         "sys.exit(rc)"]
+    )
+    rc = launcher.wait(procs)
+    assert rc == 3
+    assert all(p.poll() is not None for p in procs)  # stragglers terminated

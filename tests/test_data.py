@@ -1,0 +1,112 @@
+import numpy as np
+import pytest
+
+from tpucfn.data import (
+    RecordShardWriter,
+    ShardedDataset,
+    prefetch_to_mesh,
+    read_record_shard,
+    synthetic_cifar10,
+    write_dataset_shards,
+)
+from tpucfn.data.records import decode_example
+
+
+def test_record_roundtrip(tmp_path):
+    p = tmp_path / "a.tpurec"
+    with RecordShardWriter(p) as w:
+        w.write(b"hello")
+        w.write(b"world" * 100)
+    assert list(read_record_shard(p)) == [b"hello", b"world" * 100]
+
+
+def test_record_crc_detects_corruption(tmp_path):
+    p = tmp_path / "a.tpurec"
+    with RecordShardWriter(p) as w:
+        w.write(b"payload-payload")
+    raw = bytearray(p.read_bytes())
+    raw[-3] ^= 0xFF  # flip a payload byte
+    p.write_bytes(bytes(raw))
+    with pytest.raises(ValueError, match="CRC"):
+        list(read_record_shard(p))
+
+
+def test_record_truncation_detected(tmp_path):
+    p = tmp_path / "a.tpurec"
+    with RecordShardWriter(p) as w:
+        for i in range(10):
+            w.write(b"x" * 100)
+    p.write_bytes(p.read_bytes()[:-50])
+    with pytest.raises(ValueError):
+        list(read_record_shard(p))
+
+
+def test_bad_magic(tmp_path):
+    p = tmp_path / "junk.tpurec"
+    p.write_bytes(b"\x00" * 64)
+    with pytest.raises(ValueError, match="magic"):
+        list(read_record_shard(p))
+
+
+def test_write_dataset_shards_roundtrip(tmp_path):
+    paths = write_dataset_shards(synthetic_cifar10(32), tmp_path, num_shards=4)
+    assert len(paths) == 4
+    examples = [decode_example(b) for p in paths for b in read_record_shard(p)]
+    assert len(examples) == 32
+    assert examples[0]["image"].shape == (32, 32, 3)
+    assert examples[0]["label"].shape == ()
+
+
+def test_sharded_dataset_process_ownership(tmp_path):
+    paths = write_dataset_shards(synthetic_cifar10(64), tmp_path, num_shards=4)
+    d0 = ShardedDataset(paths, batch_size_per_process=8, process_index=0, process_count=2)
+    d1 = ShardedDataset(paths, batch_size_per_process=8, process_index=1, process_count=2)
+    assert set(d0.local_shards) | set(d1.local_shards) == {str(p) for p in paths}
+    assert not set(d0.local_shards) & set(d1.local_shards)
+
+
+def test_more_processes_than_shards_raises(tmp_path):
+    paths = write_dataset_shards(synthetic_cifar10(8), tmp_path, num_shards=2)
+    with pytest.raises(ValueError, match="owns no shards"):
+        ShardedDataset(paths, batch_size_per_process=2, process_index=2, process_count=4)
+
+
+def test_epoch_determinism_and_reshuffle(tmp_path):
+    paths = write_dataset_shards(synthetic_cifar10(64), tmp_path, num_shards=2)
+    ds = ShardedDataset(paths, batch_size_per_process=16, seed=7)
+    e0a = [b["label"] for b in ds.epoch(0)]
+    e0b = [b["label"] for b in ds.epoch(0)]
+    e1 = [b["label"] for b in ds.epoch(1)]
+    np.testing.assert_array_equal(np.concatenate(e0a), np.concatenate(e0b))
+    assert not np.array_equal(np.concatenate(e0a), np.concatenate(e1))
+
+
+def test_batch_shapes_and_len(tmp_path):
+    paths = write_dataset_shards(synthetic_cifar10(70), tmp_path, num_shards=2)
+    ds = ShardedDataset(paths, batch_size_per_process=16)
+    assert len(ds) == 4  # 70 // 16, drop remainder
+    batches = list(ds.epoch(0))
+    assert len(batches) == 4
+    assert batches[0]["image"].shape == (16, 32, 32, 3)
+
+
+def test_prefetch_to_mesh_yields_sharded(tmp_path, mesh_dp8):
+    from jax.sharding import PartitionSpec as P
+
+    paths = write_dataset_shards(synthetic_cifar10(64), tmp_path, num_shards=2)
+    ds = ShardedDataset(paths, batch_size_per_process=16)
+    out = list(prefetch_to_mesh(ds.epoch(0), mesh_dp8))
+    assert len(out) == 4
+    assert out[0]["image"].sharding.spec == P(("data", "fsdp"))
+    assert out[0]["image"].addressable_shards[0].data.shape[0] == 2
+
+
+def test_prefetch_propagates_errors(mesh_dp8):
+    def bad_iter():
+        yield {"x": np.ones((8, 2), np.float32)}
+        raise RuntimeError("decode exploded")
+
+    it = prefetch_to_mesh(bad_iter(), mesh_dp8)
+    next(it)
+    with pytest.raises(RuntimeError, match="decode exploded"):
+        list(it)

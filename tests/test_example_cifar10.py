@@ -1,0 +1,62 @@
+"""End-to-end smoke of the minimum slice (SURVEY.md §7.3): the bundled
+CIFAR-10 example trains on 8 fake devices in a subprocess, checkpoints,
+and resumes — the convergence-smoke analogue of the reference's "stack
+reaches CREATE_COMPLETE and the CIFAR-10 example converges" manual test.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _run_example(run_dir, steps, resume=False, extra=()):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    )
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [
+        sys.executable, str(REPO / "examples" / "cifar10_resnet20.py"),
+        "--run-dir", str(run_dir),
+        "--batch-size", "64",
+        "--steps", str(steps),
+        "--num-examples", "256",
+        "--ckpt-every", "5",
+        "--log-every", "5",
+    ] + (["--resume"] if resume else []) + list(extra)
+    return subprocess.run(cmd, env=env, capture_output=True, text=True, timeout=600)
+
+
+def test_cifar10_example_end_to_end(tmp_path):
+    r = _run_example(tmp_path, steps=10)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "images/sec" in r.stdout
+
+    # metrics were logged as JSONL with loss/accuracy/step_time
+    logs = list((tmp_path / "logs").glob("*.jsonl"))
+    assert logs, r.stdout
+    records = [json.loads(line) for line in logs[0].read_text().splitlines()]
+    assert any(rec["step"] == 10 for rec in records)
+    assert all("loss" in rec for rec in records)
+
+    # checkpoints exist
+    assert (tmp_path / "ckpt").exists()
+
+    # resume continues from step 10 rather than restarting
+    r2 = _run_example(tmp_path, steps=14, resume=True)
+    assert r2.returncode == 0, f"stdout:\n{r2.stdout}\nstderr:\n{r2.stderr}"
+    assert "resumed from step 10" in r2.stdout
+    m = re.search(r"final: step=(\d+)", r2.stdout)
+    assert m and int(m.group(1)) == 14
+
+
+def test_cifar10_example_fsdp_mode(tmp_path):
+    r = _run_example(tmp_path, steps=4, extra=("--fsdp", "2"))
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"

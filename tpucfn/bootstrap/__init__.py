@@ -1,0 +1,1 @@
+from tpucfn.bootstrap.contract import EnvContract, converge  # noqa: F401

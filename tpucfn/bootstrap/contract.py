@@ -1,0 +1,108 @@
+"""The cluster contract — what every layer above the provisioner consumes.
+
+This is the exact analogue of the reference's bootstrap output (SURVEY.md
+§2.1 "Cluster contract"): a hostfile of worker addresses plus exported env
+vars, converged per-host at boot. Reference names → tpucfn names:
+
+    $DEEPLEARNING_WORKERS_PATH      → $TPUCFN_WORKERS_PATH  (hostfile)
+    $DEEPLEARNING_WORKERS_COUNT     → $TPUCFN_WORKERS_COUNT
+    $DEEPLEARNING_WORKER_GPU_COUNT  → $TPUCFN_WORKER_CHIP_COUNT
+    (implicit master)               → $TPUCFN_COORDINATOR   (host0:port —
+                                      jax.distributed rendezvous, which
+                                      replaces both MPI and the dmlc
+                                      scheduler)
+    (implicit EFS mount)            → $TPUCFN_STORAGE       (GCS/shared dir)
+
+The legacy ``DEEPLEARNING_*`` names are also exported so reference-era
+launch commands (``launch.py -n $DEEPLEARNING_WORKERS_COUNT -H
+$DEEPLEARNING_WORKERS_PATH …``) keep working verbatim — the "examples run
+unmodified from the user's side" requirement (BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from pathlib import Path
+
+from tpucfn.provision.control_plane import ClusterRecord
+
+COORDINATOR_PORT = 8476
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvContract:
+    workers_path: str  # hostfile location
+    workers_count: int
+    worker_chip_count: int
+    coordinator: str  # "host0_addr:port"
+    host_id: int
+    storage: str
+    generation: int
+
+    def to_env(self) -> dict[str, str]:
+        env = {
+            "TPUCFN_WORKERS_PATH": self.workers_path,
+            "TPUCFN_WORKERS_COUNT": str(self.workers_count),
+            "TPUCFN_WORKER_CHIP_COUNT": str(self.worker_chip_count),
+            "TPUCFN_COORDINATOR": self.coordinator,
+            "TPUCFN_HOST_ID": str(self.host_id),
+            "TPUCFN_STORAGE": self.storage,
+            "TPUCFN_GENERATION": str(self.generation),
+            # Legacy aliases for reference-era commands.
+            "DEEPLEARNING_WORKERS_PATH": self.workers_path,
+            "DEEPLEARNING_WORKERS_COUNT": str(self.workers_count),
+            "DEEPLEARNING_WORKER_GPU_COUNT": str(self.worker_chip_count),
+        }
+        return env
+
+    @classmethod
+    def from_env(cls, env: dict[str, str] | None = None) -> "EnvContract":
+        e = os.environ if env is None else env
+        try:
+            return cls(
+                workers_path=e["TPUCFN_WORKERS_PATH"],
+                workers_count=int(e["TPUCFN_WORKERS_COUNT"]),
+                worker_chip_count=int(e["TPUCFN_WORKER_CHIP_COUNT"]),
+                coordinator=e["TPUCFN_COORDINATOR"],
+                host_id=int(e["TPUCFN_HOST_ID"]),
+                storage=e.get("TPUCFN_STORAGE", ""),
+                generation=int(e.get("TPUCFN_GENERATION", "0")),
+            )
+        except KeyError as k:
+            raise EnvironmentError(
+                f"missing {k.args[0]} — this process is not inside a converged "
+                "tpucfn cluster (run via `tpucfn launch` or source the env file)"
+            ) from None
+
+    def hosts(self) -> list[str]:
+        return Path(self.workers_path).read_text().split()
+
+
+def converge(record: ClusterRecord, run_dir: str | Path, host_id: int = 0) -> EnvContract:
+    """Per-host bootstrap: write the hostfile + env file under ``run_dir``
+    (≈ what cfn-init did with EC2 metadata), return the contract.
+
+    Idempotent — re-running after a re-acquire overwrites with the new
+    generation, exactly like the reference's bootstrap regenerating the
+    hostfile after an ASG resize (SURVEY.md §3.5).
+    """
+    d = Path(run_dir)
+    d.mkdir(parents=True, exist_ok=True)
+    hostfile = d / "hostfile"
+    hostfile.write_text("".join(f"{h.address}\n" for h in record.hosts))
+    coord_host = record.hosts[0].address.rsplit(":", 1)[0]
+    contract = EnvContract(
+        workers_path=str(hostfile),
+        workers_count=len(record.hosts),
+        worker_chip_count=record.spec.sku.chips_per_host,
+        coordinator=f"{coord_host}:{COORDINATOR_PORT}",
+        host_id=host_id,
+        storage=record.spec.storage_path or str(d / "storage"),
+        generation=record.generation,
+    )
+    envfile = d / "env.sh"
+    envfile.write_text(
+        "".join(f"export {k}={v!r}\n" for k, v in sorted(contract.to_env().items()))
+    )
+    return contract

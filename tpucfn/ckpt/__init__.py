@@ -1,0 +1,1 @@
+from tpucfn.ckpt.manager import CheckpointManager  # noqa: F401

@@ -1,0 +1,73 @@
+"""Sharding-aware checkpoint/resume on Orbax.
+
+Reference behavior being replaced: per-epoch ``--model-prefix`` checkpoints
+written to EFS so any node could resume after a manual job restart
+(SURVEY.md §5 checkpoint row). TPU-native version: every host writes its
+own param shards (no gather to a master), saves are async so the train
+loop isn't blocked on storage, and restore re-materializes directly into
+the target sharding — including onto a *different* mesh shape than the one
+that saved (the "resize = re-acquire + resume" path, SURVEY.md §7.4
+item 2).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    """Thin wrapper over :class:`orbax.checkpoint.CheckpointManager` fixed
+    to tpucfn's TrainState layout."""
+
+    def __init__(
+        self,
+        directory: str | Path,
+        *,
+        max_to_keep: int = 3,
+        save_interval_steps: int = 1,
+        async_save: bool = True,
+    ):
+        self.directory = Path(directory).absolute()
+        self.directory.mkdir(parents=True, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            enable_async_checkpointing=async_save,
+        )
+        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+
+    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        if step in self._mgr.all_steps():
+            return False  # idempotent: final force-save may race an interval save
+        return self._mgr.save(step, args=ocp.args.StandardSave(state), force=force)
+
+    def restore(self, abstract_state: Any, step: int | None = None) -> Any:
+        """Restore into the shardings carried by ``abstract_state``
+        (from :meth:`tpucfn.train.Trainer.abstract_state`) — this is what
+        makes cross-topology resume work: the saved layout is re-sliced to
+        whatever mesh the abstract state targets."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint found under {self.directory}")
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract_state))
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def wait(self) -> None:
+        """Block until in-flight async saves are durable (call before
+        declaring a run finished or killing the process)."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.wait()
+        self.close()

@@ -1,0 +1,3 @@
+from tpucfn.data.records import RecordShardWriter, read_record_shard, write_dataset_shards  # noqa: F401
+from tpucfn.data.pipeline import ShardedDataset, prefetch_to_mesh  # noqa: F401
+from tpucfn.data.synthetic import synthetic_cifar10, synthetic_imagenet  # noqa: F401

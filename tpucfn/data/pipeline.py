@@ -1,0 +1,137 @@
+"""Host-sharded input pipeline with device prefetch.
+
+The hot-path contract from SURVEY.md §3.2: every step, each worker must
+have its next batch ready before the previous step's compute finishes —
+on the reference this was MXNet's DataIter threads reading RecordIO; here
+it is a background thread that assembles the next global batch onto the
+mesh (``make_array_from_process_local_data``) while the current step runs,
+keeping the TPU fed from host memory without a host↔device sync bubble
+(SURVEY.md §7.4 item 4, the "S3→HBM" path).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from pathlib import Path
+from typing import Any, Iterator, Sequence
+
+import jax
+import numpy as np
+
+from tpucfn.data import records
+from tpucfn.parallel.sharding import shard_batch
+
+
+class ShardedDataset:
+    """Deterministic, per-process-sharded, shuffled batch iterator over
+    tpurecord shards.
+
+    Shard ``i`` is owned by process ``i % num_processes`` — the same
+    ownership rule the reference applied to RecordIO parts listed in the
+    hostfile order. Shuffling is seeded per epoch so every process draws
+    from a common permutation schedule and global batches are reproducible
+    run-to-run (the reference's implicit input order was not — SURVEY.md
+    §7.4 item 1 calls out exactly this divergence risk).
+    """
+
+    def __init__(
+        self,
+        shard_paths: Sequence[str | Path],
+        *,
+        batch_size_per_process: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_remainder: bool = True,
+        process_index: int | None = None,
+        process_count: int | None = None,
+    ):
+        if not shard_paths:
+            raise ValueError("no shard paths given")
+        self.all_shards = sorted(str(p) for p in shard_paths)
+        self.pi = jax.process_index() if process_index is None else process_index
+        self.pc = jax.process_count() if process_count is None else process_count
+        self.local_shards = self.all_shards[self.pi :: self.pc]
+        if not self.local_shards:
+            raise ValueError(
+                f"process {self.pi}/{self.pc} owns no shards out of "
+                f"{len(self.all_shards)} — stage more shards than processes"
+            )
+        self.batch = batch_size_per_process
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_remainder = drop_remainder
+        self._cache: list[dict[str, np.ndarray]] | None = None
+
+    def _load(self) -> list[dict[str, np.ndarray]]:
+        if self._cache is None:
+            out = []
+            for p in self.local_shards:
+                out.extend(records.decode_example(b) for b in records.read_record_shard(p))
+            if not out:
+                raise ValueError(f"shards {self.local_shards} contain no examples")
+            self._cache = out
+        return self._cache
+
+    def __len__(self) -> int:
+        n = len(self._load())
+        return n // self.batch if self.drop_remainder else -(-n // self.batch)
+
+    def epoch(self, epoch: int) -> Iterator[dict[str, np.ndarray]]:
+        """One epoch of host-local batches (dicts of stacked arrays)."""
+        examples = self._load()
+        order = np.arange(len(examples))
+        if self.shuffle:
+            # Epoch-keyed seed, offset by process so local orders differ
+            # but are reproducible.
+            np.random.RandomState((self.seed, epoch, self.pi)).shuffle(order)
+        for start in range(0, len(order) - self.batch + 1, self.batch):
+            idx = order[start : start + self.batch]
+            yield {
+                k: np.stack([examples[i][k] for i in idx])
+                for k in examples[0]
+            }
+        if not self.drop_remainder and len(order) % self.batch:
+            idx = order[len(order) - len(order) % self.batch :]
+            yield {k: np.stack([examples[i][k] for i in idx]) for k in examples[0]}
+
+    def batches(self, num_epochs: int | None = None) -> Iterator[dict[str, np.ndarray]]:
+        e = 0
+        while num_epochs is None or e < num_epochs:
+            yield from self.epoch(e)
+            e += 1
+
+
+def prefetch_to_mesh(
+    it: Iterator[dict[str, np.ndarray]],
+    mesh,
+    *,
+    extra_axes: tuple[str | None, ...] = (),
+    depth: int = 2,
+) -> Iterator[Any]:
+    """Wrap a host-batch iterator so device transfer overlaps compute.
+
+    A daemon thread stays ``depth`` global batches ahead; the consumer
+    always finds its next batch already resident on the mesh.
+    """
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    _END = object()
+
+    def producer():
+        try:
+            for host_batch in it:
+                q.put(shard_batch(mesh, host_batch, extra_axes))
+        except Exception as e:  # surface pipeline errors to the consumer
+            q.put(e)
+            return
+        q.put(_END)
+
+    t = threading.Thread(target=producer, daemon=True, name="tpucfn-prefetch")
+    t.start()
+    while True:
+        item = q.get()
+        if item is _END:
+            return
+        if isinstance(item, Exception):
+            raise item
+        yield item

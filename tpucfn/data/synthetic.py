@@ -1,0 +1,43 @@
+"""Synthetic dataset generators.
+
+The reference staged real CIFAR-10/ImageNet from S3 (SURVEY.md §2.1); this
+zero-egress build environment cannot download them, so convergence smoke
+tests and benchmarks run on deterministic synthetic data with the same
+shapes/dtypes/label cardinality. The staging path (``write_dataset_shards``
+→ ``ShardedDataset``) is identical to what a real dataset would use — only
+the bytes differ; point ``write_dataset_shards`` at a real decoder to stage
+the real thing.
+
+The synthetic task is *learnable* (class-conditional means) so loss curves
+actually discriminate working training from broken training.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+
+def _class_conditional_images(
+    n: int, hw: int, classes: int, seed: int
+) -> Iterator[dict[str, np.ndarray]]:
+    rs = np.random.RandomState(seed)
+    # Fixed per-class mean patterns; examples are mean + noise.
+    protos = rs.randn(classes, hw, hw, 3).astype(np.float32)
+    for _ in range(n):
+        y = int(rs.randint(classes))
+        x = protos[y] * 0.5 + rs.randn(hw, hw, 3).astype(np.float32) * 0.5
+        yield {"image": x.astype(np.float32), "label": np.int32(y)}
+
+
+def synthetic_cifar10(n: int = 1024, seed: int = 0) -> Iterator[dict[str, np.ndarray]]:
+    """CIFAR-10-shaped (32×32×3, 10 classes) learnable synthetic stream."""
+    return _class_conditional_images(n, 32, 10, seed)
+
+
+def synthetic_imagenet(
+    n: int = 256, seed: int = 0, image_size: int = 224, classes: int = 1000
+) -> Iterator[dict[str, np.ndarray]]:
+    """ImageNet-shaped (224×224×3, 1000 classes) synthetic stream."""
+    return _class_conditional_images(n, image_size, classes, seed)

@@ -1,0 +1,6 @@
+from tpucfn.launch.launcher import (  # noqa: F401
+    Launcher,
+    LocalTransport,
+    SSHTransport,
+    initialize_runtime,
+)

@@ -1,0 +1,144 @@
+"""Multihost launcher — the ``launch.py`` / ``mpirun`` replacement.
+
+Reference launch path (SURVEY.md §3.2/§3.3): a tracker process ssh-fans
+out per-host commands with role env vars, then MPI/ps-lite bootstrap their
+own rendezvous. tpucfn keeps the one-command UX but collapses the stack:
+
+    tpucfn launch train.py -- --flags        (CLI, any host)
+      → Launcher: per-host env (contract + process_id) + Transport fan-out
+        → per host: initialize_runtime() → jax.distributed.initialize
+          → user main runs as ONE SPMD program over all chips
+
+There is no scheduler process, no per-GPU ranks (one process per host
+drives all local chips), and no wire protocol owned by this code —
+``jax.distributed`` does rendezvous (gRPC) and XLA does the data path.
+
+Transports: LocalTransport spawns subprocesses (single-host multi-chip,
+and the N-process CPU test rig from SURVEY.md §4); SSHTransport runs the
+same argv over ssh for real multi-host fleets, relying on the bootstrap
+layer's key setup exactly as the reference did.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shlex
+import subprocess
+import sys
+from typing import Sequence
+
+from tpucfn.bootstrap import EnvContract
+
+
+class Transport:
+    def run(self, host: str, argv: Sequence[str], env: dict[str, str]) -> subprocess.Popen:
+        raise NotImplementedError
+
+
+class LocalTransport(Transport):
+    """Spawn on this machine (ignores ``host``)."""
+
+    def run(self, host: str, argv: Sequence[str], env: dict[str, str]) -> subprocess.Popen:
+        full_env = {**os.environ, **env}
+        return subprocess.Popen(list(argv), env=full_env)
+
+    def argv_for(self, host: str, argv: Sequence[str], env: dict[str, str]) -> list[str]:
+        return list(argv)
+
+
+class SSHTransport(Transport):
+    """Fan out over passwordless SSH (the bootstrap layer's key contract).
+
+    Mirrors the reference's dmlc ssh tracker / `mpirun -hostfile` hop:
+    env is passed inline because ssh does not forward arbitrary vars.
+    """
+
+    def __init__(self, ssh_args: Sequence[str] = ("-o", "StrictHostKeyChecking=no")):
+        self.ssh_args = tuple(ssh_args)
+
+    def argv_for(self, host: str, argv: Sequence[str], env: dict[str, str]) -> list[str]:
+        hostname = host.rsplit(":", 1)[0]
+        env_prefix = " ".join(f"{k}={shlex.quote(v)}" for k, v in sorted(env.items()))
+        remote_cmd = f"{env_prefix} {' '.join(shlex.quote(a) for a in argv)}"
+        return ["ssh", *self.ssh_args, hostname, remote_cmd]
+
+    def run(self, host: str, argv: Sequence[str], env: dict[str, str]) -> subprocess.Popen:
+        return subprocess.Popen(self.argv_for(host, argv, env))
+
+
+@dataclasses.dataclass
+class Launcher:
+    contract: EnvContract
+    transport: Transport
+
+    def host_env(self, host_id: int) -> dict[str, str]:
+        env = self.contract.to_env()
+        env["TPUCFN_HOST_ID"] = str(host_id)
+        return env
+
+    def launch(self, argv: Sequence[str]) -> list[subprocess.Popen]:
+        """Start ``argv`` on every host; returns the Popen handles (the
+        local handle for LocalTransport, the ssh client handles for SSH)."""
+        hosts = self.contract.hosts()
+        procs = []
+        for host_id, host in enumerate(hosts):
+            procs.append(self.transport.run(host, argv, self.host_env(host_id)))
+        return procs
+
+    def wait(self, procs: list[subprocess.Popen], poll_interval: float = 0.05) -> int:
+        """Wait for all ranks; first nonzero exit wins and the rest are
+        terminated, so one dead host fails the job fast instead of hanging
+        the collective (SURVEY.md §5 failure-detection row). Polls rather
+        than waiting in rank order — rank 0 being alive must not mask a
+        crashed rank 3."""
+        import time
+
+        rc = 0
+        remaining = set(range(len(procs)))
+        try:
+            while remaining:
+                for i in sorted(remaining):
+                    r = procs[i].poll()
+                    if r is None:
+                        continue
+                    remaining.discard(i)
+                    if r != 0 and rc == 0:
+                        rc = r
+                        for q in procs:
+                            if q.poll() is None:
+                                q.terminate()
+                if remaining:
+                    time.sleep(poll_interval)
+        finally:
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+        return rc
+
+
+def initialize_runtime(contract: EnvContract | None = None) -> EnvContract | None:
+    """Per-process entry: join the cluster rendezvous.
+
+    Replaces both `hvd.init()`/MPI_Init and the dmlc scheduler handshake
+    (SURVEY.md §3.2/§3.3) with `jax.distributed.initialize`. No-op for
+    single-host jobs so the same user script runs anywhere.
+    """
+    if contract is None:
+        try:
+            contract = EnvContract.from_env()
+        except EnvironmentError:
+            return None  # plain single-host run, no cluster env
+    if contract.workers_count > 1:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=contract.coordinator,
+            num_processes=contract.workers_count,
+            process_id=contract.host_id,
+        )
+    return contract
+
+
+def main_argv_for_script(script: str, args: Sequence[str]) -> list[str]:
+    return [sys.executable, script, *args]

@@ -1,0 +1,2 @@
+from tpucfn.obs.metrics import MetricLogger, StepTimer  # noqa: F401
+from tpucfn.obs.profiler import profile_steps  # noqa: F401

@@ -1,0 +1,100 @@
+"""Metrics and structured logging.
+
+The reference's observability was stdout of training processes plus
+bootstrap logs scattered over ``/var/log`` on each instance (SURVEY.md §5
+metrics row). Here every host writes structured JSONL (machine-parseable,
+shippable to GCS) and rank 0 mirrors a human-readable line to stdout.
+Step-time and examples/sec/chip are first-class because they are the
+headline baseline metric (BASELINE.md: ResNet-50 images/sec/chip).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Any, Mapping
+
+import jax
+
+
+class StepTimer:
+    """Tracks step wall time and derives throughput.
+
+    Call :meth:`tick` once per completed (blocked-on) step. The first
+    ``warmup`` ticks are excluded from the running average — they contain
+    XLA compilation (SURVEY.md §7.4 item 6: don't let compile time pollute
+    the metric).
+    """
+
+    def __init__(self, warmup: int = 2):
+        self.warmup = warmup
+        self._count = 0
+        self._t0 = None
+        self._total = 0.0
+        self._last = None
+
+    def tick(self) -> float | None:
+        now = time.perf_counter()
+        dt = None if self._t0 is None else now - self._t0
+        self._t0 = now
+        if dt is not None:
+            self._count += 1
+            self._last = dt
+            if self._count > self.warmup:
+                self._total += dt
+        return dt
+
+    @property
+    def mean_step_time(self) -> float | None:
+        steady = self._count - self.warmup
+        return self._total / steady if steady > 0 else None
+
+    def throughput(self, items_per_step: int) -> float | None:
+        """items/sec over steady-state steps (e.g. global-batch images/sec)."""
+        mst = self.mean_step_time
+        return items_per_step / mst if mst else None
+
+    def per_chip_throughput(self, items_per_step: int) -> float | None:
+        tp = self.throughput(items_per_step)
+        return tp / jax.device_count() if tp else None
+
+
+class MetricLogger:
+    def __init__(
+        self,
+        log_dir: str | Path | None = None,
+        *,
+        stdout_every: int = 10,
+        name: str = "train",
+    ):
+        self.path = None
+        self._f = None
+        if log_dir is not None:
+            d = Path(log_dir)
+            d.mkdir(parents=True, exist_ok=True)
+            self.path = d / f"{name}-host{jax.process_index():03d}.jsonl"
+            self._f = open(self.path, "a", buffering=1)
+        self.stdout_every = stdout_every
+        self.name = name
+
+    def log(self, step: int, metrics: Mapping[str, Any]) -> None:
+        record = {"step": int(step), "time": time.time()}
+        for k, v in metrics.items():
+            try:
+                record[k] = float(v)
+            except (TypeError, ValueError):
+                record[k] = str(v)
+        if self._f is not None:
+            self._f.write(json.dumps(record) + "\n")
+        if jax.process_index() == 0 and self.stdout_every and step % self.stdout_every == 0:
+            body = " ".join(
+                f"{k}={v:.5g}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in record.items()
+                if k not in ("time",)
+            )
+            print(f"[{self.name}] {body}", flush=True)
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()

@@ -1,0 +1,31 @@
+"""Profiling hooks.
+
+The reference exposed no profiling story at all (delegated to nvprof/
+framework profilers, undocumented — SURVEY.md §5). tpucfn makes a step-
+range trace a launcher flag: traces capture XLA op timelines *and* ICI
+collective overlap, viewable in TensorBoard/XProf.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from pathlib import Path
+
+import jax
+
+
+@contextlib.contextmanager
+def profile_steps(log_dir: str | Path, *, enabled: bool = True):
+    """Trace everything inside the context into ``log_dir`` (one trace per
+    host). Use around a small steady-state step range, not the whole run —
+    the first steps are compilation."""
+    if not enabled:
+        yield
+        return
+    d = Path(log_dir)
+    d.mkdir(parents=True, exist_ok=True)
+    jax.profiler.start_trace(str(d))
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

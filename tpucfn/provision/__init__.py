@@ -1,0 +1,8 @@
+from tpucfn.provision.control_plane import (  # noqa: F401
+    ClusterState,
+    ControlPlane,
+    FakeControlPlane,
+    HostRecord,
+    ClusterRecord,
+)
+from tpucfn.provision.provisioner import Provisioner  # noqa: F401

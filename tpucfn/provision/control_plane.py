@@ -1,0 +1,143 @@
+"""TPU control-plane abstraction + fake implementation.
+
+The reference trusted the CloudFormation service as an untestable black
+box (SURVEY.md §4: "multi-node was only ever tested on real EC2"). Here
+the control plane is an interface so the whole provisioning state machine
+is exercised in CI against :class:`FakeControlPlane` — a deterministic,
+optionally-failing in-process implementation of the TPU queued-resource
+lifecycle:
+
+    QUEUED → PROVISIONING → ACTIVE → (DELETING → DELETED | FAILED)
+
+A real GCP/AWS-trn backend implements the same five methods against the
+cloud API; nothing above this module changes (SURVEY.md §5 failure-
+detection row and §7.2 step 4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import itertools
+from typing import Callable
+
+from tpucfn.spec import ClusterSpec
+
+
+class ClusterState(enum.Enum):
+    QUEUED = "QUEUED"
+    PROVISIONING = "PROVISIONING"
+    ACTIVE = "ACTIVE"
+    DELETING = "DELETING"
+    DELETED = "DELETED"
+    FAILED = "FAILED"
+
+
+@dataclasses.dataclass
+class HostRecord:
+    host_id: int
+    address: str  # ip:port the launcher reaches this host at
+    healthy: bool = True
+
+
+@dataclasses.dataclass
+class ClusterRecord:
+    spec: ClusterSpec
+    state: ClusterState
+    hosts: list[HostRecord]
+    generation: int = 0  # bumped on every (re)acquire — resume fencing
+    message: str = ""
+
+
+class ControlPlane:
+    """Interface; see FakeControlPlane for semantics."""
+
+    def create(self, spec: ClusterSpec) -> ClusterRecord:
+        raise NotImplementedError
+
+    def describe(self, name: str) -> ClusterRecord:
+        raise NotImplementedError
+
+    def delete(self, name: str) -> None:
+        raise NotImplementedError
+
+    def tick(self) -> None:
+        """Advance async state transitions (real backends poll instead)."""
+
+    def kill_host(self, name: str, host_id: int) -> None:
+        """Fault injection: mark a host dead (test-only on real backends)."""
+        raise NotImplementedError
+
+
+class FakeControlPlane(ControlPlane):
+    """Deterministic fake with scriptable latency and failures.
+
+    ``steps_to_provision`` QUEUED→ACTIVE ticks model queued-resource wait;
+    ``fail_after`` makes creation land in FAILED (capacity error);
+    ``kill_host`` flips a host unhealthy, which the Provisioner's monitor
+    must notice (SURVEY.md §5: ASG auto-replacement analogue — except a
+    TPU slice is atomic, so replacement = re-acquire the whole slice).
+    """
+
+    def __init__(self, *, steps_to_provision: int = 2, fail_creation: bool = False):
+        self.steps_to_provision = steps_to_provision
+        self.fail_creation = fail_creation
+        self._clusters: dict[str, ClusterRecord] = {}
+        self._pending: dict[str, int] = {}
+        self._gen = itertools.count(1)
+        self.events: list[tuple[str, str]] = []  # (cluster, event) audit log
+
+    # -- ControlPlane ----------------------------------------------------
+
+    def create(self, spec: ClusterSpec) -> ClusterRecord:
+        existing = self._clusters.get(spec.name)
+        if existing is not None and existing.state not in (
+            ClusterState.DELETED,
+            ClusterState.FAILED,
+        ):
+            raise ValueError(f"cluster {spec.name!r} already exists ({existing.state.value})")
+        rec = ClusterRecord(spec=spec, state=ClusterState.QUEUED, hosts=[],
+                            generation=next(self._gen))
+        self._clusters[spec.name] = rec
+        self._pending[spec.name] = self.steps_to_provision
+        self.events.append((spec.name, "create"))
+        return rec
+
+    def describe(self, name: str) -> ClusterRecord:
+        if name not in self._clusters:
+            raise KeyError(f"no cluster named {name!r}")
+        return self._clusters[name]
+
+    def delete(self, name: str) -> None:
+        rec = self.describe(name)
+        rec.state = ClusterState.DELETED
+        rec.hosts = []
+        self._pending.pop(name, None)
+        self.events.append((name, "delete"))
+
+    def tick(self) -> None:
+        for name, rec in self._clusters.items():
+            if rec.state in (ClusterState.QUEUED, ClusterState.PROVISIONING):
+                left = self._pending.get(name, 0) - 1
+                self._pending[name] = left
+                if left > 0:
+                    rec.state = ClusterState.PROVISIONING
+                elif self.fail_creation:
+                    rec.state = ClusterState.FAILED
+                    rec.message = "no capacity for requested topology"
+                    self.events.append((name, "failed"))
+                else:
+                    rec.state = ClusterState.ACTIVE
+                    rec.hosts = [
+                        HostRecord(host_id=i, address=f"10.0.0.{i + 1}:8471")
+                        for i in range(rec.spec.num_hosts)
+                    ]
+                    self.events.append((name, "active"))
+
+    def kill_host(self, name: str, host_id: int) -> None:
+        rec = self.describe(name)
+        rec.hosts[host_id].healthy = False
+        self.events.append((name, f"host{host_id}-died"))
+
+
+WaitCallback = Callable[[ClusterRecord], None]

@@ -1,0 +1,1 @@
+from tpucfn.spec.cluster import ClusterSpec, ACCELERATOR_TYPES, AcceleratorType  # noqa: F401

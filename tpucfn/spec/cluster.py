@@ -1,0 +1,132 @@
+"""Cluster specification — the analogue of ``cfn-template/deeplearning.template``.
+
+The reference declared its cluster as a CloudFormation JSON template with
+``Parameters`` (InstanceType, WorkerCount, KeyName, SSHLocation, ImageType)
+and stack ``Outputs`` (master DNS) — SURVEY.md §2.1 "Stack template". The
+TPU equivalent is a validated dataclass (serializable to/from JSON) whose
+fields map 1:1 onto the TPU VM provisioning surface:
+
+    CFN Parameter          →  ClusterSpec field
+    ---------------------     ----------------------------
+    InstanceType           →  accelerator ("v5e-8", "v4-32", …)
+    WorkerCount            →  derived: hosts of the slice topology
+    ImageType/AMI mapping  →  runtime_version
+    KeyName/SSHLocation    →  (not needed: TPU VM SSH is IAM-brokered)
+    EFS filesystem         →  storage_path (GCS bucket / shared dir)
+
+Unlike EC2 ASGs, a TPU slice is an atomic unit: you don't pick a worker
+count, you pick a topology and the host count follows from it. ``resize``
+therefore means "re-acquire a different slice and resume from checkpoint"
+(SURVEY.md §3.5, §7.4 item 2), which :mod:`tpucfn.provision` automates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Any
+
+from tpucfn.mesh import MeshSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class AcceleratorType:
+    """Static description of one slice SKU: chip generation + topology."""
+
+    name: str  # e.g. "v5e-8"
+    chips: int
+    hosts: int
+    chips_per_host: int
+    ici_topology: tuple[int, ...]  # physical torus shape
+
+    def default_mesh(self) -> MeshSpec:
+        return MeshSpec.for_devices(self.chips)
+
+
+def _sku(name: str, chips: int, hosts: int, topo: tuple[int, ...]) -> AcceleratorType:
+    return AcceleratorType(name, chips, hosts, chips // hosts, topo)
+
+
+# The region→AMI ``Mappings`` analogue: a registry of known slice shapes.
+# (Sizes per public TPU docs; cpu-N entries are the test/fake platform.)
+ACCELERATOR_TYPES: dict[str, AcceleratorType] = {
+    t.name: t
+    for t in [
+        _sku("v4-8", 4, 1, (2, 2, 1)),
+        _sku("v4-16", 8, 2, (2, 2, 2)),
+        _sku("v4-32", 16, 4, (2, 2, 4)),  # BASELINE config 2 target
+        _sku("v4-64", 32, 8, (2, 4, 4)),
+        _sku("v5e-4", 4, 1, (2, 2)),
+        _sku("v5e-8", 8, 1, (2, 4)),
+        _sku("v5e-16", 16, 4, (4, 4)),
+        _sku("v5e-64", 64, 16, (8, 8)),
+        _sku("v5p-8", 4, 1, (2, 2, 1)),
+        _sku("v5p-16", 8, 2, (2, 2, 2)),
+        _sku("v5p-64", 32, 8, (2, 4, 4)),  # BASELINE config 4 target
+        _sku("v5p-128", 64, 16, (4, 4, 4)),
+        # Fake/test platform: N virtual CPU devices on one host.
+        _sku("cpu-1", 1, 1, (1,)),
+        _sku("cpu-8", 8, 1, (8,)),
+    ]
+}
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9-]{0,61}[a-z0-9]$")
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterSpec:
+    name: str
+    accelerator: str = "v5e-8"
+    runtime_version: str = "tpu-ubuntu2204-base"
+    storage_path: str = ""  # shared storage root (≈ the EFS mount)
+    zone: str = "us-central2-b"
+    preemptible: bool = False
+    env: tuple[tuple[str, str], ...] = ()  # extra env for every host
+
+    def __post_init__(self):
+        if not _NAME_RE.match(self.name):
+            raise ValueError(
+                f"cluster name {self.name!r} must be lowercase RFC-1035-ish "
+                "(letters, digits, hyphens)"
+            )
+        if self.accelerator not in ACCELERATOR_TYPES:
+            known = ", ".join(sorted(ACCELERATOR_TYPES))
+            raise ValueError(f"unknown accelerator {self.accelerator!r}; known: {known}")
+
+    @property
+    def sku(self) -> AcceleratorType:
+        return ACCELERATOR_TYPES[self.accelerator]
+
+    @property
+    def num_hosts(self) -> int:
+        return self.sku.hosts
+
+    @property
+    def num_chips(self) -> int:
+        return self.sku.chips
+
+    # ---- serialization (the "template file" form) ----------------------
+
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        d["env"] = dict(self.env)
+        return json.dumps(d, indent=2, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "ClusterSpec":
+        d: dict[str, Any] = json.loads(text)
+        unknown = set(d) - {f.name for f in dataclasses.fields(cls)}
+        if unknown:
+            raise ValueError(f"unknown ClusterSpec fields: {sorted(unknown)}")
+        if "env" in d:
+            d["env"] = tuple(sorted(d["env"].items()))
+        return cls(**d)
+
+    @classmethod
+    def load(cls, path: str | Path) -> "ClusterSpec":
+        return cls.from_json(Path(path).read_text())
+
+    def save(self, path: str | Path) -> None:
+        Path(path).write_text(self.to_json() + "\n")
