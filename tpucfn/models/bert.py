@@ -1,0 +1,133 @@
+"""BERT-base encoder + MLM pretraining head (BASELINE config 3:
+"BERT-base pretraining, Horovod→JAX launcher, all-reduce over ICI").
+
+Classic post-LayerNorm BERT architecture (learned positions, GELU MLP,
+tied-shape untied-weight MLM head). Param naming (q_proj/…/o_proj,
+fc1/fc2) matches the transformer sharding presets, so the same TP×FSDP
+rules drive it. bf16 compute / fp32 params; fp32 softmax and LayerNorm.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tpucfn.ops.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    ffn_dim: int = 3072
+    max_positions: int = 512
+    type_vocab: int = 2
+    dropout: float = 0.1
+    norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @classmethod
+    def base(cls) -> "BertConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "BertConfig":
+        return cls(vocab_size=128, dim=32, n_layers=2, n_heads=2, ffn_dim=64,
+                   max_positions=64, dropout=0.0, dtype=jnp.float32)
+
+
+class BertLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attn_mask, *, train: bool):
+        cfg = self.cfg
+        b, s, _ = x.shape
+        dense = lambda feat, name: nn.DenseGeneral(  # noqa: E731
+            feat, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name
+        )
+        ln = lambda name: nn.LayerNorm(  # noqa: E731
+            epsilon=cfg.norm_eps, dtype=jnp.float32, param_dtype=cfg.param_dtype,
+            name=name,
+        )
+        drop = nn.Dropout(cfg.dropout, deterministic=not train)
+
+        q = dense(cfg.dim, "q_proj")(x).reshape(b, s, cfg.n_heads, cfg.head_dim)
+        k = dense(cfg.dim, "k_proj")(x).reshape(b, s, cfg.n_heads, cfg.head_dim)
+        v = dense(cfg.dim, "v_proj")(x).reshape(b, s, cfg.n_heads, cfg.head_dim)
+        attn = dot_product_attention(q, k, v, causal=False,
+                                     mask=attn_mask[:, None, None, :])
+        attn = attn.reshape(b, s, cfg.dim)
+        x = ln("attn_norm")((x + drop(dense(cfg.dim, "o_proj")(attn))).astype(jnp.float32))
+        x = x.astype(cfg.dtype)
+
+        h = nn.gelu(dense(cfg.ffn_dim, "fc1")(x))
+        x = ln("mlp_norm")((x + drop(dense(cfg.dim, "fc2")(h))).astype(jnp.float32))
+        return x.astype(cfg.dtype)
+
+
+class Bert(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens, *, token_types=None, attn_mask=None, train: bool = False):
+        """tokens: (B, S) → MLM logits (B, S, vocab) fp32."""
+        cfg = self.cfg
+        b, s = tokens.shape
+        if attn_mask is None:
+            attn_mask = jnp.ones((b, s), bool)
+        if token_types is None:
+            token_types = jnp.zeros((b, s), jnp.int32)
+
+        embed = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="embed_tokens")
+        x = embed(tokens)
+        x = x + nn.Embed(cfg.max_positions, cfg.dim, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="embed_positions")(
+            jnp.arange(s)[None, :]
+        )
+        x = x + nn.Embed(cfg.type_vocab, cfg.dim, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="embed_types")(token_types)
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
+                         param_dtype=cfg.param_dtype, name="embed_norm")(
+            x.astype(jnp.float32)
+        ).astype(cfg.dtype)
+        x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
+
+        for i in range(cfg.n_layers):
+            x = BertLayer(cfg, name=f"layers_{i}")(x, attn_mask, train=train)
+
+        # MLM head: transform + vocab projection.
+        h = nn.gelu(nn.DenseGeneral(cfg.dim, dtype=cfg.dtype,
+                                    param_dtype=cfg.param_dtype, name="mlm_transform")(x))
+        h = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
+                         param_dtype=cfg.param_dtype, name="mlm_norm")(
+            h.astype(jnp.float32)
+        )
+        logits = nn.DenseGeneral(cfg.vocab_size, dtype=jnp.float32,
+                                 param_dtype=cfg.param_dtype, name="lm_head")(h)
+        return logits
+
+
+def mlm_loss(logits: jax.Array, labels: jax.Array, mask: jax.Array):
+    """Masked-LM loss over positions where ``mask`` is True.
+
+    labels: (B, S) original token ids; mask: (B, S) bool of masked slots."""
+    import optax
+
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    denom = jnp.maximum(mask.sum(), 1)
+    loss = jnp.where(mask, per_tok, 0.0).sum() / denom
+    acc = jnp.where(mask, jnp.argmax(logits, -1) == labels, False).sum() / denom
+    return loss, acc

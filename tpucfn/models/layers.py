@@ -1,0 +1,109 @@
+"""Shared transformer building blocks.
+
+Module/param names follow the conventions the sharding-rule presets match
+(tpucfn/parallel/presets.py): q_proj/k_proj/v_proj/o_proj, gate_proj/
+up_proj/down_proj, embed_tokens, lm_head. bf16 compute / fp32 params
+throughout (MXU-native mixed precision).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tpucfn.ops.attention import dot_product_attention
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (norm * scale).astype(self.dtype)
+
+
+def rope_frequencies(dim: int, max_pos: int, theta: float) -> tuple[jax.Array, jax.Array]:
+    """Precompute RoPE cos/sin tables: (max_pos, dim//2), fp32."""
+    inv = 1.0 / (theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    t = jnp.arange(max_pos, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
+               positions: jax.Array) -> jax.Array:
+    """x: (B, S, H, D); positions: (B, S) or (S,) global token positions."""
+    c = cos[positions]  # (..., S, D/2)
+    s = sin[positions]
+    if c.ndim == 2:  # (S, D/2) -> broadcast batch
+        c, s = c[None], s[None]
+    c, s = c[:, :, None, :], s[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)
+
+
+# attention_fn(q, k, v, causal=..., q_offset=..., k_offset=...) -> out
+AttentionFn = Callable[..., jax.Array]
+
+
+class CausalSelfAttention(nn.Module):
+    """GQA self-attention with RoPE; the attention inner op is pluggable so
+    dense/flash/ring implementations swap without touching the module."""
+
+    n_heads: int
+    n_kv_heads: int
+    head_dim: int
+    rope_theta: float = 500000.0
+    max_seq: int = 8192
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    attention_fn: AttentionFn = dot_product_attention
+
+    @nn.compact
+    def __call__(self, x, *, positions=None, q_offset=0):
+        b, s, _ = x.shape
+        dense = lambda feat, name: nn.DenseGeneral(  # noqa: E731
+            feat, axis=-1, use_bias=False, dtype=self.dtype,
+            param_dtype=self.param_dtype, name=name,
+        )
+        q = dense(self.n_heads * self.head_dim, "q_proj")(x)
+        k = dense(self.n_kv_heads * self.head_dim, "k_proj")(x)
+        v = dense(self.n_kv_heads * self.head_dim, "v_proj")(x)
+        q = q.reshape(b, s, self.n_heads, self.head_dim)
+        k = k.reshape(b, s, self.n_kv_heads, self.head_dim)
+        v = v.reshape(b, s, self.n_kv_heads, self.head_dim)
+
+        if positions is None:
+            positions = jnp.arange(s) + q_offset
+        cos, sin = rope_frequencies(self.head_dim, self.max_seq, self.rope_theta)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+
+        out = self.attention_fn(q, k, v, causal=True,
+                                q_offset=q_offset, k_offset=q_offset)
+        out = out.reshape(b, s, self.n_heads * self.head_dim)
+        return dense(x.shape[-1], "o_proj")(out)
+
+
+class SwiGLUMLP(nn.Module):
+    ffn_dim: int
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        dense = lambda feat, name: nn.DenseGeneral(  # noqa: E731
+            feat, use_bias=False, dtype=self.dtype,
+            param_dtype=self.param_dtype, name=name,
+        )
+        gate = nn.silu(dense(self.ffn_dim, "gate_proj")(x))
+        up = dense(self.ffn_dim, "up_proj")(x)
+        return dense(x.shape[-1], "down_proj")(gate * up)

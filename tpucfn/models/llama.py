@@ -1,0 +1,178 @@
+"""Llama-3-family decoder — the flagship model (BASELINE config 4:
+"Llama-3 8B FSDP-style param sharding on v5p-64").
+
+Architecture (public Llama-3 recipe): RMSNorm pre-norm, GQA attention with
+RoPE (theta 500k), SwiGLU MLP, untied LM head. TPU-first choices:
+
+* layers run under ``nn.scan`` + ``nn.remat`` — one compiled block body
+  regardless of depth (compile time O(1) in layers) and activation
+  rematerialization to trade MXU flops for HBM (the standard TPU memory
+  recipe). Scanned params carry a leading layer axis; ``sharding_rules``
+  accounts for it.
+* bf16 activations, fp32 params/optimizer, fp32 logits for the softmax.
+* attention inner op is pluggable (dense XLA / Pallas flash / ring SP).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tpucfn.mesh import AXIS_FSDP, AXIS_TENSOR
+from tpucfn.models.layers import (
+    AttentionFn,
+    CausalSelfAttention,
+    RMSNorm,
+    SwiGLUMLP,
+)
+from tpucfn.ops.attention import dot_product_attention
+from tpucfn.parallel.sharding import ShardingRules
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    max_seq: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @classmethod
+    def llama3_8b(cls) -> "LlamaConfig":
+        return cls()  # the defaults above are the 8B shape
+
+    @classmethod
+    def llama3_1b(cls) -> "LlamaConfig":
+        # ~1B proxy for single-chip benchmarking.
+        return cls(dim=2048, n_layers=16, n_heads=32, n_kv_heads=8, ffn_dim=8192)
+
+    @classmethod
+    def tiny(cls, vocab: int = 256) -> "LlamaConfig":
+        return cls(vocab_size=vocab, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                   ffn_dim=128, max_seq=512, dtype=jnp.float32)
+
+
+class LlamaBlock(nn.Module):
+    """One decoder block. ``__call__`` uses scan's (carry, _) -> (carry, None)
+    shape so the same body works unrolled and under ``nn.scan``; q_offset
+    rides in the carry because it can be a traced value (ring/SP shards
+    derive it from ``lax.axis_index``)."""
+
+    cfg: LlamaConfig
+    attention_fn: AttentionFn = dot_product_attention
+
+    @nn.compact
+    def __call__(self, carry, _=None):
+        x, q_offset = carry
+        cfg = self.cfg
+        h = CausalSelfAttention(
+            n_heads=cfg.n_heads, n_kv_heads=cfg.n_kv_heads, head_dim=cfg.head_dim,
+            rope_theta=cfg.rope_theta, max_seq=cfg.max_seq, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, attention_fn=self.attention_fn,
+            name="attn",
+        )(RMSNorm(cfg.norm_eps, cfg.dtype, name="input_norm")(x), q_offset=q_offset)
+        x = x + h
+        h = SwiGLUMLP(cfg.ffn_dim, cfg.dtype, cfg.param_dtype, name="mlp")(
+            RMSNorm(cfg.norm_eps, cfg.dtype, name="post_attn_norm")(x)
+        )
+        return (x + h, q_offset), None
+
+
+class Llama(nn.Module):
+    cfg: LlamaConfig
+    attention_fn: AttentionFn = dot_product_attention
+
+    @nn.compact
+    def __call__(self, tokens, *, q_offset=0):
+        """tokens: (B, S) int32 → logits (B, S, vocab) fp32.
+
+        ``q_offset`` is the global position of tokens[:, 0] — nonzero when
+        the sequence axis is sharded (ring attention / SP).
+        """
+        cfg = self.cfg
+        x = nn.Embed(
+            cfg.vocab_size, cfg.dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name="embed_tokens",
+        )(tokens)
+
+        block = LlamaBlock
+        if cfg.remat:
+            block = nn.remat(block, prevent_cse=False)
+        carry = (x, jnp.asarray(q_offset))
+        if cfg.scan_layers:
+            carry, _ = nn.scan(
+                block,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, self.attention_fn, name="layers")(carry)
+        else:
+            for i in range(cfg.n_layers):
+                carry, _ = block(cfg, self.attention_fn, name=f"layers_{i}")(carry)
+        x = carry[0]
+
+        x = RMSNorm(cfg.norm_eps, cfg.dtype, name="final_norm")(x)
+        logits = nn.DenseGeneral(
+            cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+            param_dtype=cfg.param_dtype, name="lm_head",
+        )(x.astype(jnp.float32))
+        return logits
+
+
+def sharding_rules(cfg: LlamaConfig, *, fsdp: bool = True, tensor: bool = True) -> ShardingRules:
+    """Megatron TP × FSDP rules for the Llama param tree.
+
+    Scanned layers stack params with a leading ``layers`` axis, so every
+    spec under ``layers/`` starts with None (never shard the depth axis).
+    """
+    t = AXIS_TENSOR if tensor else None
+    f = AXIS_FSDP if fsdp else None
+    lead = (None,) if cfg.scan_layers else ()
+
+    def spec(*axes):
+        return P(*(lead + axes))
+
+    return ShardingRules((
+        (r"(q_proj|k_proj|v_proj)/kernel$", spec(f, t)),
+        (r"o_proj/kernel$", spec(t, f)),
+        (r"(gate_proj|up_proj)/kernel$", spec(f, t)),
+        (r"down_proj/kernel$", spec(t, f)),
+        (r"(input_norm|post_attn_norm)/scale$", spec()),
+        (r"embed_tokens/embedding$", P(t, f)),
+        (r"lm_head/kernel$", P(f, t)),
+        (r".*", P()),
+    ))
+
+
+def causal_lm_loss(logits: jax.Array, tokens: jax.Array,
+                   *, z_loss: float = 0.0) -> tuple[jax.Array, jax.Array]:
+    """Next-token cross entropy (mean over B, S-1) + optional z-loss.
+
+    Returns (loss, accuracy)."""
+    import optax
+
+    targets = tokens[:, 1:]
+    pred = logits[:, :-1]
+    ce = optax.softmax_cross_entropy_with_integer_labels(pred, targets).mean()
+    if z_loss:
+        ce = ce + z_loss * jnp.mean(jax.nn.logsumexp(pred, axis=-1) ** 2)
+    acc = jnp.mean(jnp.argmax(pred, -1) == targets)
+    return ce, acc

@@ -1,0 +1,1 @@
+from tpucfn.ops.attention import dot_product_attention  # noqa: F401

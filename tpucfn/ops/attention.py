@@ -1,0 +1,69 @@
+"""Attention numerics — the reference implementation every kernel is
+tested against.
+
+The reference never owned attention math (it launched MXNet/TF scripts);
+BASELINE configs 3-4 (BERT, Llama) make it the hot op here. This module is
+the straightforward XLA path: one batched matmul pair the MXU loves, fp32
+softmax for bf16 stability. The Pallas flash/ring kernels in
+:mod:`tpucfn.kernels` must match it to tolerance (SURVEY.md §7.4 item 3).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
+    """GQA: expand KV heads to match query heads. (B, S, Hkv, D) -> (B, S, Hkv*n_rep, D)."""
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(
+        b, s, h * n_rep, d
+    )
+
+
+def dot_product_attention(
+    q: jax.Array,  # (B, Sq, Hq, D)
+    k: jax.Array,  # (B, Sk, Hkv, D)
+    v: jax.Array,  # (B, Sk, Hkv, D)
+    *,
+    causal: bool = True,
+    mask: jax.Array | None = None,  # broadcastable to (B, Hq, Sq, Sk); True = attend
+    q_offset: int | jax.Array = 0,  # global position of q[0] (ring/SP shards)
+    k_offset: int | jax.Array = 0,
+) -> jax.Array:
+    """Returns (B, Sq, Hq, D). Softmax in fp32 regardless of input dtype.
+
+    ``q_offset``/``k_offset`` place local shards on the global sequence
+    axis so the same causal math serves full attention and ring-attention
+    blocks.
+    """
+    orig_dtype = q.dtype
+    hq, hkv = q.shape[2], k.shape[2]
+    if hq % hkv:
+        raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
+    k = _repeat_kv(k, hq // hkv)
+    v = _repeat_kv(v, hq // hkv)
+
+    scale = q.shape[-1] ** -0.5
+    # (B, H, Sq, Sk)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        qpos = jnp.arange(sq)[:, None] + q_offset
+        kpos = jnp.arange(sk)[None, :] + k_offset
+        causal_mask = qpos >= kpos
+        logits = jnp.where(causal_mask[None, None], logits, -jnp.inf)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -jnp.inf)
+
+    # Rows that attend to nothing (possible in ring blocks) softmax to 0.
+    probs = jax.nn.softmax(logits, axis=-1, where=jnp.isfinite(logits))
+    probs = jnp.nan_to_num(probs)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(orig_dtype)
