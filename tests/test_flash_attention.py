@@ -1,0 +1,99 @@
+"""Flash kernel vs the dense reference — forward and gradients, causal and
+not, GQA, offsets. Runs in Pallas interpret mode on CPU (same kernel code
+path the TPU compiles)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpucfn.kernels import flash_attention
+from tpucfn.ops.attention import dot_product_attention
+
+
+def _qkv(b=2, sq=64, sk=64, hq=4, hkv=4, d=32, seed=0):
+    rng = jax.random.key(seed)
+    q = jax.random.normal(jax.random.fold_in(rng, 0), (b, sq, hq, d))
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (b, sk, hkv, d))
+    v = jax.random.normal(jax.random.fold_in(rng, 2), (b, sk, hkv, d))
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_forward_matches_dense(causal):
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    ref = dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_forward_gqa():
+    q, k, v = _qkv(hq=8, hkv=2)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_forward_offsets():
+    q, k, v = _qkv(sq=32, sk=64)
+    out = flash_attention(q, k, v, causal=True, q_offset=32, interpret=True)
+    ref = dot_product_attention(q, k, v, causal=True, q_offset=32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_fully_masked_is_zero():
+    q, k, v = _qkv(sq=32, sk=32)
+    out = flash_attention(q, k, v, causal=True, k_offset=1000, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-6)
+
+
+def test_non_128_blocks():
+    # S=48 forces _pick_block to a non-power block that still tiles S
+    q, k, v = _qkv(sq=48, sk=48, d=16)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_gradients_match_dense(causal):
+    q, k, v = _qkv(sq=32, sk=32, d=16)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal, interpret=True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=causal) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_gradients_gqa():
+    q, k, v = _qkv(sq=32, sk=32, hq=4, hkv=2, d=16)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, interpret=True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gd, "qkv"):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_bf16_forward_close():
+    q, k, v = _qkv()
+    out = flash_attention(q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+                          v.astype(jnp.bfloat16), causal=True, interpret=True)
+    ref = dot_product_attention(q, k, v, causal=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               atol=3e-2)

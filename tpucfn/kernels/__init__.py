@@ -1,0 +1,3 @@
+from tpucfn.kernels.flash_attention import flash_attention  # noqa: F401
+from tpucfn.kernels.ring_attention import make_ring_attention, ring_attention  # noqa: F401
+from tpucfn.kernels.ulysses import make_ulysses_attention  # noqa: F401

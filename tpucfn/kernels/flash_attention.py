@@ -1,0 +1,334 @@
+"""FlashAttention-2 for TPU in Pallas: fused blockwise attention.
+
+The memory-bound op the reference delegated to cuDNN gets a TPU-native
+kernel: O(S·D) memory instead of O(S²) — logits never leave VMEM, online
+softmax streams KV blocks through the MXU (pallas_guide.md blockwise
+pattern). Forward emits (O, LSE); backward is two more Pallas kernels
+(dQ; dK/dV) in the FlashAttention-2 formulation wired through
+``jax.custom_vjp``.
+
+Causal masking takes global ``q_offset``/``k_offset`` so the same kernel
+serves full attention and one ring-attention hop (SURVEY.md §2.3 "Ring
+attention"). GQA reads each KV head once in the forward via BlockSpec
+index maps; the backward repeats KV to query-head count and reduces, which
+is simpler than multi-visit output accumulation and off the memory-peak
+path.
+
+Layout: (B, H, S, D) inside the kernels — S×D trailing tiles are what the
+MXU wants. The public wrapper takes the framework-standard (B, S, H, D).
+
+Interpret mode (``interpret=True``) runs the same kernels on CPU for CI;
+tests compare against :func:`tpucfn.ops.attention.dot_product_attention`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # mask value; finite so max/exp never see nan-producing -inf
+LANES = 128  # m/l scratch lane width (TPU tiling)
+
+
+def _pick_block(s: int, target: int = 128) -> int:
+    """Largest divisor of ``s`` that is ≤ target (block shapes must tile S)."""
+    b = min(s, target)
+    while s % b:
+        b -= 1
+    return b
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale, causal, block_q, block_k, q_offset, k_offset):
+    ki = pl.program_id(3)
+    qi = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32)  # (BQ, D)
+    k = k_ref[0, 0].astype(jnp.float32)  # (BK, D)
+    v = v_ref[0, 0].astype(jnp.float32)  # (BK, D)
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale  # (BQ, BK)
+
+    if causal:
+        qpos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kpos = k_offset + ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+
+    m_prev = m_ref[:, 0]  # (BQ,)
+    m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+    # Explicitly zero masked entries so fully-masked rows give l == 0
+    # rather than a junk uniform softmax.
+    p = jnp.where(s > NEG_INF / 2, jnp.exp(s - m_cur[:, None]), 0.0)  # (BQ, BK)
+    alpha = jnp.where(m_prev > NEG_INF / 2, jnp.exp(m_prev - m_cur), 0.0)
+
+    l_ref[:] = (l_ref[:, 0] * alpha + jnp.sum(p, axis=-1))[:, None] * jnp.ones(
+        (1, LANES), jnp.float32)
+    acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot(
+        p, v, preferred_element_type=jnp.float32)
+    m_ref[:] = m_cur[:, None] * jnp.ones((1, LANES), jnp.float32)
+
+    @pl.when(ki == pl.num_programs(3) - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        safe_l = jnp.where(l > 0, l, 1.0)
+        o_ref[0, 0] = (acc_ref[:] / safe_l[:, None]).astype(o_ref.dtype)
+        lse = jnp.where(l > 0, m_ref[:, 0] + jnp.log(safe_l), NEG_INF)
+        lse_ref[0, 0] = lse[:, None] * jnp.ones((1, LANES), jnp.float32)
+
+
+def _flash_fwd(q, k, v, *, causal, q_offset, k_offset, interpret):
+    """q: (B, H, SQ, D); k/v: (B, HKV, SK, D) → (o, lse[B,H,SQ,LANES])."""
+    b, h, sq, d = q.shape
+    hkv, sk = k.shape[1], k.shape[2]
+    rep = h // hkv
+    block_q = _pick_block(sq)
+    block_k = _pick_block(sk)
+    scale = d ** -0.5
+
+    grid = (b, h, sq // block_q, sk // block_k)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, q_offset=q_offset, k_offset=k_offset,
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki, rep=rep: (bi, hi // rep, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki, rep=rep: (bi, hi // rep, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, LANES), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# --------------------------------------------------------------------------
+# backward
+# --------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, scale, causal, block_q, block_k, q_offset, k_offset):
+    ki = pl.program_id(3)
+    qi = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q = q_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, 0]      # (BQ,)
+    delta = delta_ref[0, 0][:, 0]  # (BQ,)
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kpos = k_offset + ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+
+    p = jnp.where(s > NEG_INF / 2, jnp.exp(s - lse[:, None]), 0.0)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None]) * scale
+    dq_acc[:] += jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == pl.num_programs(3) - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale, causal, block_q, block_k, q_offset, k_offset):
+    qi = pl.program_id(3)
+    ki = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q = q_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, 0]
+    delta = delta_ref[0, 0][:, 0]
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kpos = k_offset + ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+
+    p = jnp.where(s > NEG_INF / 2, jnp.exp(s - lse[:, None]), 0.0)  # (BQ, BK)
+    dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None]) * scale  # (BQ, BK)
+    dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+
+    @pl.when(qi == pl.num_programs(3) - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, *, causal, q_offset, k_offset, interpret):
+    """All inputs (B, H, S, D) with KV already repeated to H query heads."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = _pick_block(sq)
+    block_k = _pick_block(sk)
+    scale = d ** -0.5
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = delta[..., None] * jnp.ones((1, LANES), jnp.float32)  # (B,H,SQ,LANES)
+
+    qspec = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+    kspec = pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0))
+    qrow = pl.BlockSpec((1, 1, block_q, LANES), lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          q_offset=q_offset, k_offset=k_offset),
+        grid=(b, h, sq // block_q, sk // block_k),
+        in_specs=[qspec, kspec, kspec, qspec, qrow, qrow],
+        out_specs=[qspec],
+        out_shape=[jax.ShapeDtypeStruct((b, h, sq, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)[0]
+
+    # dk/dv: grid swaps loop order (KV blocks outer, Q blocks inner).
+    qspec2 = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0))
+    kspec2 = pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0))
+    qrow2 = pl.BlockSpec((1, 1, block_q, LANES), lambda bi, hi, ki, qi: (bi, hi, qi, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          q_offset=q_offset, k_offset=k_offset),
+        grid=(b, h, sk // block_k, sq // block_q),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, qrow2, qrow2],
+        out_specs=[kspec2, kspec2],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
+# public API with custom VJP
+# --------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, q_offset, k_offset, interpret):
+    o, _ = _flash_fwd(q, k, v, causal=causal, q_offset=q_offset,
+                      k_offset=k_offset, interpret=interpret)
+    return o
+
+
+def _flash_fwd_rule(q, k, v, causal, q_offset, k_offset, interpret):
+    o, lse = _flash_fwd(q, k, v, causal=causal, q_offset=q_offset,
+                        k_offset=k_offset, interpret=interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd_rule(causal, q_offset, k_offset, interpret, res, do):
+    q, k, v, o, lse = res
+    h, hkv = q.shape[1], k.shape[1]
+    rep = h // hkv
+    k_rep = jnp.repeat(k, rep, axis=1) if rep > 1 else k
+    v_rep = jnp.repeat(v, rep, axis=1) if rep > 1 else v
+    dq, dk, dv = _flash_bwd(q, k_rep, v_rep, o, lse, do, causal=causal,
+                            q_offset=q_offset, k_offset=k_offset,
+                            interpret=interpret)
+    if rep > 1:
+        b, _, sk, d = dk.shape
+        dk = dk.reshape(b, hkv, rep, sk, d).sum(axis=2)
+        dv = dv.reshape(b, hkv, rep, sk, d).sum(axis=2)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(
+    q: jax.Array,  # (B, SQ, H, D) — framework-standard layout
+    k: jax.Array,  # (B, SK, HKV, D)
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    mask: jax.Array | None = None,
+    q_offset: int = 0,
+    k_offset: int = 0,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Drop-in replacement for
+    :func:`tpucfn.ops.attention.dot_product_attention` (dense boolean masks
+    are not supported — use causal/offsets; that covers the LM families).
+    """
+    if mask is not None:
+        raise NotImplementedError("flash_attention supports causal masking only")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    o = _flash(qt, kt, vt, causal, int(q_offset), int(k_offset), interpret)
+    return jnp.swapaxes(o, 1, 2)

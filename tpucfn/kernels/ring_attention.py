@@ -1,0 +1,102 @@
+"""Ring attention — causal attention over a context-sharded sequence.
+
+Long-context sequence parallelism (SURVEY.md §2.3/§5): the sequence axis
+is sharded over the ``context`` mesh axis; each device keeps its Q shard
+resident and the K/V shards rotate around the ICI ring (``ppermute``), one
+neighbor hop per step. Per-hop partial results merge with the online-
+softmax rule via log-sum-exp, so the result is *exactly* full causal
+attention — memory per device is O(S/N · S/N) for the hop logits instead
+of O(S²), and each hop's ppermute overlaps the previous hop's compute
+under XLA's async collectives.
+
+Causal structure makes hops cheap: a hop whose KV source is entirely in
+the future contributes nothing (its rows come back fully masked and the
+merge is a no-op); the framework still runs the hop to keep the ring
+schedule uniform — the bytes moved, not the flops, bound this op.
+
+Built on :func:`dot_product_attention_with_lse` blocks, so it is
+differentiable by construction (XLA autodiffs through psum/ppermute).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpucfn.mesh import AXIS_CONTEXT, AXIS_TENSOR, BATCH_AXES
+from tpucfn.ops.attention import NEG_INF, dot_product_attention_with_lse
+
+
+def _merge(o, lse, blk_o, blk_lse):
+    """Online-softmax combine of two partial attention results."""
+    new_lse = jnp.logaddexp(lse, blk_lse)
+    # empty ∪ empty stays empty; guard the exp against NEG_INF - NEG_INF
+    w_old = jnp.where(lse > NEG_INF / 2, jnp.exp(lse - new_lse), 0.0)
+    w_new = jnp.where(blk_lse > NEG_INF / 2, jnp.exp(blk_lse - new_lse), 0.0)
+    o = o * w_old[..., None] + blk_o.astype(jnp.float32) * w_new[..., None]
+    return o, new_lse
+
+
+def ring_attention(
+    q: jax.Array,  # local shard (B, S_loc, H_loc, D) — call inside shard_map
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis: str = AXIS_CONTEXT,
+    causal: bool = True,
+) -> jax.Array:
+    """Per-shard ring attention body. Requires an active ``axis`` context
+    (shard_map); sequence shards must be equal-sized and in axis order."""
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    sq, sk = q.shape[1], k.shape[1]
+    q_off = idx * sq
+
+    o = jnp.zeros(q.shape, jnp.float32)
+    lse = jnp.full(q.shape[:3], NEG_INF, jnp.float32)  # (B, S_loc, H)
+
+    kk, vv = k, v
+    for step in range(n):
+        src = (idx - step) % n  # whose KV shard we hold this hop
+        blk_o, blk_lse = dot_product_attention_with_lse(
+            q, kk, vv, causal=causal, q_offset=q_off, k_offset=src * sk
+        )
+        o, lse = _merge(o, lse, blk_o, blk_lse)
+        if step < n - 1:
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            kk = lax.ppermute(kk, axis, perm)
+            vv = lax.ppermute(vv, axis, perm)
+    return o.astype(q.dtype)
+
+
+def make_ring_attention(
+    mesh: Mesh,
+    *,
+    seq_axis: str = AXIS_CONTEXT,
+    heads_axis: str | None = AXIS_TENSOR,
+    batch_axes: Sequence[str] = BATCH_AXES,
+):
+    """AttentionFn for the model layer: global (B, S, H, D) arrays in, ring
+    attention over the context axis inside. Plugs into
+    ``CausalSelfAttention(attention_fn=...)`` — the model stays identical;
+    only the attention inner op changes (SURVEY.md §5 long-context row).
+    """
+    spec = P(tuple(batch_axes), seq_axis, heads_axis)
+
+    def attention_fn(q, k, v, *, causal=True, mask=None, q_offset=0, k_offset=0):
+        if mask is not None:
+            raise NotImplementedError("ring attention is causal-only")
+        fn = jax.shard_map(
+            lambda q_, k_, v_: ring_attention(q_, k_, v_, axis=seq_axis, causal=causal),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+        return fn(q, k, v)
+
+    return attention_fn

@@ -1,0 +1,61 @@
+"""Ulysses-style sequence parallelism: all-to-all head scatter.
+
+The alternative SP mode (SURVEY.md §2.3 "Ulysses"): instead of rotating
+KV around a ring, one all-to-all swaps the sharded axis — sequence-sharded
+activations become head-sharded just for the attention op, each device
+computes *full-sequence* attention for its subset of heads, and a second
+all-to-all swaps back. Two collectives per attention total; on the ICI
+torus an all-to-all is cheap, and the attention math itself needs no
+modification (any inner impl works on the gathered sequence).
+
+Constraint: kv heads must be divisible by the context-axis size (heads
+are the unit being scattered).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpucfn.mesh import AXIS_CONTEXT, AXIS_TENSOR, BATCH_AXES
+from tpucfn.ops.attention import dot_product_attention
+
+
+def make_ulysses_attention(
+    mesh: Mesh,
+    *,
+    seq_axis: str = AXIS_CONTEXT,
+    heads_axis: str | None = AXIS_TENSOR,
+    batch_axes: Sequence[str] = BATCH_AXES,
+    inner: Callable = dot_product_attention,
+):
+    spec = P(tuple(batch_axes), seq_axis, heads_axis)
+
+    def attention_fn(q, k, v, *, causal=True, mask=None, q_offset=0, k_offset=0):
+        if mask is not None:
+            raise NotImplementedError("ulysses attention is causal-only here")
+
+        def body(q_, k_, v_):
+            n = lax.axis_size(seq_axis)
+            if q_.shape[2] % n or k_.shape[2] % n:
+                raise ValueError(
+                    f"heads {q_.shape[2]}/{k_.shape[2]} not divisible by "
+                    f"context axis {n} — use ring attention instead"
+                )
+            # (B, S/n, H, D) -> (B, S, H/n, D): scatter heads, gather seq
+            a2a = lambda x: lax.all_to_all(  # noqa: E731
+                x, seq_axis, split_axis=2, concat_axis=1, tiled=True
+            )
+            out = inner(a2a(q_), a2a(k_), a2a(v_), causal=causal)
+            # (B, S, H/n, D) -> (B, S/n, H, D)
+            return lax.all_to_all(out, seq_axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+        fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                           out_specs=spec, check_vma=False)
+        return fn(q, k, v)
+
+    return attention_fn

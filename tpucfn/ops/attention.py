@@ -24,7 +24,10 @@ def _repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
     )
 
 
-def dot_product_attention(
+NEG_INF = -1e30  # finite mask value: keeps max/exp nan-free for empty rows
+
+
+def dot_product_attention_with_lse(
     q: jax.Array,  # (B, Sq, Hq, D)
     k: jax.Array,  # (B, Sk, Hkv, D)
     v: jax.Array,  # (B, Sk, Hkv, D)
@@ -33,12 +36,12 @@ def dot_product_attention(
     mask: jax.Array | None = None,  # broadcastable to (B, Hq, Sq, Sk); True = attend
     q_offset: int | jax.Array = 0,  # global position of q[0] (ring/SP shards)
     k_offset: int | jax.Array = 0,
-) -> jax.Array:
-    """Returns (B, Sq, Hq, D). Softmax in fp32 regardless of input dtype.
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (out (B,Sq,Hq,D), lse (B,Sq,Hq)). Softmax in fp32.
 
-    ``q_offset``/``k_offset`` place local shards on the global sequence
-    axis so the same causal math serves full attention and ring-attention
-    blocks.
+    The log-sum-exp output is what lets ring attention merge per-hop
+    partial results exactly (online-softmax combining); rows that attend
+    to nothing yield out = 0 and lse = NEG_INF.
     """
     orig_dtype = q.dtype
     hq, hkv = q.shape[2], k.shape[2]
@@ -56,14 +59,33 @@ def dot_product_attention(
         sq, sk = q.shape[1], k.shape[1]
         qpos = jnp.arange(sq)[:, None] + q_offset
         kpos = jnp.arange(sk)[None, :] + k_offset
-        causal_mask = qpos >= kpos
-        logits = jnp.where(causal_mask[None, None], logits, -jnp.inf)
+        logits = jnp.where((qpos >= kpos)[None, None], logits, NEG_INF)
     if mask is not None:
-        logits = jnp.where(mask, logits, -jnp.inf)
+        logits = jnp.where(mask, logits, NEG_INF)
 
-    # Rows that attend to nothing (possible in ring blocks) softmax to 0.
-    probs = jax.nn.softmax(logits, axis=-1, where=jnp.isfinite(logits))
-    probs = jnp.nan_to_num(probs)
+    m = jnp.max(logits, axis=-1)  # (B, H, Sq); NEG_INF for empty rows
+    probs = jnp.where(logits > NEG_INF / 2, jnp.exp(logits - m[..., None]), 0.0)
+    l = jnp.sum(probs, axis=-1)
+    lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
-    return out.astype(orig_dtype)
+    out = out / jnp.maximum(l, 1.0e-30).transpose(0, 2, 1)[..., None]
+    out = jnp.where((l > 0).transpose(0, 2, 1)[..., None], out, 0.0)
+    return out.astype(orig_dtype), lse.transpose(0, 2, 1)  # lse -> (B, Sq, Hq)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    mask: jax.Array | None = None,
+    q_offset: int | jax.Array = 0,
+    k_offset: int | jax.Array = 0,
+) -> jax.Array:
+    """Returns (B, Sq, Hq, D); see :func:`dot_product_attention_with_lse`."""
+    out, _ = dot_product_attention_with_lse(
+        q, k, v, causal=causal, mask=mask, q_offset=q_offset, k_offset=k_offset
+    )
+    return out
