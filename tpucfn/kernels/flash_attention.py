@@ -58,32 +58,43 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0, 0].astype(jnp.float32)  # (BQ, D)
-    k = k_ref[0, 0].astype(jnp.float32)  # (BK, D)
-    v = v_ref[0, 0].astype(jnp.float32)  # (BK, D)
-
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale  # (BQ, BK)
-
+    # Causal block skip: a KV block strictly above the diagonal (its first
+    # key is later than this Q block's last query) contributes nothing —
+    # skip its MXU work entirely (roughly halves causal flops).
+    needed = True
     if causal:
-        qpos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        kpos = k_offset + ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(qpos >= kpos, s, NEG_INF)
+        last_q = q_offset + qi * block_q + block_q - 1
+        first_k = k_offset + ki * block_k
+        needed = last_q >= first_k
 
-    m_prev = m_ref[:, 0]  # (BQ,)
-    m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-    # Explicitly zero masked entries so fully-masked rows give l == 0
-    # rather than a junk uniform softmax.
-    p = jnp.where(s > NEG_INF / 2, jnp.exp(s - m_cur[:, None]), 0.0)  # (BQ, BK)
-    alpha = jnp.where(m_prev > NEG_INF / 2, jnp.exp(m_prev - m_cur), 0.0)
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)  # (BQ, D)
+        k = k_ref[0, 0].astype(jnp.float32)  # (BK, D)
+        v = v_ref[0, 0].astype(jnp.float32)  # (BK, D)
 
-    l_ref[:] = (l_ref[:, 0] * alpha + jnp.sum(p, axis=-1))[:, None] * jnp.ones(
-        (1, LANES), jnp.float32)
-    acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot(
-        p, v, preferred_element_type=jnp.float32)
-    m_ref[:] = m_cur[:, None] * jnp.ones((1, LANES), jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+
+        if causal:
+            qpos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = k_offset + ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+
+        m_prev = m_ref[:, 0]  # (BQ,)
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        # Explicitly zero masked entries so fully-masked rows give l == 0
+        # rather than a junk uniform softmax.
+        p = jnp.where(s > NEG_INF / 2, jnp.exp(s - m_cur[:, None]), 0.0)  # (BQ, BK)
+        alpha = jnp.where(m_prev > NEG_INF / 2, jnp.exp(m_prev - m_cur), 0.0)
+
+        l_ref[:] = (l_ref[:, 0] * alpha + jnp.sum(p, axis=-1))[:, None] * jnp.ones(
+            (1, LANES), jnp.float32)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_ref[:] = m_cur[:, None] * jnp.ones((1, LANES), jnp.float32)
 
     @pl.when(ki == pl.num_programs(3) - 1)
     def _finalize():
@@ -150,27 +161,35 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    q = q_ref[0, 0].astype(jnp.float32)
-    k = k_ref[0, 0].astype(jnp.float32)
-    v = v_ref[0, 0].astype(jnp.float32)
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0][:, 0]      # (BQ,)
-    delta = delta_ref[0, 0][:, 0]  # (BQ,)
-
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+    needed = True
     if causal:
-        qpos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        kpos = k_offset + ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(qpos >= kpos, s, NEG_INF)
+        last_q = q_offset + qi * block_q + block_q - 1
+        first_k = k_offset + ki * block_k
+        needed = last_q >= first_k
 
-    p = jnp.where(s > NEG_INF / 2, jnp.exp(s - lse[:, None]), 0.0)
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - delta[:, None]) * scale
-    dq_acc[:] += jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, 0]      # (BQ,)
+        delta = delta_ref[0, 0][:, 0]  # (BQ,)
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = k_offset + ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+
+        p = jnp.where(s > NEG_INF / 2, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dq_acc[:] += jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
 
     @pl.when(ki == pl.num_programs(3) - 1)
     def _finalize():
@@ -188,30 +207,38 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    q = q_ref[0, 0].astype(jnp.float32)
-    k = k_ref[0, 0].astype(jnp.float32)
-    v = v_ref[0, 0].astype(jnp.float32)
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0][:, 0]
-    delta = delta_ref[0, 0][:, 0]
-
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+    needed = True
     if causal:
-        qpos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        kpos = k_offset + ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(qpos >= kpos, s, NEG_INF)
+        last_q = q_offset + qi * block_q + block_q - 1
+        first_k = k_offset + ki * block_k
+        needed = last_q >= first_k
 
-    p = jnp.where(s > NEG_INF / 2, jnp.exp(s - lse[:, None]), 0.0)  # (BQ, BK)
-    dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                     preferred_element_type=jnp.float32)
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - delta[:, None]) * scale  # (BQ, BK)
-    dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                     preferred_element_type=jnp.float32)
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, 0]
+        delta = delta_ref[0, 0][:, 0]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = k_offset + ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+
+        p = jnp.where(s > NEG_INF / 2, jnp.exp(s - lse[:, None]), 0.0)  # (BQ, BK)
+        dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale  # (BQ, BK)
+        dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
 
     @pl.when(qi == pl.num_programs(3) - 1)
     def _finalize():

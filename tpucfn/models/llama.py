@@ -23,13 +23,14 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from tpucfn.mesh import AXIS_FSDP, AXIS_TENSOR
+from tpucfn.mesh import AXIS_EXPERT, AXIS_FSDP, AXIS_TENSOR
 from tpucfn.models.layers import (
     AttentionFn,
     CausalSelfAttention,
     RMSNorm,
     SwiGLUMLP,
 )
+from tpucfn.models.moe import MoEConfig, MoEMLP
 from tpucfn.ops.attention import dot_product_attention
 from tpucfn.parallel.sharding import ShardingRules
 
@@ -49,6 +50,7 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32
     scan_layers: bool = True
     remat: bool = True
+    moe: MoEConfig | None = None  # None = dense SwiGLU MLP
 
     @property
     def head_dim(self) -> int:
@@ -89,9 +91,12 @@ class LlamaBlock(nn.Module):
             name="attn",
         )(RMSNorm(cfg.norm_eps, cfg.dtype, name="input_norm")(x), q_offset=q_offset)
         x = x + h
-        h = SwiGLUMLP(cfg.ffn_dim, cfg.dtype, cfg.param_dtype, name="mlp")(
-            RMSNorm(cfg.norm_eps, cfg.dtype, name="post_attn_norm")(x)
-        )
+        normed = RMSNorm(cfg.norm_eps, cfg.dtype, name="post_attn_norm")(x)
+        if cfg.moe is not None:
+            h = MoEMLP(cfg.ffn_dim, cfg.moe, cfg.dtype, cfg.param_dtype,
+                       name="mlp")(normed)
+        else:
+            h = SwiGLUMLP(cfg.ffn_dim, cfg.dtype, cfg.param_dtype, name="mlp")(normed)
         return (x + h, q_offset), None
 
 
@@ -119,7 +124,7 @@ class Llama(nn.Module):
         if cfg.scan_layers:
             carry, _ = nn.scan(
                 block,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "losses": 0, "metrics": 0},
                 split_rngs={"params": True},
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
@@ -148,9 +153,17 @@ def sharding_rules(cfg: LlamaConfig, *, fsdp: bool = True, tensor: bool = True) 
     lead = (None,) if cfg.scan_layers else ()
 
     def spec(*axes):
-        return P(*(lead + axes))
+        full = lead + axes
+        while full and full[-1] is None:  # canonical: no trailing Nones
+            full = full[:-1]
+        return P(*full)
 
+    e = AXIS_EXPERT
     return ShardingRules((
+        # MoE experts first (more specific than the dense MLP rules).
+        (r"experts/(gate_proj|up_proj)/kernel$", spec(e, f, t)),
+        (r"experts/down_proj/kernel$", spec(e, t, f)),
+        (r"router/kernel$", spec(f)),
         (r"(q_proj|k_proj|v_proj)/kernel$", spec(f, t)),
         (r"o_proj/kernel$", spec(t, f)),
         (r"(gate_proj|up_proj)/kernel$", spec(f, t)),

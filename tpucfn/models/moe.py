@@ -1,0 +1,120 @@
+"""Mixture-of-Experts MLP with expert parallelism.
+
+Net-new vs the reference (SURVEY.md §2.3: EP row — "experts sharded on
+mesh axis"). GShard/Switch-style capacity-based routing expressed as
+dense einsums: top-k routing builds one-hot dispatch/combine tensors, the
+expert computation is a single batched matmul over the stacked expert
+weights, and sharding the expert dimension over the ``expert`` mesh axis
+makes XLA emit the dispatch/return all-to-alls. No ragged shapes, no
+scatter — everything stays MXU-friendly and statically shaped (tokens
+overflowing an expert's capacity are dropped, the standard TPU trade).
+
+Param layout matches the preset conventions (``experts/...`` with a
+leading expert dim, ``router/kernel``): tpucfn/parallel/presets.py rules
+shard it as P(expert, fsdp, tensor).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    router_z_loss: float = 1e-3
+    load_balance_loss: float = 1e-2
+
+
+class MoEMLP(nn.Module):
+    """Drop-in replacement for a dense SwiGLU MLP block."""
+
+    ffn_dim: int
+    moe: MoEConfig
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):  # (B, S, D) -> (B, S, D), plus aux losses via sow
+        cfg = self.moe
+        b, s, d = x.shape
+        e = cfg.n_experts
+        k = cfg.top_k
+        n_tokens = b * s
+        capacity = max(1, int(cfg.capacity_factor * n_tokens * k / e))
+
+        # --- routing (fp32 for a stable softmax) -------------------------
+        router_logits = nn.DenseGeneral(
+            e, use_bias=False, dtype=jnp.float32, param_dtype=self.param_dtype,
+            name="router",
+        )(x.astype(jnp.float32)).reshape(n_tokens, e)
+        probs = jax.nn.softmax(router_logits, axis=-1)
+
+        gate_vals, expert_idx = jax.lax.top_k(probs, k)  # (T, k)
+
+        # Position of each token in its chosen expert's buffer, assigned in
+        # token order per (expert, k-slot) via a cumulative count.
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)  # (T, k, E)
+        flatoh = onehot.reshape(n_tokens * k, e)
+        pos_in_expert = (jnp.cumsum(flatoh, axis=0) - flatoh).reshape(n_tokens, k, e)
+        pos_in_expert = (pos_in_expert * onehot).sum(-1)  # (T, k)
+        within_cap = pos_in_expert < capacity  # overflow tokens dropped
+
+        gate_vals = gate_vals * within_cap
+        # Renormalize kept gates so each surviving token's weights sum to 1.
+        denom = jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+        gate_vals = gate_vals / denom
+
+        # dispatch (T, E, C) one-hot; combine = dispatch * gate
+        cap_oh = jax.nn.one_hot(pos_in_expert, capacity, dtype=jnp.float32)  # (T,k,C)
+        disp = jnp.einsum("tke,tkc->tec", onehot.astype(jnp.float32),
+                          cap_oh * within_cap[..., None])
+        combine = jnp.einsum("tke,tkc,tk->tec", onehot.astype(jnp.float32),
+                             cap_oh, gate_vals)
+
+        # --- expert compute ----------------------------------------------
+        xt = x.reshape(n_tokens, d)
+        expert_in = jnp.einsum("tec,td->ecd", disp, xt.astype(jnp.float32)).astype(
+            self.dtype
+        )  # (E, C, D)
+
+        wg = self.param("experts/gate_proj/kernel", nn.initializers.lecun_normal(),
+                        (e, d, self.ffn_dim), self.param_dtype)
+        wu = self.param("experts/up_proj/kernel", nn.initializers.lecun_normal(),
+                        (e, d, self.ffn_dim), self.param_dtype)
+        wd = self.param("experts/down_proj/kernel", nn.initializers.lecun_normal(),
+                        (e, self.ffn_dim, d), self.param_dtype)
+
+        h = nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, wg.astype(self.dtype))) \
+            * jnp.einsum("ecd,edf->ecf", expert_in, wu.astype(self.dtype))
+        expert_out = jnp.einsum("ecf,efd->ecd", h, wd.astype(self.dtype))  # (E, C, D)
+
+        out = jnp.einsum("tec,ecd->td", combine, expert_out.astype(jnp.float32))
+        out = out.reshape(b, s, d).astype(self.dtype)
+
+        # --- aux losses (sown; the loss_fn adds them) --------------------
+        # Switch load-balance: E * sum_e fraction_tokens_e * mean_prob_e
+        token_frac = disp.sum((0, 2)) / jnp.maximum(disp.sum(), 1.0)
+        prob_frac = probs.mean(0)
+        lb = e * jnp.sum(token_frac * prob_frac) * cfg.load_balance_loss
+        zl = jnp.mean(jax.nn.logsumexp(router_logits, axis=-1) ** 2) * cfg.router_z_loss
+        self.sow("losses", "moe_aux", lb + zl)
+        self.sow("metrics", "moe_dropped_frac",
+                 1.0 - jnp.minimum(disp.sum() / (n_tokens * k), 1.0))
+        return out
+
+
+def collect_moe_aux(variables: dict) -> jax.Array:
+    """Sum all sown MoE aux losses (0.0 if the model has no MoE layers)."""
+    losses = variables.get("losses", {})
+    total = 0.0
+    for leaf in jax.tree.leaves(losses):
+        total = total + jnp.sum(leaf)
+    return jnp.asarray(total, jnp.float32)

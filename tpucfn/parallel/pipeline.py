@@ -1,0 +1,90 @@
+"""Pipeline parallelism — GPipe microbatch schedule over the ``pipeline``
+mesh axis.
+
+Net-new vs the reference (SURVEY.md §2.3: PP "no" in reference, required
+in build). TPU-first formulation: this is SPMD, not a scheduler process —
+every stage runs the *same* compiled program; stage identity comes from
+``lax.axis_index``. Per tick, each stage applies its layer slice to the
+activation it holds and hands the result to its neighbor with a single
+``ppermute`` hop (stage boundaries are exactly the outermost-axis neighbor
+links, which is why ``pipeline`` is the outermost mesh axis —
+tpucfn/mesh/mesh.py).
+
+Schedule: GPipe with M microbatches over P stages → M + P - 1 ticks.
+Bubble fraction (P-1)/(M+P-1); raise M to amortize. Stages compute
+during their bubble ticks too (the result is discarded) — on SPMD
+hardware predication saves nothing, uniformity keeps the program one
+fused XLA computation. 1F1B is a planned optimization, not a semantic
+change.
+
+Differentiable by construction: the schedule is a ``lax.scan`` over
+ticks, so reverse-mode AD replays it backwards and the activation
+stash is handled by the scan's own mechanics (+ remat inside stage_fn if
+desired).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpucfn.mesh import AXIS_PIPELINE
+
+# stage_fn(stage_params, x) -> y, applied by each stage to its microbatch.
+StageFn = Callable[[Any, jax.Array], jax.Array]
+
+
+def gpipe(
+    stage_fn: StageFn,
+    stage_params: Any,
+    microbatches: jax.Array,  # (M, mb, ...) — replicated across the axis
+    *,
+    axis: str = AXIS_PIPELINE,
+) -> jax.Array:
+    """Run ``stage_fn`` as a P-stage pipeline; call inside ``shard_map``.
+
+    ``stage_params`` is this stage's slice (shard the stacked layer dim
+    over ``axis``). Returns (M, mb, ...) — the composition of all P stages
+    applied to every microbatch, replicated to all stages.
+
+    Activations must keep one shape/dtype through stages (true for
+    transformer blocks).
+    """
+    p = lax.axis_size(axis)
+    i = lax.axis_index(axis)
+    m = microbatches.shape[0]
+    ticks = m + p - 1
+    perm = [(j, (j + 1) % p) for j in range(p)]
+
+    def tick(recv, t):
+        # Stage 0 injects microbatch t (clamped during drain ticks);
+        # other stages consume what arrived from their left neighbor.
+        inject = microbatches[jnp.clip(t, 0, m - 1)]
+        x = jnp.where(i == 0, inject, recv)
+        y = stage_fn(stage_params, x)
+        send = lax.ppermute(y, axis, perm)
+        return send, y
+
+    zero = jnp.zeros_like(microbatches[0])
+    _, ys = lax.scan(tick, zero, jnp.arange(ticks))
+
+    # Microbatch j finishes on the last stage at tick j + p - 1.
+    finished = ys[jnp.arange(m) + (p - 1)]
+    # Broadcast the last stage's results to every stage (masked psum).
+    return lax.psum(jnp.where(i == p - 1, finished, jnp.zeros_like(finished)), axis)
+
+
+def microbatch(x: jax.Array, num_microbatches: int) -> jax.Array:
+    """(B, ...) -> (M, B/M, ...)."""
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(f"batch {b} not divisible by {num_microbatches} microbatches")
+    return x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
+
+
+def unmicrobatch(x: jax.Array) -> jax.Array:
+    """(M, B/M, ...) -> (B, ...)."""
+    return x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])
