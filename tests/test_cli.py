@@ -1,0 +1,110 @@
+"""CLI workflow tests — the reference's README walkthrough as automation:
+create-stack → status → env → launch → kill-host → heal → resize → delete.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tpucfn.cli.main import main
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _cli(tmp_path, *argv):
+    return main(["--state-dir", str(tmp_path / "state"), *argv])
+
+
+def test_full_walkthrough(tmp_path, capsys):
+    assert _cli(tmp_path, "create-stack", "--name", "demo", "--accelerator", "v4-32") == 0
+    out = capsys.readouterr().out
+    assert "CREATE_COMPLETE demo" in out
+    assert "4 hosts" in out
+
+    assert _cli(tmp_path, "status", "--name", "demo") == 0
+    out = capsys.readouterr().out
+    assert "ACTIVE" in out and "host3" in out
+
+    assert _cli(tmp_path, "env", "--name", "demo") == 0
+    out = capsys.readouterr().out
+    assert "export TPUCFN_WORKERS_COUNT='4'" in out
+    assert "export DEEPLEARNING_WORKERS_COUNT='4'" in out  # legacy alias
+
+    # launch: each host writes its id into a file
+    marker = tmp_path / "marker"
+    marker.mkdir()
+    rc = _cli(
+        tmp_path, "launch", "--name", "demo", "--",
+        sys.executable, "-c",
+        f"import os,pathlib;pathlib.Path(r'{marker}').joinpath("
+        "os.environ['TPUCFN_HOST_ID']).write_text('ok')",
+    )
+    assert rc == 0
+    assert sorted(p.name for p in marker.iterdir()) == ["0", "1", "2", "3"]
+
+    assert _cli(tmp_path, "resize", "--name", "demo", "--accelerator", "v4-64") == 0
+    assert "RESIZE_COMPLETE" in capsys.readouterr().out
+    _cli(tmp_path, "status", "--name", "demo")
+    assert "host7" in capsys.readouterr().out
+
+    assert _cli(tmp_path, "delete", "--name", "demo") == 0
+    assert "DELETE_COMPLETE" in capsys.readouterr().out
+
+
+def test_fault_injection_and_heal(tmp_path, capsys):
+    _cli(tmp_path, "create-stack", "--name", "ft", "--accelerator", "v4-16")
+    capsys.readouterr()
+    _cli(tmp_path, "kill-host", "--name", "ft", "--host", "1")
+    capsys.readouterr()
+    _cli(tmp_path, "status", "--name", "ft")
+    assert "DEAD" in capsys.readouterr().out
+    assert _cli(tmp_path, "heal", "--name", "ft") == 0
+    assert "gen=2" in capsys.readouterr().out
+    _cli(tmp_path, "status", "--name", "ft")
+    assert "DEAD" not in capsys.readouterr().out
+
+
+def test_launch_requires_active(tmp_path, capsys):
+    _cli(tmp_path, "create-stack", "--name", "gone", "--accelerator", "cpu-8")
+    _cli(tmp_path, "delete", "--name", "gone")
+    capsys.readouterr()
+    rc = _cli(tmp_path, "launch", "--name", "gone", "--", "true")
+    assert rc == 1
+    assert "not ACTIVE" in capsys.readouterr().err
+
+
+def test_spec_file_create(tmp_path, capsys):
+    spec = {"name": "from-file", "accelerator": "v5p-64", "storage_path": "gs://b/x"}
+    f = tmp_path / "cluster.json"
+    f.write_text(json.dumps(spec))
+    assert _cli(tmp_path, "create-stack", "--spec", str(f)) == 0
+    out = capsys.readouterr().out
+    assert "8 hosts" in out
+
+
+def test_cli_subprocess_entry(tmp_path):
+    r = subprocess.run(
+        [sys.executable, "-m", "tpucfn.cli", "--state-dir", str(tmp_path),
+         "create-stack", "--name", "subp", "--accelerator", "cpu-8"],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "CREATE_COMPLETE subp" in r.stdout
+
+
+def test_state_persists_across_invocations(tmp_path, capsys):
+    _cli(tmp_path, "create-stack", "--name", "persist", "--accelerator", "v4-16")
+    capsys.readouterr()
+    # fresh control-plane object (new invocation) still sees the cluster
+    assert _cli(tmp_path, "status", "--name", "persist") == 0
+    assert "ACTIVE" in capsys.readouterr().out
+    state_file = tmp_path / "state" / "control_plane.json"
+    assert state_file.exists()
+
+
+def test_unknown_cluster_errors(tmp_path):
+    with pytest.raises(KeyError):
+        _cli(tmp_path, "status", "--name", "nope")

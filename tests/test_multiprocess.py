@@ -1,0 +1,45 @@
+"""Multi-process-without-a-cluster (SURVEY.md §4): two local processes
+join a real jax.distributed rendezvous through the launcher and compute a
+cross-process reduction — the coordinator path the reference delegated to
+MPI/dmlc, exercised on CPU in CI."""
+
+import os
+import socket
+import sys
+from pathlib import Path
+
+from tpucfn.bootstrap import EnvContract
+from tpucfn.launch import Launcher, LocalTransport
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_rendezvous_and_reduction(tmp_path):
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("127.0.0.1:0\n127.0.0.1:0\n")
+    contract = EnvContract(
+        workers_path=str(hostfile),
+        workers_count=2,
+        worker_chip_count=2,
+        coordinator=f"127.0.0.1:{_free_port()}",
+        host_id=0,
+        storage=str(tmp_path),
+        generation=1,
+    )
+    env_base = {
+        "PYTHONPATH": str(REPO) + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    launcher = Launcher(contract, LocalTransport())
+    argv = [sys.executable, str(REPO / "tests" / "multiproc_worker.py")]
+    procs = []
+    for host_id in range(2):
+        env = {**launcher.host_env(host_id), **env_base}
+        procs.append(launcher.transport.run(f"127.0.0.1:{host_id}", argv, env))
+    rc = launcher.wait(procs)
+    assert rc == 0

@@ -1,0 +1,5 @@
+import sys
+
+from tpucfn.cli.main import main
+
+sys.exit(main())

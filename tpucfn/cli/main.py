@@ -1,0 +1,198 @@
+"""``tpucfn`` CLI — the user-facing command surface.
+
+Command-for-command parity with the reference's documented workflow
+(SURVEY.md §1 L6, §3.1-§3.5):
+
+    reference                              tpucfn
+    ------------------------------------   ------------------------------------
+    aws cloudformation create-stack        tpucfn create-stack --name p --accelerator v4-32
+      --template-body …deeplearning.template  [--spec cluster.json]
+    (stack Outputs: master DNS)            printed outputs: coordinator, env file
+    aws cloudformation describe-stacks     tpucfn status --name p
+    aws cloudformation update-stack        tpucfn resize --name p --accelerator v4-64
+    aws cloudformation delete-stack        tpucfn delete --name p
+    launch.py -n $N -H $HOSTFILE cmd…      tpucfn launch --name p -- python train.py …
+    (ssh master; env already exported)     tpucfn env --name p   (print/export contract)
+
+State lives in ``--state-dir`` (default ``~/.tpucfn``) through the fake
+control plane; a real cloud backend slots in behind the same interface.
+``--backend local`` "provisions" this machine (the single-host path used
+with the real TPU chip and in CI).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from pathlib import Path
+
+from tpucfn.bootstrap import converge
+from tpucfn.launch import Launcher, LocalTransport, SSHTransport
+from tpucfn.provision import FakeControlPlane, Provisioner
+from tpucfn.spec import ClusterSpec
+
+
+def _control_plane(args) -> FakeControlPlane:
+    state = Path(args.state_dir).expanduser() / "control_plane.json"
+    # steps_to_provision=1: CLI ticks are driven by wait_active polling.
+    return FakeControlPlane(steps_to_provision=1, state_file=str(state))
+
+
+def _run_dir(args, name: str) -> Path:
+    return Path(args.state_dir).expanduser() / "clusters" / name
+
+
+def cmd_create_stack(args) -> int:
+    if args.spec:
+        spec = ClusterSpec.load(args.spec)
+    else:
+        if not args.name:
+            print("error: --name (or --spec file) required", file=sys.stderr)
+            return 2
+        spec = ClusterSpec(
+            name=args.name,
+            accelerator=args.accelerator,
+            storage_path=args.storage or "",
+        )
+    prov = Provisioner(_control_plane(args))
+    rec = prov.create(spec)
+    contract = converge(rec, _run_dir(args, spec.name))
+    print(f"CREATE_COMPLETE {spec.name}")
+    print(f"  accelerator:  {spec.accelerator} ({spec.num_hosts} hosts, "
+          f"{spec.num_chips} chips)")
+    print(f"  coordinator:  {contract.coordinator}")
+    print(f"  hostfile:     {contract.workers_path}")
+    print(f"  env file:     {_run_dir(args, spec.name) / 'env.sh'}")
+    print(f"  next:         tpucfn launch --name {spec.name} -- python train.py")
+    return 0
+
+
+def cmd_status(args) -> int:
+    rec = _control_plane(args).describe(args.name)
+    print(f"{args.name}: {rec.state.value} gen={rec.generation}")
+    for h in rec.hosts:
+        print(f"  host{h.host_id} {h.address} {'healthy' if h.healthy else 'DEAD'}")
+    return 0
+
+
+def cmd_delete(args) -> int:
+    Provisioner(_control_plane(args)).delete(args.name)
+    print(f"DELETE_COMPLETE {args.name}")
+    return 0
+
+
+def cmd_resize(args) -> int:
+    prov = Provisioner(_control_plane(args))
+    rec = prov.resize(args.name, args.accelerator)
+    converge(rec, _run_dir(args, args.name))
+    print(f"RESIZE_COMPLETE {args.name} -> {args.accelerator} "
+          f"({len(rec.hosts)} hosts, gen={rec.generation})")
+    print("  running jobs must be re-launched; they resume from their "
+          "latest checkpoint")
+    return 0
+
+
+def cmd_env(args) -> int:
+    rec = _control_plane(args).describe(args.name)
+    contract = converge(rec, _run_dir(args, args.name))
+    for k, v in sorted(contract.to_env().items()):
+        print(f"export {k}={v!r}")
+    return 0
+
+
+def cmd_launch(args) -> int:
+    rec = _control_plane(args).describe(args.name)
+    from tpucfn.provision.control_plane import ClusterState
+
+    if rec.state is not ClusterState.ACTIVE:
+        print(f"error: cluster {args.name} is {rec.state.value}, not ACTIVE",
+              file=sys.stderr)
+        return 1
+    contract = converge(rec, _run_dir(args, args.name))
+    transport = SSHTransport() if args.transport == "ssh" else LocalTransport()
+    launcher = Launcher(contract, transport)
+    argv = list(args.cmd)
+    if argv and argv[0] == "--":
+        argv = argv[1:]
+    if not argv:
+        print("error: no command given (use: tpucfn launch --name X -- cmd…)",
+              file=sys.stderr)
+        return 2
+    procs = launcher.launch(argv)
+    rc = launcher.wait(procs)
+    print(f"launch finished rc={rc}")
+    return rc
+
+
+def cmd_kill_host(args) -> int:
+    """Fault injection (SURVEY.md §5): mark a host dead so monitors and
+    tests can exercise the recovery path."""
+    _control_plane(args).kill_host(args.name, args.host)
+    print(f"host {args.host} of {args.name} marked dead")
+    return 0
+
+
+def cmd_heal(args) -> int:
+    prov = Provisioner(_control_plane(args))
+    rec = prov.ensure_healthy(args.name)
+    converge(rec, _run_dir(args, args.name))
+    print(f"{args.name}: {rec.state.value} gen={rec.generation} "
+          f"({len(rec.hosts)} healthy hosts)")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpucfn", description=__doc__)
+    p.add_argument("--state-dir", default=os.environ.get("TPUCFN_STATE_DIR", "~/.tpucfn"))
+    sub = p.add_subparsers(dest="command", required=True)
+
+    c = sub.add_parser("create-stack", help="provision a cluster (≈ CFN create-stack)")
+    c.add_argument("--name")
+    c.add_argument("--spec", help="cluster spec JSON file (≈ the template)")
+    c.add_argument("--accelerator", default="v5e-8")
+    c.add_argument("--storage", help="shared storage root (≈ EFS)")
+    c.set_defaults(fn=cmd_create_stack)
+
+    s = sub.add_parser("status", help="describe a cluster")
+    s.add_argument("--name", required=True)
+    s.set_defaults(fn=cmd_status)
+
+    d = sub.add_parser("delete", help="delete a cluster")
+    d.add_argument("--name", required=True)
+    d.set_defaults(fn=cmd_delete)
+
+    r = sub.add_parser("resize", help="re-acquire at a new topology (≈ update-stack)")
+    r.add_argument("--name", required=True)
+    r.add_argument("--accelerator", required=True)
+    r.set_defaults(fn=cmd_resize)
+
+    e = sub.add_parser("env", help="print the cluster env contract (eval-able)")
+    e.add_argument("--name", required=True)
+    e.set_defaults(fn=cmd_env)
+
+    l = sub.add_parser("launch", help="fan a command out across all hosts")
+    l.add_argument("--name", required=True)
+    l.add_argument("--transport", choices=["local", "ssh"], default="local")
+    l.add_argument("cmd", nargs=argparse.REMAINDER)
+    l.set_defaults(fn=cmd_launch)
+
+    k = sub.add_parser("kill-host", help="fault injection: mark a host dead")
+    k.add_argument("--name", required=True)
+    k.add_argument("--host", type=int, required=True)
+    k.set_defaults(fn=cmd_kill_host)
+
+    h = sub.add_parser("heal", help="health check; re-acquire if hosts died")
+    h.add_argument("--name", required=True)
+    h.set_defaults(fn=cmd_heal)
+
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

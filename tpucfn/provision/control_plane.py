@@ -79,13 +79,68 @@ class FakeControlPlane(ControlPlane):
     TPU slice is atomic, so replacement = re-acquire the whole slice).
     """
 
-    def __init__(self, *, steps_to_provision: int = 2, fail_creation: bool = False):
+    def __init__(self, *, steps_to_provision: int = 2, fail_creation: bool = False,
+                 state_file: str | None = None):
+        """``state_file`` persists cluster records to disk so separate CLI
+        invocations (create-stack, then launch, then delete) share state —
+        the role the CFN service's own database played for the reference."""
         self.steps_to_provision = steps_to_provision
         self.fail_creation = fail_creation
         self._clusters: dict[str, ClusterRecord] = {}
         self._pending: dict[str, int] = {}
         self._gen = itertools.count(1)
         self.events: list[tuple[str, str]] = []  # (cluster, event) audit log
+        self._state_file = state_file
+        if state_file:
+            self._load()
+
+    # -- persistence -----------------------------------------------------
+
+    def _load(self) -> None:
+        import json
+        from pathlib import Path
+
+        p = Path(self._state_file)
+        if not p.exists():
+            return
+        raw = json.loads(p.read_text())
+        for name, rec in raw.get("clusters", {}).items():
+            self._clusters[name] = ClusterRecord(
+                spec=ClusterSpec.from_json(rec["spec"]),
+                state=ClusterState(rec["state"]),
+                hosts=[HostRecord(**h) for h in rec["hosts"]],
+                generation=rec["generation"],
+                message=rec.get("message", ""),
+            )
+        self._pending = dict(raw.get("pending", {}))
+        self._gen = itertools.count(raw.get("next_gen", 1))
+
+    def _save(self) -> None:
+        if not self._state_file:
+            return
+        import dataclasses as dc
+        import json
+        from pathlib import Path
+
+        next_gen = next(self._gen)  # peek (consumes; re-prime below)
+        self._gen = itertools.count(next_gen)
+        data = {
+            "clusters": {
+                name: {
+                    "spec": rec.spec.to_json(),
+                    "state": rec.state.value,
+                    "hosts": [dc.asdict(h) for h in rec.hosts],
+                    "generation": rec.generation,
+                    "message": rec.message,
+                }
+                for name, rec in self._clusters.items()
+            },
+            "pending": self._pending,
+            "next_gen": next_gen,
+        }
+        p = Path(self._state_file)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps(data, indent=2))
 
     # -- ControlPlane ----------------------------------------------------
 
@@ -101,6 +156,7 @@ class FakeControlPlane(ControlPlane):
         self._clusters[spec.name] = rec
         self._pending[spec.name] = self.steps_to_provision
         self.events.append((spec.name, "create"))
+        self._save()
         return rec
 
     def describe(self, name: str) -> ClusterRecord:
@@ -114,6 +170,7 @@ class FakeControlPlane(ControlPlane):
         rec.hosts = []
         self._pending.pop(name, None)
         self.events.append((name, "delete"))
+        self._save()
 
     def tick(self) -> None:
         for name, rec in self._clusters.items():
@@ -133,11 +190,13 @@ class FakeControlPlane(ControlPlane):
                         for i in range(rec.spec.num_hosts)
                     ]
                     self.events.append((name, "active"))
+        self._save()
 
     def kill_host(self, name: str, host_id: int) -> None:
         rec = self.describe(name)
         rec.hosts[host_id].healthy = False
         self.events.append((name, f"host{host_id}-died"))
+        self._save()
 
 
 WaitCallback = Callable[[ClusterRecord], None]
