@@ -1,0 +1,7 @@
+#!/bin/sh
+# Build the native tpurecord reader. Invoked automatically by
+# tpucfn/data/native.py on first use; safe to run by hand.
+set -e
+cd "$(dirname "$0")"
+g++ -O3 -fPIC -shared -std=c++17 -Wall -o libtpurecord.so tpurecord.cc -lz
+echo "built $(pwd)/libtpurecord.so"

@@ -65,9 +65,13 @@ class ShardedDataset:
 
     def _load(self) -> list[dict[str, np.ndarray]]:
         if self._cache is None:
+            from tpucfn.data import native
+
+            read = (native.read_record_shard_native if native.native_available()
+                    else records.read_record_shard)
             out = []
             for p in self.local_shards:
-                out.extend(records.decode_example(b) for b in records.read_record_shard(p))
+                out.extend(records.decode_example(b) for b in read(p))
             if not out:
                 raise ValueError(f"shards {self.local_shards} contain no examples")
             self._cache = out
