@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""BERT-base MLM pretraining (BASELINE config 3: "Horovod→JAX launcher
+path, all-reduce over ICI").
+
+The reference ran BERT through Horovod's ``mpirun`` + NCCL all-reduce
+(SURVEY.md §3.3); here the same one-command launch produces a single SPMD
+program whose gradient all-reduce XLA emits over ICI. Masking is applied
+on the fly per step (15% positions, 80/10/10 mask/random/keep).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+from common import (  # noqa: E402
+    add_cluster_args,
+    build_example_mesh,
+    per_process_batch,
+    run_train_loop,
+    stage_synthetic,
+)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    add_cluster_args(p)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--num-examples", type=int, default=512)
+    p.add_argument("--mask-prob", type=float, default=0.15)
+    p.add_argument("--tiny", action="store_true", help="tiny config (CI)")
+    args = p.parse_args()
+
+    from tpucfn.launch import initialize_runtime
+
+    initialize_runtime()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpucfn.data import ShardedDataset
+    from tpucfn.models import Bert, BertConfig, mlm_loss
+    from tpucfn.parallel import transformer_rules
+    from tpucfn.train import Trainer
+
+    cfg = BertConfig.tiny() if args.tiny else BertConfig.base()
+    run_dir = Path(args.run_dir)
+    shards = stage_synthetic(
+        "tokens", run_dir / "data", n=args.num_examples,
+        num_shards=max(8, jax.process_count()), seed=args.seed,
+        seq_len=args.seq_len, vocab=cfg.vocab_size,
+    )
+
+    mesh = build_example_mesh(args)
+    model = Bert(cfg)
+    sample = jnp.zeros((1, args.seq_len), jnp.int32)
+    MASK_ID = 3
+
+    def init_fn(rng):
+        return model.init(rng, sample)["params"], {}
+
+    def loss_fn(params, mstate, batch, rng):
+        tokens = batch["tokens"]
+        r1, r2, r3 = jax.random.split(rng, 3)
+        mask = jax.random.uniform(r1, tokens.shape) < args.mask_prob
+        swap = jax.random.uniform(r2, tokens.shape)
+        randoms = jax.random.randint(r3, tokens.shape, 0, cfg.vocab_size)
+        masked = jnp.where(mask & (swap < 0.8), MASK_ID, tokens)
+        masked = jnp.where(mask & (swap >= 0.8) & (swap < 0.9), randoms, masked)
+        logits = model.apply({"params": params}, masked, train=True,
+                             rngs={"dropout": rng})
+        loss, acc = mlm_loss(logits, tokens, mask)
+        return loss, ({"accuracy": acc}, mstate)
+
+    total = args.steps or 1000
+    tx = optax.adamw(
+        optax.warmup_cosine_decay_schedule(0.0, 1e-4, max(1, min(100, total // 10)),
+                                           total),
+        weight_decay=0.01,
+    )
+    trainer = Trainer(
+        mesh, transformer_rules(tensor=args.tensor > 1), loss_fn, tx, init_fn
+    )
+    ds = ShardedDataset(shards, batch_size_per_process=per_process_batch(args),
+                        seed=args.seed)
+    run_train_loop(trainer, ds, mesh, args, items_per_step=args.batch_size)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -28,6 +28,7 @@ from common import (  # noqa: E402
     add_cluster_args,
     build_example_mesh,
     per_process_batch,
+    run_train_loop,
     stage_synthetic,
 )
 
@@ -48,10 +49,8 @@ def main() -> int:
     import jax.numpy as jnp
     import optax
 
-    from tpucfn.ckpt import CheckpointManager
-    from tpucfn.data import ShardedDataset, prefetch_to_mesh
+    from tpucfn.data import ShardedDataset
     from tpucfn.models import ResNet, ResNetConfig
-    from tpucfn.obs import MetricLogger, StepTimer, profile_steps
     from tpucfn.parallel import dense_rules
     from tpucfn.train import Trainer
 
@@ -98,38 +97,7 @@ def main() -> int:
 
     ds = ShardedDataset(shards, batch_size_per_process=per_process_batch(args),
                         seed=args.seed)
-    logger = MetricLogger(run_dir / "logs", stdout_every=args.log_every)
-    timer = StepTimer()
-
-    with CheckpointManager(run_dir / "ckpt",
-                           save_interval_steps=args.ckpt_every) as ckpt:
-        if args.resume and ckpt.latest_step() is not None:
-            state = ckpt.restore(trainer.abstract_state())
-            print(f"resumed from step {int(state.step)}", flush=True)
-        else:
-            state = trainer.init(jax.random.key(args.seed))
-
-        total = args.steps or len(ds) * args.num_epochs
-        batches = prefetch_to_mesh(ds.batches(None), mesh)
-        with profile_steps(run_dir / "profile", enabled=args.profile):
-            for batch in batches:
-                if int(state.step) >= total:
-                    break
-                state, metrics = trainer.step(state, batch)
-                step = int(state.step)  # blocks on the step -> honest timing
-                timer.tick()
-                if step % args.log_every == 0 or step == total:
-                    logger.log(step, {**{k: float(v) for k, v in metrics.items()},
-                                      "step_time": timer._last or 0.0})
-                ckpt.save(step, state)
-        ckpt.save(int(state.step), state, force=True)
-
-    ips = timer.throughput(args.batch_size)
-    if ips and jax.process_index() == 0:
-        print(f"final: step={int(state.step)} loss={float(metrics['loss']):.4f} "
-              f"images/sec={ips:.1f} images/sec/chip={ips / jax.device_count():.1f}",
-              flush=True)
-    logger.close()
+    run_train_loop(trainer, ds, mesh, args, items_per_step=args.batch_size)
     return 0
 
 

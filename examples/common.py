@@ -56,14 +56,74 @@ def per_process_batch(args) -> int:
     return args.batch_size // jax.process_count()
 
 
-def stage_synthetic(kind: str, data_dir: Path, *, n: int, num_shards: int, seed: int = 0):
+def stage_synthetic(kind: str, data_dir: Path, *, n: int, num_shards: int,
+                    seed: int = 0, **gen_kwargs):
     """Stage synthetic data once (≈ `aws s3 sync` in the reference README;
     real datasets go through the identical write_dataset_shards path)."""
-    from tpucfn.data import synthetic_cifar10, synthetic_imagenet, write_dataset_shards
+    from tpucfn.data import (
+        synthetic_cifar10,
+        synthetic_imagenet,
+        synthetic_latents,
+        synthetic_tokens,
+        write_dataset_shards,
+    )
 
     data_dir.mkdir(parents=True, exist_ok=True)
     existing = sorted(data_dir.glob("*.tpurec"))
     if existing:
         return existing
-    gen = {"cifar10": synthetic_cifar10, "imagenet": synthetic_imagenet}[kind]
-    return write_dataset_shards(gen(n, seed=seed), data_dir, num_shards=num_shards)
+    gen = {
+        "cifar10": synthetic_cifar10,
+        "imagenet": synthetic_imagenet,
+        "tokens": synthetic_tokens,
+        "latents": synthetic_latents,
+    }[kind]
+    return write_dataset_shards(gen(n, seed=seed, **gen_kwargs), data_dir,
+                                num_shards=num_shards)
+
+
+def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=()):
+    """The shared epoch/step/checkpoint/metrics loop every example uses."""
+    import jax
+
+    from tpucfn.ckpt import CheckpointManager
+    from tpucfn.data import prefetch_to_mesh
+    from tpucfn.obs import MetricLogger, StepTimer, profile_steps
+
+    run_dir = Path(args.run_dir)
+    logger = MetricLogger(run_dir / "logs", stdout_every=args.log_every)
+    timer = StepTimer()
+    with CheckpointManager(run_dir / "ckpt",
+                           save_interval_steps=args.ckpt_every) as ckpt:
+        if args.resume and ckpt.latest_step() is not None:
+            state = ckpt.restore(trainer.abstract_state())
+            print(f"resumed from step {int(state.step)}", flush=True)
+        else:
+            state = trainer.init(jax.random.key(args.seed))
+
+        total = args.steps or len(ds) * args.num_epochs
+        metrics = {}
+        with profile_steps(run_dir / "profile", enabled=args.profile):
+            for batch in prefetch_to_mesh(ds.batches(None), mesh,
+                                          extra_axes=extra_axes):
+                if int(state.step) >= total:
+                    break
+                state, metrics = trainer.step(state, batch)
+                step = int(state.step)  # blocks -> honest step timing
+                timer.tick()
+                if step % args.log_every == 0 or step == total:
+                    logger.log(step, {**{k: float(v) for k, v in metrics.items()},
+                                      "step_time": timer._last or 0.0})
+                ckpt.save(step, state)
+        ckpt.save(int(state.step), state, force=True)
+
+    if jax.process_index() == 0:
+        ips = timer.throughput(items_per_step)
+        loss = float(metrics.get("loss", float("nan")))
+        line = f"final: step={int(state.step)} loss={loss:.4f}"
+        if ips:  # needs steady-state steps beyond the compile warmup
+            line += (f" items/sec={ips:.1f}"
+                     f" items/sec/chip={ips / jax.device_count():.1f}")
+        print(line, flush=True)
+    logger.close()
+    return state

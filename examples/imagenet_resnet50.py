@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""ImageNet ResNet-50 data-parallel training (BASELINE config 2; the
+reference's headline example, SURVEY.md §2.1 "Example: ImageNet ResNet-50").
+
+    reference:  launch.py -n $DEEPLEARNING_WORKERS_COUNT -H $HOSTFILE \
+                   python train_imagenet.py --network resnet --kv-store dist_sync
+    tpucfn:     tpucfn launch examples/imagenet_resnet50.py -- --batch-size 1024
+
+DP via psum over ICI (XLA-inserted); --fsdp N shards params/optimizer.
+Data: real ImageNet stages through the identical tpurecord path — here the
+synthetic generator stands in (zero-egress build env; BASELINE.md caveat).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+from common import (  # noqa: E402
+    add_cluster_args,
+    build_example_mesh,
+    per_process_batch,
+    run_train_loop,
+    stage_synthetic,
+)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    add_cluster_args(p)
+    p.add_argument("--network", default="resnet50", choices=["resnet50", "resnet18"])
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--num-examples", type=int, default=512)
+    p.add_argument("--label-smoothing", type=float, default=0.1)
+    args = p.parse_args()
+
+    from tpucfn.launch import initialize_runtime
+
+    initialize_runtime()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpucfn.data import ShardedDataset
+    from tpucfn.models import ResNet, ResNetConfig
+    from tpucfn.parallel import dense_rules
+    from tpucfn.train import Trainer
+
+    run_dir = Path(args.run_dir)
+    shards = stage_synthetic(
+        "imagenet", run_dir / "data", n=args.num_examples,
+        num_shards=max(8, jax.process_count()), seed=args.seed,
+        image_size=args.image_size,
+    )
+
+    mesh = build_example_mesh(args)
+    cfg = {"resnet50": ResNetConfig.resnet50, "resnet18": ResNetConfig.resnet18}[
+        args.network
+    ]()
+    model = ResNet(cfg)
+    sample = jnp.zeros((1, args.image_size, args.image_size, 3))
+
+    def init_fn(rng):
+        v = model.init(rng, sample, train=True)
+        return v["params"], {"batch_stats": v["batch_stats"]}
+
+    def loss_fn(params, mstate, batch, rng):
+        logits, upd = model.apply(
+            {"params": params, **mstate}, batch["image"], train=True,
+            mutable=["batch_stats"],
+        )
+        labels = optax.smooth_labels(
+            jax.nn.one_hot(batch["label"], cfg.num_classes), args.label_smoothing
+        )
+        loss = optax.softmax_cross_entropy(logits, labels).mean()
+        acc = jnp.mean(jnp.argmax(logits, -1) == batch["label"])
+        return loss, ({"accuracy": acc}, dict(upd))
+
+    # The standard 76%-top-1 recipe: SGD + momentum, cosine decay, warmup.
+    steps_total = args.steps or 1000
+    tx = optax.chain(
+        optax.add_decayed_weights(1e-4),
+        optax.sgd(
+            optax.warmup_cosine_decay_schedule(
+                0.0, args.lr, min(200, steps_total // 10), steps_total
+            ),
+            momentum=0.9, nesterov=True,
+        ),
+    )
+    trainer = Trainer(mesh, dense_rules(fsdp=args.fsdp > 1), loss_fn, tx, init_fn)
+    ds = ShardedDataset(shards, batch_size_per_process=per_process_batch(args),
+                        seed=args.seed)
+    run_train_loop(trainer, ds, mesh, args, items_per_step=args.batch_size)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Llama-3 8B FSDP pretraining/finetune (BASELINE config 4: "Llama-3 8B
+FSDP-style param sharding on v5p-64").
+
+Net-new capability vs the reference (its parallelism stopped at DP —
+SURVEY.md §2.3): params + optimizer state shard over the ``fsdp`` axis
+(ZeRO-3: XLA all-gathers params per layer, reduce-scatters grads),
+composable with --tensor (Megatron TP) and --context (ring-attention
+sequence parallelism for long --seq-len).
+
+    tpucfn launch examples/llama3_8b_fsdp.py -- \
+        --model 8b --fsdp 32 --tensor 2 --batch-size 64 --seq-len 8192
+
+``--model tiny`` runs the identical program shape on CPU/CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+from common import (  # noqa: E402
+    add_cluster_args,
+    build_example_mesh,
+    per_process_batch,
+    run_train_loop,
+    stage_synthetic,
+)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    add_cluster_args(p)
+    p.add_argument("--model", default="tiny", choices=["8b", "1b", "tiny"])
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--context", type=int, default=1,
+                   help="context (sequence-parallel) axis size; >1 enables "
+                        "ring attention")
+    p.add_argument("--num-examples", type=int, default=256)
+    p.add_argument("--z-loss", type=float, default=1e-4)
+    args = p.parse_args()
+
+    from tpucfn.launch import initialize_runtime
+
+    initialize_runtime()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpucfn.data import ShardedDataset
+    from tpucfn.kernels import make_ring_attention
+    from tpucfn.mesh import MeshSpec, build_mesh
+    from tpucfn.models.llama import Llama, LlamaConfig, causal_lm_loss, sharding_rules
+    from tpucfn.parallel import shard_batch  # noqa: F401  (doc pointer)
+    from tpucfn.train import Trainer, TrainerConfig
+
+    cfg = {
+        "8b": LlamaConfig.llama3_8b,
+        "1b": LlamaConfig.llama3_1b,
+        "tiny": LlamaConfig.tiny,
+    }[args.model]()
+
+    run_dir = Path(args.run_dir)
+    shards = stage_synthetic(
+        "tokens", run_dir / "data", n=args.num_examples,
+        num_shards=max(8, jax.process_count()), seed=args.seed,
+        seq_len=args.seq_len, vocab=cfg.vocab_size,
+    )
+
+    n = jax.device_count()
+    mesh = build_mesh(MeshSpec.for_devices(
+        n, fsdp=args.fsdp, tensor=args.tensor, context=args.context
+    ))
+    attention = (make_ring_attention(mesh) if args.context > 1 else None)
+    model = Llama(cfg, **({"attention_fn": attention} if attention else {}))
+    # init sample must divide evenly over the batch/context mesh axes
+    dp = mesh.shape["data"] * mesh.shape["fsdp"]
+    sample = jnp.zeros((dp, args.seq_len), jnp.int32)
+
+    def init_fn(rng):
+        return model.init(rng, sample)["params"], {}
+
+    def loss_fn(params, mstate, batch, rng):
+        logits = model.apply({"params": params}, batch["tokens"])
+        loss, acc = causal_lm_loss(logits, batch["tokens"], z_loss=args.z_loss)
+        return loss, ({"accuracy": acc}, mstate)
+
+    total = args.steps or 1000
+    tx = optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(
+            optax.warmup_cosine_decay_schedule(0.0, 3e-4,
+                                               max(1, min(100, total // 10)), total),
+            b1=0.9, b2=0.95, weight_decay=0.1,
+        ),
+    )
+    trainer = Trainer(
+        mesh, sharding_rules(cfg, tensor=args.tensor > 1), loss_fn, tx, init_fn,
+        config=TrainerConfig(
+            batch_extra_axes=("context",) if args.context > 1 else ()
+        ),
+    )
+    ds = ShardedDataset(shards, batch_size_per_process=per_process_batch(args),
+                        seed=args.seed)
+    run_train_loop(
+        trainer, ds, mesh, args,
+        items_per_step=args.batch_size * args.seq_len,  # tokens/sec
+        extra_axes=("context",) if args.context > 1 else (),
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Stable Diffusion 1.5 UNet finetune (BASELINE config 5: "S3→HBM image
+streaming path").
+
+Latent-diffusion ε-prediction finetuning of the SD-1.5-class UNet. The
+point of this config is the input path: latents/context records stream
+from sharded storage through the CRC-checked tpurecord reader (C++ when
+built) and the background device-prefetch queue straight onto the mesh —
+the tpucfn version of the reference's S3 staging hooks (SURVEY.md §2.1).
+
+``--tiny`` runs the CI-sized config; the full sd15 config is the real
+~0.9B-param UNet shape.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+from common import (  # noqa: E402
+    add_cluster_args,
+    build_example_mesh,
+    per_process_batch,
+    run_train_loop,
+    stage_synthetic,
+)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    add_cluster_args(p)
+    p.add_argument("--tiny", action="store_true", help="tiny config (CI)")
+    p.add_argument("--latent-size", type=int, default=0,
+                   help="latent H=W (default 64 full / 16 tiny)")
+    p.add_argument("--num-examples", type=int, default=256)
+    args = p.parse_args()
+
+    from tpucfn.launch import initialize_runtime
+
+    initialize_runtime()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpucfn.data import ShardedDataset
+    from tpucfn.models.unet import UNet, UNetConfig, ddpm_loss
+    from tpucfn.parallel import transformer_rules
+    from tpucfn.train import Trainer
+
+    cfg = UNetConfig.tiny() if args.tiny else UNetConfig.sd15()
+    hw = args.latent_size or (16 if args.tiny else 64)
+    ctx_len = 8 if args.tiny else 77
+
+    run_dir = Path(args.run_dir)
+    shards = stage_synthetic(
+        "latents", run_dir / "data", n=args.num_examples,
+        num_shards=max(8, jax.process_count()), seed=args.seed,
+        hw=hw, ctx_len=ctx_len, ctx_dim=cfg.context_dim,
+    )
+
+    mesh = build_example_mesh(args)
+    model = UNet(cfg)
+
+    def init_fn(rng):
+        return model.init(
+            rng, jnp.zeros((1, hw, hw, cfg.in_channels)),
+            jnp.zeros((1,), jnp.int32), jnp.zeros((1, ctx_len, cfg.context_dim)),
+        )["params"], {}
+
+    def loss_fn(params, mstate, batch, rng):
+        loss = ddpm_loss(model, params, batch, rng)
+        return loss, ({}, mstate)
+
+    tx = optax.adamw(args.lr if args.lr != 0.1 else 1e-5)  # finetune-scale default
+    trainer = Trainer(
+        mesh, transformer_rules(tensor=args.tensor > 1), loss_fn, tx, init_fn
+    )
+    ds = ShardedDataset(shards, batch_size_per_process=per_process_batch(args),
+                        seed=args.seed)
+    run_train_loop(trainer, ds, mesh, args, items_per_step=args.batch_size)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -37,7 +37,7 @@ def _run_example(run_dir, steps, resume=False, extra=()):
 def test_cifar10_example_end_to_end(tmp_path):
     r = _run_example(tmp_path, steps=10)
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
-    assert "images/sec" in r.stdout
+    assert "items/sec" in r.stdout
 
     # metrics were logged as JSONL with loss/accuracy/step_time
     logs = list((tmp_path / "logs").glob("*.jsonl"))

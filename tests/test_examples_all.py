@@ -1,0 +1,67 @@
+"""Every bundled example runs end-to-end (tiny configs, few steps) on the
+8-fake-device CPU mesh in a subprocess — BASELINE configs 2-5.
+(Config 1, CIFAR-10, has its own deeper test in test_example_cifar10.py.)
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _run(script, run_dir, *extra):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    )
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [
+        sys.executable, str(REPO / "examples" / script),
+        "--run-dir", str(run_dir),
+        "--steps", "3", "--ckpt-every", "100", "--log-every", "1",
+        *extra,
+    ]
+    return subprocess.run(cmd, env=env, capture_output=True, text=True, timeout=600)
+
+
+def _ok(r):
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "final: step=3" in r.stdout
+
+
+def test_imagenet_resnet50_example(tmp_path):
+    # resnet18 at 64px keeps the CPU run quick; same code path as resnet50
+    _ok(_run("imagenet_resnet50.py", tmp_path, "--network", "resnet18",
+             "--image-size", "64", "--batch-size", "16", "--num-examples", "64"))
+
+
+def test_bert_base_example(tmp_path):
+    _ok(_run("bert_base.py", tmp_path, "--tiny", "--seq-len", "32",
+             "--batch-size", "16", "--num-examples", "64"))
+
+
+def test_llama_fsdp_example(tmp_path):
+    _ok(_run("llama3_8b_fsdp.py", tmp_path, "--model", "tiny", "--seq-len", "32",
+             "--batch-size", "16", "--num-examples", "64", "--fsdp", "2"))
+
+
+def test_llama_ring_attention_example(tmp_path):
+    _ok(_run("llama3_8b_fsdp.py", tmp_path, "--model", "tiny", "--seq-len", "64",
+             "--batch-size", "8", "--num-examples", "32", "--context", "4"))
+
+
+def test_sd15_unet_example(tmp_path):
+    _ok(_run("sd15_unet.py", tmp_path, "--tiny", "--batch-size", "8",
+             "--num-examples", "32"))
+
+
+@pytest.mark.parametrize("flag", ["--fsdp", "--tensor"])
+def test_bert_parallel_modes(tmp_path, flag):
+    _ok(_run("bert_base.py", tmp_path, "--tiny", "--seq-len", "32",
+             "--batch-size", "16", "--num-examples", "64", flag, "2"))

@@ -1,3 +1,8 @@
 from tpucfn.data.records import RecordShardWriter, read_record_shard, write_dataset_shards  # noqa: F401
 from tpucfn.data.pipeline import ShardedDataset, prefetch_to_mesh  # noqa: F401
-from tpucfn.data.synthetic import synthetic_cifar10, synthetic_imagenet  # noqa: F401
+from tpucfn.data.synthetic import (  # noqa: F401
+    synthetic_cifar10,
+    synthetic_imagenet,
+    synthetic_latents,
+    synthetic_tokens,
+)
