@@ -1,0 +1,146 @@
+"""Compat shims (kvstore/horovod) + ZeRO-1 sharded optimizer + restart
+supervisor."""
+
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import tpucfn.compat.horovod as hvd
+from tpucfn.compat import kvstore_create
+from tpucfn.parallel import ShardingRules, shard_batch, zero1_rules
+from tpucfn.train import Trainer
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ---- kvstore shim -------------------------------------------------------
+
+
+def test_kvstore_dist_sync_maps_to_dp():
+    kv = kvstore_create("dist_sync")
+    assert kv.num_workers == jax.process_count()
+    assert kv.rank == jax.process_index()
+    specs = kv.rules().spec_for("anything/kernel", 2)
+    assert specs == P()
+
+
+def test_kvstore_dist_async_rejected_with_guidance():
+    with pytest.raises(NotImplementedError, match="dist_sync"):
+        kvstore_create("dist_async")
+
+
+def test_kvstore_unknown_mode():
+    with pytest.raises(ValueError):
+        kvstore_create("dist_quantum")
+
+
+# ---- horovod shim -------------------------------------------------------
+
+
+def test_horovod_surface():
+    hvd.init()  # no cluster env -> no-op
+    assert hvd.rank() == 0
+    assert hvd.size() == 1
+    assert hvd.local_rank() == 0
+    tx = optax.adam(1e-3)
+    assert hvd.DistributedOptimizer(tx) is tx
+    hvd.broadcast_parameters(None, root_rank=0)
+
+
+# ---- ZeRO-1 -------------------------------------------------------------
+
+
+def _mlp_init(rng):
+    k1, k2 = jax.random.split(rng)
+    return {
+        "fc1": {"kernel": jax.random.normal(k1, (4, 32)) * 0.1, "bias": jnp.zeros(32)},
+        "fc2": {"kernel": jax.random.normal(k2, (32, 8)) * 0.1, "bias": jnp.zeros(8)},
+    }, {}
+
+
+def _mlp_loss(params, mstate, batch, rng):
+    h = jnp.tanh(batch["x"] @ params["fc1"]["kernel"] + params["fc1"]["bias"])
+    pred = h @ params["fc2"]["kernel"] + params["fc2"]["bias"]
+    return jnp.mean((pred - batch["y"]) ** 2), ({}, mstate)
+
+
+def _rules_dense_fsdp():
+    return ShardingRules(((r"(fc1|fc2)/kernel$", P(None, "fsdp")), (r".*", P())))
+
+
+def test_zero1_params_replicated_optstate_sharded(mesh8):
+    rules = zero1_rules(_rules_dense_fsdp())
+    trainer = Trainer(mesh8, rules, _mlp_loss, optax.adam(1e-2), _mlp_init)
+    state = trainer.init(jax.random.key(0))
+    # params fully replicated
+    assert state.params["fc1"]["kernel"].sharding.spec == P()
+    # adam mu sharded over fsdp on the same dim the model rules name
+    mu = state.opt_state[0].mu["fc1"]["kernel"]
+    assert mu.sharding.spec == P(None, "fsdp")
+    assert mu.addressable_shards[0].data.shape == (4, 16)
+
+
+def test_zero1_training_matches_replicated(mesh8):
+    rs = np.random.RandomState(0)
+    batch_np = {"x": rs.randn(16, 4).astype(np.float32),
+                "y": rs.randn(16, 8).astype(np.float32)}
+    losses = {}
+    for name, rules in [
+        ("dp", ShardingRules(((r".*", P()),))),
+        ("zero1", zero1_rules(_rules_dense_fsdp())),
+    ]:
+        trainer = Trainer(mesh8, rules, _mlp_loss, optax.adam(1e-2), _mlp_init)
+        state = trainer.init(jax.random.key(0))
+        batch = shard_batch(mesh8, batch_np)
+        for _ in range(5):
+            state, m = trainer.step(state, batch)
+        losses[name] = float(m["loss"])
+    np.testing.assert_allclose(losses["dp"], losses["zero1"], rtol=1e-5)
+
+
+# ---- restart supervisor -------------------------------------------------
+
+
+def test_run_with_restarts_recovers(tmp_path):
+    from tpucfn.bootstrap import EnvContract
+    from tpucfn.launch import Launcher, LocalTransport, run_with_restarts
+
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("127.0.0.1:0\n")
+    contract = EnvContract(
+        workers_path=str(hostfile), workers_count=1, worker_chip_count=1,
+        coordinator="127.0.0.1:0", host_id=0, storage=str(tmp_path), generation=1,
+    )
+    launcher = Launcher(contract, LocalTransport())
+    marker = tmp_path / "attempts"
+    # crash on the first attempt, succeed on the second (≈ resume path)
+    script = (
+        "import pathlib,sys;p=pathlib.Path(r'%s');"
+        "n=int(p.read_text()) if p.exists() else 0;p.write_text(str(n+1));"
+        "sys.exit(1 if n==0 else 0)" % marker
+    )
+    rc = run_with_restarts(launcher, [sys.executable, "-c", script], max_restarts=2)
+    assert rc == 0
+    assert marker.read_text() == "2"
+
+
+def test_run_with_restarts_gives_up(tmp_path):
+    from tpucfn.bootstrap import EnvContract
+    from tpucfn.launch import Launcher, LocalTransport, run_with_restarts
+
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("127.0.0.1:0\n")
+    contract = EnvContract(
+        workers_path=str(hostfile), workers_count=1, worker_chip_count=1,
+        coordinator="127.0.0.1:0", host_id=0, storage=str(tmp_path), generation=1,
+    )
+    launcher = Launcher(contract, LocalTransport())
+    rc = run_with_restarts(launcher, [sys.executable, "-c", "import sys;sys.exit(7)"],
+                           max_restarts=2)
+    assert rc == 7

@@ -119,8 +119,9 @@ def cmd_launch(args) -> int:
         print("error: no command given (use: tpucfn launch --name X -- cmd…)",
               file=sys.stderr)
         return 2
-    procs = launcher.launch(argv)
-    rc = launcher.wait(procs)
+    from tpucfn.launch import run_with_restarts
+
+    rc = run_with_restarts(launcher, argv, max_restarts=args.restarts)
     print(f"launch finished rc={rc}")
     return rc
 
@@ -174,6 +175,9 @@ def build_parser() -> argparse.ArgumentParser:
     l = sub.add_parser("launch", help="fan a command out across all hosts")
     l.add_argument("--name", required=True)
     l.add_argument("--transport", choices=["local", "ssh"], default="local")
+    l.add_argument("--restarts", type=int, default=0,
+                   help="auto-relaunch the gang up to N times on failure "
+                        "(jobs resume from their latest checkpoint)")
     l.add_argument("cmd", nargs=argparse.REMAINDER)
     l.set_defaults(fn=cmd_launch)
 

@@ -1,0 +1,2 @@
+from tpucfn.compat.kvstore import create as kvstore_create  # noqa: F401
+from tpucfn.compat import horovod  # noqa: F401

@@ -1,0 +1,57 @@
+"""Horovod-shaped compat surface.
+
+The reference's TF path wrapped everything in Horovod (SURVEY.md §3.3):
+``hvd.init()``, rank/size queries, ``DistributedOptimizer`` hooking a
+tensor-fusion NCCL all-reduce behind the optimizer. On tpucfn the SPMD
+program *is* the distribution, so these become thin queries/no-ops with
+the same signatures — a port of a Horovod-era script keeps its structure
+and loses the wrapper cost.
+
+    import tpucfn.compat.horovod as hvd
+    hvd.init()                      # jax.distributed via the env contract
+    hvd.rank(), hvd.size()          # process index / count
+    hvd.local_rank()                # host-local index (always 0: one
+                                    # process drives all local chips)
+    tx = hvd.DistributedOptimizer(optax.adam(1e-3))  # identity: psum is
+                                    # already in the compiled step
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def init() -> None:
+    from tpucfn.launch import initialize_runtime
+
+    initialize_runtime()
+
+
+def rank() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def size() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def local_rank() -> int:
+    # One tpucfn process drives every local chip (vs Horovod's
+    # process-per-GPU), so the local rank is always 0.
+    return 0
+
+
+def DistributedOptimizer(tx: optax.GradientTransformation, **_ignored) -> optax.GradientTransformation:
+    """Identity: gradient averaging is part of the jit-compiled step (the
+    batch is sharded, so XLA emits the psum Horovod's hook existed to
+    provide)."""
+    return tx
+
+
+def broadcast_parameters(*args, **kwargs) -> None:
+    """No-op: Trainer.init creates params *born sharded/replicated* on
+    their target devices; there is no rank-0 copy to broadcast."""

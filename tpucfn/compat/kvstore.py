@@ -1,0 +1,63 @@
+"""MXNet-KVStore-shaped compat surface.
+
+The reference's training scripts selected their distribution mode with
+``--kv-store dist_sync`` and programmatically via
+``mx.kvstore.create("dist_sync")`` (SURVEY.md §3.2); under it, ps-lite
+servers held weights and every batch did push(grad)/pull(weights) over
+TCP. tpucfn has no parameter server — synchronous DP is one SPMD program
+with a compiler-emitted gradient psum over ICI (SURVEY.md §2.3 row 1) —
+but scripts keep working: this shim accepts the same mode strings and
+returns an object describing the equivalent tpucfn configuration (and
+raises with a pointed message for modes whose *semantics* don't exist on
+TPU, i.e. async PS).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from jax.sharding import PartitionSpec as P
+
+from tpucfn.parallel.sharding import ShardingRules
+
+_SYNC_MODES = {"local", "device", "dist_sync", "dist_sync_device"}
+_ASYNC_MODES = {"dist_async"}
+
+
+@dataclasses.dataclass(frozen=True)
+class KVStoreShim:
+    """What a kv-store mode means here: a sharding-rule choice, not a
+    server fleet. ``rank``/``num_workers`` mirror the KVStore attributes
+    scripts read for epoch math."""
+
+    type: str
+
+    @property
+    def rank(self) -> int:
+        import jax
+
+        return jax.process_index()
+
+    @property
+    def num_workers(self) -> int:
+        import jax
+
+        return jax.process_count()
+
+    def rules(self) -> ShardingRules:
+        """Replicated params; gradient reduction is implicit in the SPMD
+        step — exactly dist_sync's convergence semantics at none of its
+        wire cost."""
+        return ShardingRules(((r".*", P()),))
+
+
+def create(mode: str = "local") -> KVStoreShim:
+    if mode in _SYNC_MODES:
+        return KVStoreShim(type=mode)
+    if mode in _ASYNC_MODES:
+        raise NotImplementedError(
+            "dist_async was a ps-lite artifact (stale-gradient updates to a "
+            "server copy). The TPU path is synchronous SPMD; use dist_sync "
+            "(same convergence contract the reference's examples used)."
+        )
+    raise ValueError(f"unknown kv-store mode {mode!r}")

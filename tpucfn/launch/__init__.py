@@ -3,4 +3,5 @@ from tpucfn.launch.launcher import (  # noqa: F401
     LocalTransport,
     SSHTransport,
     initialize_runtime,
+    run_with_restarts,
 )

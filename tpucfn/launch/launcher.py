@@ -117,6 +117,35 @@ class Launcher:
         return rc
 
 
+def run_with_restarts(
+    launcher: "Launcher",
+    argv: Sequence[str],
+    *,
+    max_restarts: int = 0,
+    backoff_s: float = 0.0,
+) -> int:
+    """Supervise a job: relaunch the whole gang after a failure.
+
+    The recovery contract from SURVEY.md §5 (failure detection row): a TPU
+    slice is not elastic, so recovery is re-launch + resume-from-
+    checkpoint — jobs written with tpucfn's CheckpointManager pick up at
+    their latest step (the examples' ``--resume`` path). The reference's
+    answer here was "the training job dies and is re-run by hand"; this
+    automates the re-run.
+    """
+    import time
+
+    attempt = 0
+    while True:
+        procs = launcher.launch(argv)
+        rc = launcher.wait(procs)
+        if rc == 0 or attempt >= max_restarts:
+            return rc
+        attempt += 1
+        if backoff_s:
+            time.sleep(backoff_s)
+
+
 def initialize_runtime(contract: EnvContract | None = None) -> EnvContract | None:
     """Per-process entry: join the cluster rendezvous.
 

@@ -11,4 +11,6 @@ from tpucfn.parallel.presets import (  # noqa: F401
     PRESETS,
     dense_rules,
     transformer_rules,
+    zero1_rules,
 )
+from tpucfn.parallel.pipeline import gpipe, microbatch, unmicrobatch  # noqa: F401

@@ -90,9 +90,32 @@ def transformer_rules(
     )
 
 
+def zero1_rules(model_rules: ShardingRules | None = None) -> ShardingRules:
+    """ZeRO-1: replicated params, optimizer state sharded over ``fsdp``.
+
+    The "dist_sync compat at scale" preset (SURVEY.md §2.3 row 1: PS-style
+    API maps onto sharded-optimizer DP): forward/backward see replicated
+    params (no per-layer all-gathers like full FSDP), but the optimizer
+    moments — 2× param memory under Adam — shard over the fsdp axis.
+
+    Mechanism: optax state mirrors the param tree under ``mu``/``nu``/
+    ``trace``, so each sharded rule of ``model_rules`` (default: the
+    fsdp dense preset) is re-scoped to those subtrees; bare param paths
+    fall through to the replicated tail.
+    """
+    model_rules = model_rules or dense_rules(fsdp=True)
+    opt_scoped = tuple(
+        ((r"(^|/)(mu|nu|trace)/.*" + pat.lstrip("^")), spec)
+        for pat, spec in model_rules.rules
+        if tuple(spec) != ()
+    )
+    return ShardingRules(opt_scoped + _REPLICATED_TAIL)
+
+
 PRESETS = {
     "dp": lambda: dense_rules(fsdp=False),
     "fsdp_dense": lambda: dense_rules(fsdp=True),
+    "zero1": lambda: zero1_rules(),
     "transformer": lambda: transformer_rules(),
     "transformer_tp_only": lambda: transformer_rules(fsdp=False),
     "transformer_fsdp_only": lambda: transformer_rules(tensor=False),
