@@ -14,6 +14,13 @@ from pathlib import Path
 import jax
 
 
+def start_profiler_server(port: int = 9012) -> None:
+    """Start the per-host profiler server so XProf/TensorBoard can attach
+    a live capture to any host in the fleet (the launcher calls this when
+    ``--profile-server`` is set)."""
+    jax.profiler.start_server(port)
+
+
 @contextlib.contextmanager
 def profile_steps(log_dir: str | Path, *, enabled: bool = True):
     """Trace everything inside the context into ``log_dir`` (one trace per

@@ -16,6 +16,7 @@ detection row and §7.2 step 4).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import enum
 import itertools
@@ -95,6 +96,25 @@ class FakeControlPlane(ControlPlane):
             self._load()
 
     # -- persistence -----------------------------------------------------
+    #
+    # Concurrent CLI invocations (e.g. a health-monitor loop racing a user
+    # resize) serialize on an flock'd sidecar; writes are atomic
+    # (tmp + rename) so readers never observe a torn JSON — the
+    # control-plane-race concern from SURVEY.md §5 (race detection row).
+
+    @contextlib.contextmanager
+    def _locked(self):
+        import fcntl
+        from pathlib import Path
+
+        lock_path = Path(self._state_file).with_suffix(".lock")
+        lock_path.parent.mkdir(parents=True, exist_ok=True)
+        with open(lock_path, "w") as lk:
+            fcntl.flock(lk, fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(lk, fcntl.LOCK_UN)
 
     def _load(self) -> None:
         import json
@@ -103,7 +123,8 @@ class FakeControlPlane(ControlPlane):
         p = Path(self._state_file)
         if not p.exists():
             return
-        raw = json.loads(p.read_text())
+        with self._locked():
+            raw = json.loads(p.read_text())
         for name, rec in raw.get("clusters", {}).items():
             self._clusters[name] = ClusterRecord(
                 spec=ClusterSpec.from_json(rec["spec"]),
@@ -140,7 +161,10 @@ class FakeControlPlane(ControlPlane):
         }
         p = Path(self._state_file)
         p.parent.mkdir(parents=True, exist_ok=True)
-        p.write_text(json.dumps(data, indent=2))
+        with self._locked():
+            tmp = p.with_suffix(".tmp")
+            tmp.write_text(json.dumps(data, indent=2))
+            tmp.replace(p)
 
     # -- ControlPlane ----------------------------------------------------
 

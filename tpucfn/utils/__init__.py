@@ -1,0 +1,1 @@
+from tpucfn.utils.tree import param_count, param_bytes, tree_paths, describe_params  # noqa: F401

@@ -1,0 +1,45 @@
+"""Pytree inspection helpers shared by examples, tests, and the CLI."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def _path_str(path) -> str:
+    parts = []
+    for k in path:
+        parts.append(str(getattr(k, "key", getattr(k, "name", getattr(k, "idx", k)))))
+    return "/".join(parts)
+
+
+def tree_paths(tree: Any) -> list[tuple[str, Any]]:
+    """[(path_string, leaf), ...] in deterministic order."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [(_path_str(p), leaf) for p, leaf in flat]
+
+
+def param_count(tree: Any) -> int:
+    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(tree))
+
+
+def param_bytes(tree: Any) -> int:
+    return sum(int(np.prod(x.shape)) * x.dtype.itemsize for x in jax.tree.leaves(tree))
+
+
+def describe_params(tree: Any, *, max_rows: int = 0) -> str:
+    """Human-readable table: path, shape, dtype, sharding (if placed)."""
+    rows = []
+    for path, leaf in tree_paths(tree):
+        sharding = ""
+        spec = getattr(getattr(leaf, "sharding", None), "spec", None)
+        if spec is not None:
+            sharding = f"  {spec}"
+        rows.append(f"{path:60s} {str(leaf.shape):20s} {leaf.dtype}{sharding}")
+    if max_rows and len(rows) > max_rows:
+        rows = rows[:max_rows] + [f"... ({len(rows) - max_rows} more)"]
+    total = param_count(tree)
+    rows.append(f"total params: {total:,} ({param_bytes(tree) / 1e9:.2f} GB)")
+    return "\n".join(rows)
