@@ -38,6 +38,11 @@ def main() -> int:
     p.add_argument("--context", type=int, default=1,
                    help="context (sequence-parallel) axis size; >1 enables "
                         "ring attention")
+    p.add_argument("--pipeline", type=int, default=1,
+                   help="pipeline stages; >1 runs the GPipe schedule with "
+                        "stage-sharded layers (excludes --tensor/--context "
+                        "in this version)")
+    p.add_argument("--microbatches", type=int, default=4)
     p.add_argument("--num-examples", type=int, default=256)
     p.add_argument("--z-loss", type=float, default=1e-4)
     args = p.parse_args()
@@ -70,9 +75,12 @@ def main() -> int:
         seq_len=args.seq_len, vocab=cfg.vocab_size,
     )
 
+    if args.pipeline > 1 and (args.tensor > 1 or args.context > 1):
+        raise SystemExit("--pipeline composes with --fsdp/data only (PARITY.md)")
     n = jax.device_count()
     mesh = build_mesh(MeshSpec.for_devices(
-        n, fsdp=args.fsdp, tensor=args.tensor, context=args.context
+        n, fsdp=args.fsdp, tensor=args.tensor, context=args.context,
+        pipeline=args.pipeline,
     ))
     attention = (make_ring_attention(mesh) if args.context > 1 else None)
     model = Llama(cfg, **({"attention_fn": attention} if attention else {}))
@@ -83,8 +91,18 @@ def main() -> int:
     def init_fn(rng):
         return model.init(rng, sample)["params"], {}
 
+    if args.pipeline > 1:
+        from tpucfn.models.llama_pp import pipelined_llama_apply
+
+        def forward(params, tokens):
+            return pipelined_llama_apply(cfg, mesh, params, tokens,
+                                         num_microbatches=args.microbatches)
+    else:
+        def forward(params, tokens):
+            return model.apply({"params": params}, tokens)
+
     def loss_fn(params, mstate, batch, rng):
-        logits = model.apply({"params": params}, batch["tokens"])
+        logits = forward(params, batch["tokens"])
         loss, acc = causal_lm_loss(logits, batch["tokens"], z_loss=args.z_loss)
         return loss, ({"accuracy": acc}, mstate)
 
@@ -97,8 +115,14 @@ def main() -> int:
             b1=0.9, b2=0.95, weight_decay=0.1,
         ),
     )
+    if args.pipeline > 1:
+        from tpucfn.models.llama_pp import pp_sharding_rules
+
+        rules = pp_sharding_rules(cfg)
+    else:
+        rules = sharding_rules(cfg, tensor=args.tensor > 1)
     trainer = Trainer(
-        mesh, sharding_rules(cfg, tensor=args.tensor > 1), loss_fn, tx, init_fn,
+        mesh, rules, loss_fn, tx, init_fn,
         config=TrainerConfig(
             batch_extra_axes=("context",) if args.context > 1 else ()
         ),

@@ -56,6 +56,12 @@ def test_llama_ring_attention_example(tmp_path):
              "--batch-size", "8", "--num-examples", "32", "--context", "4"))
 
 
+def test_llama_pipeline_example(tmp_path):
+    _ok(_run("llama3_8b_fsdp.py", tmp_path, "--model", "tiny", "--seq-len", "32",
+             "--batch-size", "16", "--num-examples", "64", "--pipeline", "2",
+             "--microbatches", "2"))
+
+
 def test_sd15_unet_example(tmp_path):
     _ok(_run("sd15_unet.py", tmp_path, "--tiny", "--batch-size", "8",
              "--num-examples", "32"))

@@ -1,0 +1,103 @@
+"""Pipeline-parallel execution of the Llama stack.
+
+Same params, different schedule: the scanned Llama param tree (leading
+``layers`` axis) is sharded over the ``pipeline`` mesh axis — stage p
+holds layers [p·L/P, (p+1)·L/P) — and the forward runs the GPipe
+microbatch schedule from :mod:`tpucfn.parallel.pipeline` inside a
+``shard_map``. Embedding, final norm, and LM head compute replicated on
+every stage (cheap relative to the block stack; revisit for huge vocab).
+
+Composition in this version: pipeline × data (batch shards ride along as
+unsharded-per-stage slices; the only cross-shard traffic is the
+stage-boundary ppermute). TP/FSDP × PP composition is a known gap tracked
+in PARITY.md.
+
+Checkpoints interchange with the plain :class:`tpucfn.models.llama.Llama`
+— the param tree is identical; only placement and schedule differ.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+import flax.linen as nn
+
+from tpucfn.mesh import AXIS_PIPELINE, BATCH_AXES
+from tpucfn.models.layers import RMSNorm
+from tpucfn.models.llama import LlamaBlock, LlamaConfig
+from tpucfn.ops.attention import dot_product_attention
+from tpucfn.parallel.pipeline import gpipe, microbatch, unmicrobatch
+from tpucfn.parallel.sharding import ShardingRules
+
+
+def pp_sharding_rules(cfg: LlamaConfig) -> ShardingRules:
+    """Stage-sharded layout: every scanned block param shards its leading
+    (layer) dim over ``pipeline``; embed/norm/head replicate."""
+    if not cfg.scan_layers:
+        raise ValueError("pipeline execution needs scan_layers=True (stacked params)")
+    return ShardingRules((
+        (r"(^|/)layers/", P(AXIS_PIPELINE)),
+        (r".*", P()),
+    ))
+
+
+def pipelined_llama_apply(
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    params,
+    tokens: jax.Array,
+    *,
+    num_microbatches: int = 4,
+) -> jax.Array:
+    """tokens (B, S) → logits (B, S, vocab), numerically equal to
+    ``Llama(cfg).apply`` with the same params (tests assert it)."""
+    if not cfg.scan_layers:
+        raise ValueError("pipeline execution needs scan_layers=True")
+
+    embed = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype)
+    x = embed.apply({"params": params["embed_tokens"]}, tokens)
+
+    def stage_fn(stage_params, h):
+        """Apply this stage's layer slice (lax.scan over local layers)."""
+
+        def body(carry, layer_params):
+            if cfg.remat:
+                apply = jax.checkpoint(
+                    lambda p, c: LlamaBlock(cfg, dot_product_attention).apply(
+                        {"params": p}, c
+                    )[0],
+                    prevent_cse=False,
+                )
+                carry = apply(layer_params, carry)
+            else:
+                carry, _ = LlamaBlock(cfg, dot_product_attention).apply(
+                    {"params": layer_params}, carry
+                )
+            return carry, None
+
+        (h_out, _), _ = lax.scan(body, (h, jnp.zeros((), jnp.int32)), stage_params)
+        return h_out
+
+    mb = microbatch(x, num_microbatches)  # (M, B/M, S, D)
+    layer_specs = jax.tree.map(lambda _: P(AXIS_PIPELINE), params["layers"])
+    mb_spec = P(None, BATCH_AXES)
+
+    run = jax.shard_map(
+        lambda p, xs: gpipe(stage_fn, p, xs),
+        mesh=mesh,
+        in_specs=(layer_specs, mb_spec),
+        out_specs=mb_spec,
+        check_vma=False,
+    )
+    x = unmicrobatch(run(params["layers"], mb))
+
+    x = RMSNorm(cfg.norm_eps, cfg.dtype).apply({"params": params["final_norm"]}, x)
+    logits = nn.DenseGeneral(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                             param_dtype=cfg.param_dtype).apply(
+        {"params": params["lm_head"]}, x.astype(jnp.float32)
+    )
+    return logits
