@@ -30,17 +30,38 @@ REFERENCE_IMAGES_PER_SEC_PER_ACCEL = 400.0  # V100 ResNet-50 fp16, reference-era
 
 def main() -> int:
     import jax
+
+    # Persistent XLA compilation cache: the second "create-stack → first
+    # step" on the same pod skips recompilation (SURVEY.md §7.4 item 6 —
+    # keep the time-to-first-step metric from being compile-dominated).
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("TPUCFN_XLA_CACHE", "/tmp/tpucfn_xla_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     import jax.numpy as jnp
     import numpy as np
     import optax
 
+    from tpucfn.bootstrap import converge
     from tpucfn.mesh import MeshSpec, build_mesh
     from tpucfn.models import ResNet, ResNetConfig
     from tpucfn.parallel import dense_rules, shard_batch
+    from tpucfn.provision import FakeControlPlane, Provisioner
+    from tpucfn.spec import ClusterSpec
     from tpucfn.train import Trainer
 
     tiny = os.environ.get("TPUCFN_BENCH_PRESET") == "tiny"
     n_dev = jax.device_count()
+
+    # --- "create-stack" leg of time-to-first-step (BASELINE metric 2).
+    # The control plane here is the in-process fake (this environment has
+    # no cloud API); what it measures is the framework's own overhead:
+    # provisioning state machine + bootstrap convergence + contract load.
+    t_stack0 = time.perf_counter()
+    prov = Provisioner(FakeControlPlane(steps_to_provision=1))
+    rec = prov.create(ClusterSpec(name="bench", accelerator="cpu-1"))
+    converge(rec, "/tmp/tpucfn-bench-run")
+    provision_s = time.perf_counter() - t_stack0
 
     if tiny:
         cfg = ResNetConfig(stage_sizes=(1, 1, 1), num_classes=10, bottleneck=False,
@@ -122,6 +143,7 @@ def main() -> int:
             "mean_step_s": round(mean_step, 5),
             "compile_s": round(compile_s, 2),
             "init_s": round(init_s, 2),
+            "time_to_first_step_s": round(provision_s + init_s + compile_s, 2),
             "final_loss": round(final_loss, 4),
         },
     }))

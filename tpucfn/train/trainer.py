@@ -16,6 +16,7 @@ import dataclasses
 from typing import Any, Callable
 
 import jax
+import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -43,6 +44,11 @@ class TrainerConfig:
     # Extra sharded batch dims after the leading batch axis, e.g.
     # ("context",) when sequence parallelism is on.
     batch_extra_axes: tuple[str | None, ...] = ()
+    # Gradient accumulation: split each global batch into this many
+    # sequential microbatches inside the step (lax.scan), average grads,
+    # apply once. Raises the effective batch without raising peak
+    # activation memory — the non-pipeline sibling of GPipe microbatching.
+    grad_accum: int = 1
 
 
 class Trainer:
@@ -124,11 +130,50 @@ class Trainer:
 
     # ---- step ----------------------------------------------------------
 
+    def _grads(self, state: TrainState, batch: Any, step_rng: jax.Array):
+        accum = self.config.grad_accum
+        grad_fn = jax.value_and_grad(self.loss_fn, has_aux=True)
+        if accum <= 1:
+            (loss, (aux, new_model_state)), grads = grad_fn(
+                state.params, state.model_state, batch, step_rng
+            )
+            return loss, aux, new_model_state, grads
+
+        micro = jax.tree.map(
+            lambda x: x.reshape(accum, x.shape[0] // accum, *x.shape[1:]), batch
+        )
+
+        def body(carry, mb):
+            grads_acc, loss_acc, aux_acc, mstate, i = carry
+            (loss, (aux, mstate)), grads = grad_fn(
+                state.params, mstate, mb, jax.random.fold_in(step_rng, i)
+            )
+            grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+            loss_acc = loss_acc + loss
+            aux_acc = jax.tree.map(jnp.add, aux_acc, aux)
+            return (grads_acc, loss_acc, aux_acc, mstate, i + 1), None
+
+        zero_grads = jax.tree.map(jnp.zeros_like, state.params)
+        mb0 = jax.tree.map(lambda x: x[0], micro)
+        _, (aux0, _) = jax.eval_shape(
+            lambda: self.loss_fn(state.params, state.model_state, mb0, step_rng)
+        )
+        zero_aux = jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype), aux0)
+        (grads, loss, aux, new_model_state, _), _ = jax.lax.scan(
+            body,
+            (zero_grads, jnp.zeros((), jnp.float32), zero_aux, state.model_state,
+             jnp.zeros((), jnp.int32)),
+            micro,
+        )
+        inv = 1.0 / accum
+        return (loss * inv,
+                jax.tree.map(lambda a: a * inv, aux),
+                new_model_state,
+                jax.tree.map(lambda g: g * inv, grads))
+
     def _step_fn(self, state: TrainState, batch: Any):
         step_rng = jax.random.fold_in(state.rng, state.step)
-        (loss, (aux, new_model_state)), grads = jax.value_and_grad(
-            self.loss_fn, has_aux=True
-        )(state.params, state.model_state, batch, step_rng)
+        loss, aux, new_model_state, grads = self._grads(state, batch, step_rng)
         updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(
