@@ -1,0 +1,82 @@
+"""Autoregressive generation with a KV cache.
+
+The serving-side counterpart of the training stack (net-new vs the
+reference, which was a training-only harness): prefill runs the prompt
+through the decode-mode model once (populating each layer's KV cache),
+then a ``lax.scan`` emits one token per step attending over the cached
+prefix — O(S) memory and O(S·D) work per token instead of re-running the
+full forward. Greedy (temperature=0) or temperature sampling.
+
+The decode-mode model shares the *exact* param tree with the training
+model — checkpoints flow straight from `Trainer` to `generate`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from tpucfn.models.llama import Llama, LlamaConfig
+
+
+def generate(
+    cfg: LlamaConfig,
+    params,
+    prompt: jax.Array,  # (B, T) int32
+    *,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: jax.Array | None = None,
+    cache_len: int | None = None,
+) -> jax.Array:
+    """Returns (B, T + max_new_tokens) tokens (prompt included)."""
+    b, t = prompt.shape
+    total = t + max_new_tokens
+    cache_len = cache_len or total
+    if cache_len < total:
+        raise ValueError(f"cache_len {cache_len} < prompt+new {total}")
+    # The cache sizes itself from max_seq; cap it to what this call needs.
+    dcfg = dataclasses.replace(cfg, max_seq=max(cache_len, cfg.max_seq)
+                               if cfg.max_seq < cache_len else cfg.max_seq)
+    model = Llama(dcfg, decode=True)
+    if rng is None:
+        rng = jax.random.key(0)
+
+    # Materialize zero caches with the right shapes (params are reused).
+    cache = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), jnp.zeros((b, 1), jnp.int32))
+    )["cache"]
+    cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache)
+
+    # Prefill: one pass over the prompt fills every layer's cache.
+    logits, muts = model.apply(
+        {"params": params, "cache": cache}, prompt, mutable=["cache"]
+    )
+    cache = muts["cache"]
+
+    def sample(logits_last, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(key, logits_last / temperature, axis=-1).astype(
+            jnp.int32
+        )
+
+    first = sample(logits[:, -1], rng)
+
+    def step(carry, key):
+        cache, tok = carry
+        logits, muts = model.apply(
+            {"params": params, "cache": cache}, tok[:, None], mutable=["cache"]
+        )
+        nxt = sample(logits[:, -1], key)
+        return (muts["cache"], nxt), tok
+
+    # first is generated token 1; each scan step consumes the previous
+    # token and samples the next, so max_new-1 steps complete the budget.
+    keys = jax.random.split(jax.random.fold_in(rng, 1), max_new_tokens - 1)
+    (_, last), toks = jax.lax.scan(step, (cache, first), keys)
+    parts = [toks.T, last[:, None]] if max_new_tokens > 1 else [last[:, None]]
+    generated = jnp.concatenate(parts, axis=1)  # (B, max_new)
+    return jnp.concatenate([prompt, generated], axis=1)

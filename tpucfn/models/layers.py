@@ -56,7 +56,13 @@ AttentionFn = Callable[..., jax.Array]
 
 class CausalSelfAttention(nn.Module):
     """GQA self-attention with RoPE; the attention inner op is pluggable so
-    dense/flash/ring implementations swap without touching the module."""
+    dense/flash/ring implementations swap without touching the module.
+
+    ``decode=True`` turns on the autoregressive KV cache (flax ``cache``
+    collection): each call appends this step's K/V at ``cache_index`` and
+    attends over the whole prefix — the serving path. Cache capacity is
+    ``max_seq``.
+    """
 
     n_heads: int
     n_kv_heads: int
@@ -66,6 +72,7 @@ class CausalSelfAttention(nn.Module):
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     attention_fn: AttentionFn = dot_product_attention
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, *, positions=None, q_offset=0):
@@ -81,14 +88,42 @@ class CausalSelfAttention(nn.Module):
         k = k.reshape(b, s, self.n_kv_heads, self.head_dim)
         v = v.reshape(b, s, self.n_kv_heads, self.head_dim)
 
-        if positions is None:
-            positions = jnp.arange(s) + q_offset
         cos, sin = rope_frequencies(self.head_dim, self.max_seq, self.rope_theta)
-        q = apply_rope(q, cos, sin, positions)
-        k = apply_rope(k, cos, sin, positions)
 
-        out = self.attention_fn(q, k, v, causal=True,
-                                q_offset=q_offset, k_offset=q_offset)
+        if self.decode:
+            cached_k = self.variable(
+                "cache", "cached_key", jnp.zeros,
+                (b, self.max_seq, self.n_kv_heads, self.head_dim), self.dtype,
+            )
+            cached_v = self.variable(
+                "cache", "cached_value", jnp.zeros,
+                (b, self.max_seq, self.n_kv_heads, self.head_dim), self.dtype,
+            )
+            cache_index = self.variable(
+                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            i = cache_index.value
+            positions = i + jnp.arange(s)
+            q = apply_rope(q, cos, sin, positions)
+            k = apply_rope(k, cos, sin, positions)
+            k_all = jax.lax.dynamic_update_slice(
+                cached_k.value, k.astype(self.dtype), (0, i, 0, 0))
+            v_all = jax.lax.dynamic_update_slice(
+                cached_v.value, v.astype(self.dtype), (0, i, 0, 0))
+            cached_k.value = k_all
+            cached_v.value = v_all
+            cache_index.value = i + s
+            # q lives at global positions [i, i+s); cache slots beyond are
+            # zeros and masked out by causality.
+            out = self.attention_fn(q, k_all, v_all, causal=True,
+                                    q_offset=i, k_offset=0)
+        else:
+            if positions is None:
+                positions = jnp.arange(s) + q_offset
+            q = apply_rope(q, cos, sin, positions)
+            k = apply_rope(k, cos, sin, positions)
+            out = self.attention_fn(q, k, v, causal=True,
+                                    q_offset=q_offset, k_offset=q_offset)
         out = out.reshape(b, s, self.n_heads * self.head_dim)
         return dense(x.shape[-1], "o_proj")(out)
 

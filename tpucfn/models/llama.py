@@ -79,6 +79,7 @@ class LlamaBlock(nn.Module):
 
     cfg: LlamaConfig
     attention_fn: AttentionFn = dot_product_attention
+    decode: bool = False
 
     @nn.compact
     def __call__(self, carry, _=None):
@@ -88,7 +89,7 @@ class LlamaBlock(nn.Module):
             n_heads=cfg.n_heads, n_kv_heads=cfg.n_kv_heads, head_dim=cfg.head_dim,
             rope_theta=cfg.rope_theta, max_seq=cfg.max_seq, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, attention_fn=self.attention_fn,
-            name="attn",
+            decode=self.decode, name="attn",
         )(RMSNorm(cfg.norm_eps, cfg.dtype, name="input_norm")(x), q_offset=q_offset)
         x = x + h
         normed = RMSNorm(cfg.norm_eps, cfg.dtype, name="post_attn_norm")(x)
@@ -103,6 +104,7 @@ class LlamaBlock(nn.Module):
 class Llama(nn.Module):
     cfg: LlamaConfig
     attention_fn: AttentionFn = dot_product_attention
+    decode: bool = False  # KV-cache autoregressive mode (generation)
 
     @nn.compact
     def __call__(self, tokens, *, q_offset=0):
@@ -118,20 +120,21 @@ class Llama(nn.Module):
         )(tokens)
 
         block = LlamaBlock
-        if cfg.remat:
+        if cfg.remat and not self.decode:
             block = nn.remat(block, prevent_cse=False)
         carry = (x, jnp.asarray(q_offset))
         if cfg.scan_layers:
             carry, _ = nn.scan(
                 block,
-                variable_axes={"params": 0, "losses": 0, "metrics": 0},
+                variable_axes={"params": 0, "losses": 0, "metrics": 0, "cache": 0},
                 split_rngs={"params": True},
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(cfg, self.attention_fn, name="layers")(carry)
+            )(cfg, self.attention_fn, self.decode, name="layers")(carry)
         else:
             for i in range(cfg.n_layers):
-                carry, _ = block(cfg, self.attention_fn, name=f"layers_{i}")(carry)
+                carry, _ = block(cfg, self.attention_fn, self.decode,
+                                 name=f"layers_{i}")(carry)
         x = carry[0]
 
         x = RMSNorm(cfg.norm_eps, cfg.dtype, name="final_norm")(x)
