@@ -98,6 +98,35 @@ def test_generate_single_token():
     assert out.shape == (2, 5)
 
 
+def test_generate_rejects_zero_new_tokens():
+    cfg = _cfg()
+    params = _params(cfg)
+    import pytest
+
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        generate(cfg, params, jnp.ones((1, 4), jnp.int32), max_new_tokens=0)
+
+
+def test_decode_past_capacity_poisons_output():
+    import dataclasses as dc
+
+    cfg = dc.replace(_cfg(), max_seq=8)
+    params = _params(cfg)
+    dec = Llama(cfg, decode=True)
+    cache = jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype),
+        jax.eval_shape(lambda: dec.init(jax.random.key(0),
+                                        jnp.zeros((1, 1), jnp.int32)))["cache"],
+    )
+    tok = jnp.ones((1, 1), jnp.int32)
+    for i in range(10):
+        logits, muts = dec.apply({"params": params, "cache": cache}, tok,
+                                 mutable=["cache"])
+        cache = muts["cache"]
+        finite = bool(jnp.isfinite(logits).all())
+        assert finite == (i < 8), f"step {i}: finite={finite}"
+
+
 def test_generate_temperature_sampling_runs():
     cfg = _cfg()
     params = _params(cfg)

@@ -33,13 +33,16 @@ def generate(
 ) -> jax.Array:
     """Returns (B, T + max_new_tokens) tokens (prompt included)."""
     b, t = prompt.shape
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     total = t + max_new_tokens
-    cache_len = cache_len or total
+    if cache_len is None:
+        cache_len = total
     if cache_len < total:
         raise ValueError(f"cache_len {cache_len} < prompt+new {total}")
-    # The cache sizes itself from max_seq; cap it to what this call needs.
-    dcfg = dataclasses.replace(cfg, max_seq=max(cache_len, cfg.max_seq)
-                               if cfg.max_seq < cache_len else cfg.max_seq)
+    # The cache (and RoPE tables) size from max_seq; cap to this call's
+    # needs so short generations don't pay full-context attention.
+    dcfg = dataclasses.replace(cfg, max_seq=cache_len)
     model = Llama(dcfg, decode=True)
     if rng is None:
         rng = jax.random.key(0)
@@ -71,12 +74,10 @@ def generate(
             {"params": params, "cache": cache}, tok[:, None], mutable=["cache"]
         )
         nxt = sample(logits[:, -1], key)
-        return (muts["cache"], nxt), tok
+        return (muts["cache"], nxt), nxt
 
-    # first is generated token 1; each scan step consumes the previous
-    # token and samples the next, so max_new-1 steps complete the budget.
+    # first is generated token 1; each scan step samples one more.
     keys = jax.random.split(jax.random.fold_in(rng, 1), max_new_tokens - 1)
-    (_, last), toks = jax.lax.scan(step, (cache, first), keys)
-    parts = [toks.T, last[:, None]] if max_new_tokens > 1 else [last[:, None]]
-    generated = jnp.concatenate(parts, axis=1)  # (B, max_new)
+    _, toks = jax.lax.scan(step, (cache, first), keys)  # (max_new-1, B)
+    generated = jnp.concatenate([first[:, None], toks.T], axis=1)  # (B, max_new)
     return jnp.concatenate([prompt, generated], axis=1)

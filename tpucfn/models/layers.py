@@ -91,6 +91,14 @@ class CausalSelfAttention(nn.Module):
         cos, sin = rope_frequencies(self.head_dim, self.max_seq, self.rope_theta)
 
         if self.decode:
+            # Positions come from the cache index; a caller-supplied
+            # schedule (the ring/SP path) is incompatible with decode.
+            # q_offset arrives as the model's traced zero and is ignored.
+            if positions is not None:
+                raise ValueError(
+                    "decode mode derives positions from the KV cache index; "
+                    "explicit positions are not supported together with decode"
+                )
             cached_k = self.variable(
                 "cache", "cached_key", jnp.zeros,
                 (b, self.max_seq, self.n_kv_heads, self.head_dim), self.dtype,
@@ -117,6 +125,10 @@ class CausalSelfAttention(nn.Module):
             # zeros and masked out by causality.
             out = self.attention_fn(q, k_all, v_all, causal=True,
                                     q_offset=i, k_offset=0)
+            # Past-capacity decoding would silently clamp the RoPE gather
+            # and the cache write; poison the output instead so overflow is
+            # loud (NaNs) rather than quietly wrong.
+            out = jnp.where(i + s <= self.max_seq, out, jnp.nan)
         else:
             if positions is None:
                 positions = jnp.arange(s) + q_offset

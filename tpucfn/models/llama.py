@@ -113,6 +113,8 @@ class Llama(nn.Module):
         ``q_offset`` is the global position of tokens[:, 0] — nonzero when
         the sequence axis is sharded (ring attention / SP).
         """
+        if self.decode and not (isinstance(q_offset, int) and q_offset == 0):
+            raise ValueError("decode mode is incompatible with q_offset/SP sharding")
         cfg = self.cfg
         x = nn.Embed(
             cfg.vocab_size, cfg.dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
