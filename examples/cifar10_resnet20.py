@@ -97,7 +97,16 @@ def main() -> int:
 
     ds = ShardedDataset(shards, batch_size_per_process=per_process_batch(args),
                         seed=args.seed)
-    run_train_loop(trainer, ds, mesh, args, items_per_step=args.batch_size)
+    eval_ds = None
+    if args.eval_every:
+        eval_shards = stage_synthetic(
+            "cifar10", run_dir / "eval", n=max(64, args.num_examples // 4),
+            num_shards=max(8, jax.process_count()), seed=args.seed + 1,
+        )
+        eval_ds = ShardedDataset(eval_shards, shuffle=False,
+                                 batch_size_per_process=per_process_batch(args))
+    run_train_loop(trainer, ds, mesh, args, items_per_step=args.batch_size,
+                   eval_ds=eval_ds)
     return 0
 
 

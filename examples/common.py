@@ -28,6 +28,8 @@ def add_cluster_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--ckpt-every", type=int, default=200)
     p.add_argument("--resume", action="store_true",
                    help="resume from the latest checkpoint in --run-dir")
+    p.add_argument("--eval-every", type=int, default=0,
+                   help="run validation over the held-out split every N steps")
     p.add_argument("--profile", action="store_true",
                    help="capture a jax.profiler trace of steps 10-20")
     # Parallelism surface (reference exposed only worker count; SURVEY §2.3
@@ -82,17 +84,36 @@ def stage_synthetic(kind: str, data_dir: Path, *, n: int, num_shards: int,
                                 num_shards=num_shards)
 
 
-def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=()):
-    """The shared epoch/step/checkpoint/metrics loop every example uses."""
+def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=(),
+                   eval_ds=None):
+    """The shared epoch/step/checkpoint/metrics loop every example uses.
+
+    ``eval_ds`` + ``--eval-every N`` runs inference-mode validation (the
+    trainer's eval_loss_fn) over the held-out split and logs ``eval_*``
+    metrics — the measurement path for accuracy targets like the 76%
+    top-1 north star (BASELINE.md)."""
     import jax
 
     from tpucfn.ckpt import CheckpointManager
     from tpucfn.data import prefetch_to_mesh
     from tpucfn.obs import MetricLogger, StepTimer, profile_steps
+    from tpucfn.parallel import shard_batch
 
     run_dir = Path(args.run_dir)
     logger = MetricLogger(run_dir / "logs", stdout_every=args.log_every)
     timer = StepTimer()
+
+    def run_eval(state, step):
+        if eval_ds is None or not args.eval_every:
+            return
+        sums, n = {}, 0
+        for host_batch in eval_ds.epoch(0):
+            m = trainer.eval_step(state, shard_batch(mesh, host_batch, extra_axes))
+            for k, v in m.items():
+                sums[k] = sums.get(k, 0.0) + float(v)
+            n += 1
+        if n:
+            logger.log(step, {f"eval_{k}": v / n for k, v in sums.items()})
     with CheckpointManager(run_dir / "ckpt",
                            save_interval_steps=args.ckpt_every) as ckpt:
         if args.resume and ckpt.latest_step() is not None:
@@ -114,7 +135,10 @@ def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=()):
                 if step % args.log_every == 0 or step == total:
                     logger.log(step, {**{k: float(v) for k, v in metrics.items()},
                                       "step_time": timer._last or 0.0})
+                if args.eval_every and step % args.eval_every == 0:
+                    run_eval(state, step)
                 ckpt.save(step, state)
+        run_eval(state, int(state.step))
         ckpt.save(int(state.step), state, force=True)
 
     if jax.process_index() == 0:

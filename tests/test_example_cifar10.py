@@ -60,3 +60,13 @@ def test_cifar10_example_end_to_end(tmp_path):
 def test_cifar10_example_fsdp_mode(tmp_path):
     r = _run_example(tmp_path, steps=4, extra=("--fsdp", "2"))
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+
+
+def test_cifar10_example_eval_split(tmp_path):
+    r = _run_example(tmp_path, steps=6, extra=("--eval-every", "3"))
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    logs = list((tmp_path / "logs").glob("*.jsonl"))
+    records = [json.loads(line) for line in logs[0].read_text().splitlines()]
+    eval_recs = [rec for rec in records if "eval_accuracy" in rec]
+    assert eval_recs, records
+    assert all("eval_loss" in rec for rec in eval_recs)

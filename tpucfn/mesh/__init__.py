@@ -9,5 +9,6 @@ from tpucfn.mesh.mesh import (  # noqa: F401
     BATCH_AXES,
     MeshSpec,
     build_mesh,
+    build_multislice_mesh,
     local_mesh_devices,
 )

@@ -131,3 +131,71 @@ def local_mesh_devices(mesh: Mesh) -> list[jax.Device]:
     """Devices of ``mesh`` attached to this process (host-local shard of the
     fleet — the analogue of one row of the reference's hostfile)."""
     return [d for d in mesh.devices.flat if d.process_index == jax.process_index()]
+
+
+# Axes whose collectives are once-per-step and bandwidth-light enough to
+# ride DCN between slices; everything else must stay inside a slice (ICI).
+DCN_FRIENDLY_AXES = (AXIS_PIPELINE, AXIS_DATA)
+
+
+def build_multislice_mesh(
+    spec: MeshSpec,
+    *,
+    num_slices: int,
+    dcn_axis: str = AXIS_DATA,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Mesh for a multislice fleet: ``dcn_axis`` spans the slices (DCN),
+    every other axis stays inside one slice (ICI).
+
+    The two-tier fabric decision from SURVEY.md §2.4: gradient reduction
+    (data) or stage hand-off (pipeline) per step is the only traffic that
+    crosses DCN; TP/SP/FSDP collectives never leave a slice. Devices are
+    grouped by ``slice_index`` when the platform reports it (real
+    multislice TPU); otherwise (CPU tests, single slice) contiguous
+    device-id blocks stand in for slices — same layout math either way.
+    """
+    if dcn_axis not in DCN_FRIENDLY_AXES:
+        raise ValueError(
+            f"dcn_axis {dcn_axis!r} is latency/bandwidth-bound; only "
+            f"{DCN_FRIENDLY_AXES} may span slices"
+        )
+    if devices is None:
+        devices = jax.devices()
+    if getattr(spec, dcn_axis) != num_slices:
+        raise ValueError(
+            f"spec.{dcn_axis}={getattr(spec, dcn_axis)} must equal "
+            f"num_slices={num_slices} (one shard per slice)"
+        )
+    spec.validate(len(devices))
+    if len(devices) % num_slices:
+        raise ValueError(f"{len(devices)} devices not divisible by {num_slices} slices")
+
+    per_slice = len(devices) // num_slices
+    if all(getattr(d, "slice_index", None) is not None for d in devices):
+        groups: dict[int, list[jax.Device]] = {}
+        for d in devices:
+            groups.setdefault(d.slice_index, []).append(d)
+        if len(groups) != num_slices or any(len(g) != per_slice for g in groups.values()):
+            raise ValueError(
+                f"device slice topology {[len(g) for g in groups.values()]} "
+                f"!= {num_slices}x{per_slice}"
+            )
+        slices = [sorted(groups[i], key=lambda d: d.id) for i in sorted(groups)]
+    else:
+        devs = list(devices)
+        slices = [devs[i * per_slice:(i + 1) * per_slice] for i in range(num_slices)]
+
+    # Lay out: dcn axis strides across slices; intra-slice axes tile the
+    # devices of one slice exactly as build_mesh would.
+    intra_sizes = tuple(
+        1 if name == dcn_axis else getattr(spec, name) for name in ALL_AXES
+    )
+    arr = np.empty(spec.axis_sizes, dtype=object)
+    dcn_pos = ALL_AXES.index(dcn_axis)
+    for si, sdevs in enumerate(slices):
+        block = np.asarray(sdevs).reshape(intra_sizes)
+        index = [slice(None)] * len(ALL_AXES)
+        index[dcn_pos] = si
+        arr[tuple(index)] = block.squeeze(axis=dcn_pos)
+    return Mesh(arr, ALL_AXES)
