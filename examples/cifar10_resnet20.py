@@ -39,6 +39,8 @@ def main() -> int:
     p.add_argument("--network", default="resnet20", choices=["resnet20", "resnet32"])
     p.add_argument("--num-examples", type=int, default=2048,
                    help="synthetic dataset size to stage")
+    p.add_argument("--augment", action="store_true",
+                   help="pad-crop + mirror augmentation (the CIFAR recipe)")
     args = p.parse_args()
 
     from tpucfn.launch import initialize_runtime
@@ -95,8 +97,13 @@ def main() -> int:
     trainer = Trainer(mesh, dense_rules(fsdp=args.fsdp > 1), loss_fn, tx, init_fn,
                       eval_loss_fn=eval_loss_fn)
 
+    transform = None
+    if args.augment:
+        from tpucfn.data.transforms import CIFAR_TRAIN
+
+        transform = CIFAR_TRAIN
     ds = ShardedDataset(shards, batch_size_per_process=per_process_batch(args),
-                        seed=args.seed)
+                        seed=args.seed, transform=transform)
     eval_ds = None
     if args.eval_every:
         eval_shards = stage_synthetic(

@@ -34,6 +34,8 @@ def main() -> int:
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--num-examples", type=int, default=512)
     p.add_argument("--label-smoothing", type=float, default=0.1)
+    p.add_argument("--augment", action="store_true",
+                   help="inception-style random-resized-crop + mirror")
     args = p.parse_args()
 
     from tpucfn.launch import initialize_runtime
@@ -91,8 +93,13 @@ def main() -> int:
         ),
     )
     trainer = Trainer(mesh, dense_rules(fsdp=args.fsdp > 1), loss_fn, tx, init_fn)
+    transform = None
+    if args.augment:
+        from tpucfn.data.transforms import Compose, random_flip, random_resized_crop
+
+        transform = Compose([random_resized_crop(args.image_size), random_flip()])
     ds = ShardedDataset(shards, batch_size_per_process=per_process_batch(args),
-                        seed=args.seed)
+                        seed=args.seed, transform=transform)
     run_train_loop(trainer, ds, mesh, args, items_per_step=args.batch_size)
     return 0
 

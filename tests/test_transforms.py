@@ -1,0 +1,68 @@
+import numpy as np
+
+from tpucfn.data import ShardedDataset, synthetic_cifar10, write_dataset_shards
+from tpucfn.data.transforms import (
+    CIFAR_TRAIN,
+    Compose,
+    normalize,
+    random_crop,
+    random_flip,
+    random_resized_crop,
+)
+
+
+def _img(h=8, w=8):
+    return {"image": np.arange(h * w * 3, dtype=np.float32).reshape(h, w, 3),
+            "label": np.int32(1)}
+
+
+def test_flip_is_mirror():
+    rs = np.random.RandomState(0)
+    ex = _img()
+    flipped_any = False
+    for _ in range(20):
+        out = random_flip()(ex, rs)
+        assert out["image"].shape == ex["image"].shape
+        if not np.array_equal(out["image"], ex["image"]):
+            np.testing.assert_array_equal(out["image"], ex["image"][:, ::-1])
+            flipped_any = True
+    assert flipped_any
+
+
+def test_crop_preserves_shape_and_content_window():
+    rs = np.random.RandomState(0)
+    out = random_crop(2)(_img(), rs)
+    assert out["image"].shape == (8, 8, 3)
+
+
+def test_resized_crop_output_shape():
+    rs = np.random.RandomState(0)
+    out = random_resized_crop(16)({"image": np.random.rand(64, 48, 3).astype(np.float32)}, rs)
+    assert out["image"].shape == (16, 16, 3)
+
+
+def test_normalize():
+    rs = np.random.RandomState(0)
+    ex = {"image": np.ones((4, 4, 3), np.float32) * 2}
+    out = normalize([1, 1, 1], [2, 2, 2])(ex, rs)
+    np.testing.assert_allclose(out["image"], 0.5)
+
+
+def test_compose_order():
+    rs = np.random.RandomState(0)
+    t = Compose([normalize([0, 0, 0], [2, 2, 2]), normalize([1, 1, 1], [1, 1, 1])])
+    out = t({"image": np.full((2, 2, 3), 4.0, np.float32)}, rs)
+    np.testing.assert_allclose(out["image"], 1.0)  # (4/2) - 1
+
+
+def test_dataset_transform_deterministic_per_epoch(tmp_path):
+    paths = write_dataset_shards(synthetic_cifar10(32), tmp_path, num_shards=2)
+    mk = lambda: ShardedDataset(  # noqa: E731
+        paths, batch_size_per_process=8, transform=CIFAR_TRAIN, seed=3
+    )
+    a = [b["image"] for b in mk().epoch(0)]
+    b = [b["image"] for b in mk().epoch(0)]
+    c = [b_["image"] for b_ in mk().epoch(1)]
+    np.testing.assert_array_equal(np.stack(a), np.stack(b))
+    assert not np.array_equal(np.stack(a), np.stack(c))  # new epoch, new augs
+    assert a[0].shape == (8, 32, 32, 3)

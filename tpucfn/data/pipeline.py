@@ -45,6 +45,7 @@ class ShardedDataset:
         drop_remainder: bool = True,
         process_index: int | None = None,
         process_count: int | None = None,
+        transform=None,  # per-example Transform (tpucfn.data.transforms)
     ):
         if not shard_paths:
             raise ValueError("no shard paths given")
@@ -61,6 +62,7 @@ class ShardedDataset:
         self.shuffle = shuffle
         self.seed = seed
         self.drop_remainder = drop_remainder
+        self.transform = transform
         self._cache: list[dict[str, np.ndarray]] | None = None
 
     def _load(self) -> list[dict[str, np.ndarray]]:
@@ -89,15 +91,20 @@ class ShardedDataset:
             # Epoch-keyed seed, offset by process so local orders differ
             # but are reproducible.
             np.random.RandomState((self.seed, epoch, self.pi)).shuffle(order)
+        # One augmentation stream per (seed, epoch, process): consumed in
+        # iteration order, so any batch is reproducible from its epoch.
+        aug_rs = np.random.RandomState((self.seed, epoch, self.pi, 7))
+
+        def emit(idx):
+            chosen = [examples[i] for i in idx]
+            if self.transform is not None:
+                chosen = [self.transform(ex, aug_rs) for ex in chosen]
+            return {k: np.stack([ex[k] for ex in chosen]) for k in chosen[0]}
+
         for start in range(0, len(order) - self.batch + 1, self.batch):
-            idx = order[start : start + self.batch]
-            yield {
-                k: np.stack([examples[i][k] for i in idx])
-                for k in examples[0]
-            }
+            yield emit(order[start : start + self.batch])
         if not self.drop_remainder and len(order) % self.batch:
-            idx = order[len(order) - len(order) % self.batch :]
-            yield {k: np.stack([examples[i][k] for i in idx]) for k in examples[0]}
+            yield emit(order[len(order) - len(order) % self.batch :])
 
     def batches(self, num_epochs: int | None = None) -> Iterator[dict[str, np.ndarray]]:
         e = 0

@@ -1,0 +1,99 @@
+"""Input transforms — the preprocessing half of the staging path.
+
+The reference's examples leaned on MXNet DataIter's built-in augmentation
+(random crop/mirror for CIFAR, inception-style crops for ImageNet —
+SURVEY.md §3.2's DataIter frame). tpucfn keeps preprocessing on the host
+side of the S3→HBM path as pure numpy, seeded per (epoch, batch) so any
+host can reproduce any batch — determinism the reference's pipeline never
+had (SURVEY.md §7.4 item 1).
+
+All transforms take and return example dicts; compose with ``Compose``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import numpy as np
+
+Transform = Callable[[dict, np.random.RandomState], dict]
+
+
+class Compose:
+    def __init__(self, transforms: Sequence[Transform]):
+        self.transforms = tuple(transforms)
+
+    def __call__(self, ex: dict, rs: np.random.RandomState) -> dict:
+        for t in self.transforms:
+            ex = t(ex, rs)
+        return ex
+
+
+def random_flip(key: str = "image") -> Transform:
+    def t(ex, rs):
+        if rs.rand() < 0.5:
+            ex = {**ex, key: ex[key][:, ::-1]}
+        return ex
+
+    return t
+
+
+def random_crop(padding: int = 4, key: str = "image") -> Transform:
+    """Pad-and-crop (the CIFAR recipe): reflect-pad then take a random
+    window of the original size."""
+
+    def t(ex, rs):
+        img = ex[key]
+        h, w = img.shape[:2]
+        padded = np.pad(img, ((padding, padding), (padding, padding), (0, 0)),
+                        mode="reflect")
+        y = rs.randint(0, 2 * padding + 1)
+        x = rs.randint(0, 2 * padding + 1)
+        return {**ex, key: padded[y:y + h, x:x + w]}
+
+    return t
+
+
+def random_resized_crop(out_hw: int, *, min_area: float = 0.08,
+                        key: str = "image") -> Transform:
+    """Inception-style crop (the ImageNet ResNet-50 recipe): random area/
+    aspect window, resized to ``out_hw`` (nearest-neighbor — host-side
+    cheap; bilinear differences wash out under training noise)."""
+
+    def t(ex, rs):
+        img = ex[key]
+        h, w = img.shape[:2]
+        for _ in range(10):
+            area = rs.uniform(min_area, 1.0) * h * w
+            aspect = np.exp(rs.uniform(np.log(3 / 4), np.log(4 / 3)))
+            ch = int(round(np.sqrt(area / aspect)))
+            cw = int(round(np.sqrt(area * aspect)))
+            if ch <= h and cw <= w and ch > 0 and cw > 0:
+                y = rs.randint(0, h - ch + 1)
+                x = rs.randint(0, w - cw + 1)
+                crop = img[y:y + ch, x:x + cw]
+                break
+        else:
+            side = min(h, w)
+            crop = img[(h - side) // 2:(h + side) // 2,
+                       (w - side) // 2:(w + side) // 2]
+        yy = (np.arange(out_hw) * crop.shape[0] / out_hw).astype(np.int64)
+        xx = (np.arange(out_hw) * crop.shape[1] / out_hw).astype(np.int64)
+        return {**ex, key: crop[yy][:, xx]}
+
+    return t
+
+
+def normalize(mean: Sequence[float], std: Sequence[float],
+              key: str = "image") -> Transform:
+    m = np.asarray(mean, np.float32)
+    s = np.asarray(std, np.float32)
+
+    def t(ex, rs):
+        return {**ex, key: (ex[key].astype(np.float32) - m) / s}
+
+    return t
+
+
+CIFAR_TRAIN = Compose([random_crop(4), random_flip()])
+IMAGENET_TRAIN = Compose([random_resized_crop(224), random_flip()])
