@@ -10,7 +10,14 @@ runtime initialization no-ops outside a cluster.
 from __future__ import annotations
 
 import argparse
+import sys
 from pathlib import Path
+
+# Examples are runnable from a bare checkout (`python examples/x.py`)
+# without installing the package: put the repo root ahead on sys.path.
+_REPO_ROOT = str(Path(__file__).resolve().parent.parent)
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
 import jax
 
