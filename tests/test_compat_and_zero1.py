@@ -130,6 +130,35 @@ def test_run_with_restarts_recovers(tmp_path):
     assert marker.read_text() == "2"
 
 
+def test_kill_host_after_then_recover(tmp_path):
+    """The SURVEY §5 fault-injection drill: a rank is killed mid-run on
+    attempt 1; the supervisor relaunches and the job completes."""
+    from tpucfn.bootstrap import EnvContract
+    from tpucfn.launch import Launcher, LocalTransport, run_with_restarts
+
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("127.0.0.1:0\n127.0.0.1:0\n")
+    contract = EnvContract(
+        workers_path=str(hostfile), workers_count=2, worker_chip_count=1,
+        coordinator="127.0.0.1:0", host_id=0, storage=str(tmp_path), generation=1,
+    )
+    launcher = Launcher(contract, LocalTransport())
+    marker = tmp_path / "done"
+    script = (
+        "import os,time,pathlib\n"
+        "time.sleep(1.0)\n"
+        f"pathlib.Path(r'{marker}').mkdir(exist_ok=True)\n"
+        f"pathlib.Path(r'{marker}').joinpath(os.environ['TPUCFN_HOST_ID']"
+        ").write_text('ok')\n"
+    )
+    rc = run_with_restarts(
+        launcher, [sys.executable, "-c", script],
+        max_restarts=1, kill_host_after=(1, 0.2),
+    )
+    assert rc == 0
+    assert sorted(p.name for p in marker.iterdir()) == ["0", "1"]
+
+
 def test_run_with_restarts_gives_up(tmp_path):
     from tpucfn.bootstrap import EnvContract
     from tpucfn.launch import Launcher, LocalTransport, run_with_restarts

@@ -121,7 +121,12 @@ def cmd_launch(args) -> int:
         return 2
     from tpucfn.launch import run_with_restarts
 
-    rc = run_with_restarts(launcher, argv, max_restarts=args.restarts)
+    inject = None
+    if args.kill_host_after:
+        host_s, _, secs = args.kill_host_after.partition(":")
+        inject = (int(host_s), float(secs))
+    rc = run_with_restarts(launcher, argv, max_restarts=args.restarts,
+                           kill_host_after=inject)
     print(f"launch finished rc={rc}")
     return rc
 
@@ -178,6 +183,9 @@ def build_parser() -> argparse.ArgumentParser:
     l.add_argument("--restarts", type=int, default=0,
                    help="auto-relaunch the gang up to N times on failure "
                         "(jobs resume from their latest checkpoint)")
+    l.add_argument("--kill-host-after", metavar="HOST:SECONDS",
+                   help="fault injection: SIGKILL host's rank after N "
+                        "seconds on the first attempt (recovery drill)")
     l.add_argument("cmd", nargs=argparse.REMAINDER)
     l.set_defaults(fn=cmd_launch)
 

@@ -77,13 +77,36 @@ class Launcher:
         env["TPUCFN_HOST_ID"] = str(host_id)
         return env
 
-    def launch(self, argv: Sequence[str]) -> list[subprocess.Popen]:
+    def launch(
+        self,
+        argv: Sequence[str],
+        *,
+        kill_host_after: tuple[int, float] | None = None,
+    ) -> list[subprocess.Popen]:
         """Start ``argv`` on every host; returns the Popen handles (the
-        local handle for LocalTransport, the ssh client handles for SSH)."""
+        local handle for LocalTransport, the ssh client handles for SSH).
+
+        ``kill_host_after=(host_id, seconds)`` is the fault-injection hook
+        (SURVEY.md §5): a timer SIGKILLs that host's process mid-run so
+        recovery paths (fail-fast wait, --restarts resume) can be
+        exercised deterministically in tests and drills.
+        """
         hosts = self.contract.hosts()
         procs = []
         for host_id, host in enumerate(hosts):
             procs.append(self.transport.run(host, argv, self.host_env(host_id)))
+        if kill_host_after is not None:
+            import threading
+
+            victim, delay = kill_host_after
+
+            def _kill(p=procs[victim]):
+                if p.poll() is None:
+                    p.kill()
+
+            t = threading.Timer(delay, _kill)
+            t.daemon = True
+            t.start()
         return procs
 
     def wait(self, procs: list[subprocess.Popen], poll_interval: float = 0.05) -> int:
@@ -123,6 +146,7 @@ def run_with_restarts(
     *,
     max_restarts: int = 0,
     backoff_s: float = 0.0,
+    kill_host_after: tuple[int, float] | None = None,
 ) -> int:
     """Supervise a job: relaunch the whole gang after a failure.
 
@@ -137,7 +161,10 @@ def run_with_restarts(
 
     attempt = 0
     while True:
-        procs = launcher.launch(argv)
+        # Fault injection fires on the first attempt only — the drill is
+        # "die once, recover from checkpoint".
+        inject = kill_host_after if attempt == 0 else None
+        procs = launcher.launch(argv, kill_host_after=inject)
         rc = launcher.wait(procs)
         if rc == 0 or attempt >= max_restarts:
             return rc
