@@ -124,7 +124,12 @@ def cmd_launch(args) -> int:
     inject = None
     if args.kill_host_after:
         host_s, _, secs = args.kill_host_after.partition(":")
-        inject = (int(host_s), float(secs))
+        try:
+            inject = (int(host_s), float(secs))
+        except ValueError:
+            print(f"error: --kill-host-after wants HOST:SECONDS (e.g. 1:30), "
+                  f"got {args.kill_host_after!r}", file=sys.stderr)
+            return 2
     rc = run_with_restarts(launcher, argv, max_restarts=args.restarts,
                            kill_host_after=inject)
     print(f"launch finished rc={rc}")
