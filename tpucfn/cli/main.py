@@ -130,6 +130,10 @@ def cmd_launch(args) -> int:
             print(f"error: --kill-host-after wants HOST:SECONDS (e.g. 1:30), "
                   f"got {args.kill_host_after!r}", file=sys.stderr)
             return 2
+        if not 0 <= inject[0] < len(contract.hosts()):
+            print(f"error: --kill-host-after host {inject[0]} out of range "
+                  f"(cluster has {len(contract.hosts())} hosts)", file=sys.stderr)
+            return 2
     rc = run_with_restarts(launcher, argv, max_restarts=args.restarts,
                            kill_host_after=inject)
     print(f"launch finished rc={rc}")
