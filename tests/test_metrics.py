@@ -1,0 +1,50 @@
+import json
+
+import pytest
+
+from tpucfn.obs import MetricLogger, StepTimer
+
+
+def test_jsonl_records(tmp_path):
+    logger = MetricLogger(tmp_path, stdout_every=0)
+    logger.log(1, {"loss": 2.5, "note": "hi"})
+    logger.log(2, {"loss": 2.0})
+    logger.close()
+    lines = [json.loads(line) for line in logger.path.read_text().splitlines()]
+    assert lines[0]["loss"] == 2.5
+    assert lines[0]["note"] == "hi"
+    assert lines[1]["step"] == 2
+
+
+def test_tensorboard_events_written(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    logger = MetricLogger(tmp_path, stdout_every=0, tensorboard=True)
+    if logger._tb is None:
+        pytest.skip("tf.summary unavailable")
+    logger.log(1, {"loss": 1.5})
+    logger.close()
+    events = list((tmp_path / "tb").glob("events.out.tfevents.*"))
+    assert events and events[0].stat().st_size > 0
+    del tf
+
+
+def test_step_timer_warmup_exclusion():
+    t = StepTimer(warmup=1)
+    import time
+
+    t.tick()
+    time.sleep(0.01)
+    t.tick()  # warmup tick, excluded
+    time.sleep(0.01)
+    t.tick()
+    assert t.mean_step_time is not None
+    assert t.throughput(100) > 0
+    assert t.per_chip_throughput(100) is not None
+
+
+def test_step_timer_no_steady_state_is_none():
+    t = StepTimer(warmup=5)
+    t.tick()
+    t.tick()
+    assert t.mean_step_time is None
+    assert t.throughput(10) is None

@@ -67,14 +67,27 @@ class MetricLogger:
         *,
         stdout_every: int = 10,
         name: str = "train",
+        tensorboard: bool = False,
     ):
+        """``tensorboard=True`` additionally writes tf.summary scalar
+        events (rank 0 only) next to the JSONL, so `tensorboard --logdir`
+        shows curves alongside XProf traces; gated on tensorflow being
+        importable — JSONL remains the always-on source of truth."""
         self.path = None
         self._f = None
+        self._tb = None
         if log_dir is not None:
             d = Path(log_dir)
             d.mkdir(parents=True, exist_ok=True)
             self.path = d / f"{name}-host{jax.process_index():03d}.jsonl"
             self._f = open(self.path, "a", buffering=1)
+            if tensorboard and jax.process_index() == 0:
+                try:
+                    import tensorflow as tf  # baked into the image; optional
+
+                    self._tb = tf.summary.create_file_writer(str(d / "tb"))
+                except ImportError:
+                    pass
         self.stdout_every = stdout_every
         self.name = name
 
@@ -87,6 +100,13 @@ class MetricLogger:
                 record[k] = str(v)
         if self._f is not None:
             self._f.write(json.dumps(record) + "\n")
+        if self._tb is not None:
+            import tensorflow as tf
+
+            with self._tb.as_default():
+                for k, v in record.items():
+                    if k not in ("step", "time") and isinstance(v, float):
+                        tf.summary.scalar(f"{self.name}/{k}", v, step=int(step))
         if jax.process_index() == 0 and self.stdout_every and step % self.stdout_every == 0:
             body = " ".join(
                 f"{k}={v:.5g}" if isinstance(v, float) else f"{k}={v}"
@@ -98,3 +118,5 @@ class MetricLogger:
     def close(self) -> None:
         if self._f is not None:
             self._f.close()
+        if self._tb is not None:
+            self._tb.close()
