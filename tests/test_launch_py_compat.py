@@ -1,0 +1,40 @@
+"""The reference's `launch.py -n N -H hostfile cmd` line works verbatim
+through the compat entry point."""
+
+import sys
+
+from tpucfn.compat.launch_py import main
+
+
+def test_launch_py_shape_fans_out(tmp_path):
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("127.0.0.1\n127.0.0.1\n127.0.0.1\n")
+    marker = tmp_path / "out"
+    marker.mkdir()
+    rc = main([
+        "-n", "2", "-H", str(hostfile), "--local", "--",
+        sys.executable, "-c",
+        "import os,pathlib;pathlib.Path("
+        f"r'{marker}'"
+        ").joinpath(os.environ['TPUCFN_HOST_ID']).write_text("
+        "os.environ['DEEPLEARNING_WORKERS_COUNT'])",
+    ])
+    assert rc == 0
+    # -n 2 launches exactly two ranks even though the hostfile lists 3
+    assert sorted(p.name for p in marker.iterdir()) == ["0", "1"]
+    assert (marker / "0").read_text() == "2"  # legacy env var exported
+
+
+def test_launch_py_too_few_hosts(tmp_path, capsys):
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("127.0.0.1\n")
+    rc = main(["-n", "4", "-H", str(hostfile), "--local", "--", "true"])
+    assert rc == 2
+    assert "hostfile has 1 hosts" in capsys.readouterr().err
+
+
+def test_launch_py_no_command(tmp_path, capsys):
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("127.0.0.1\n")
+    rc = main(["-n", "1", "-H", str(hostfile), "--local"])
+    assert rc == 2

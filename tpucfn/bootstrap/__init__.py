@@ -1,1 +1,1 @@
-from tpucfn.bootstrap.contract import EnvContract, converge  # noqa: F401
+from tpucfn.bootstrap.contract import COORDINATOR_PORT, EnvContract, converge  # noqa: F401

@@ -1,0 +1,75 @@
+"""``launch.py``-shaped compat entry point.
+
+The reference's documented launch line (SURVEY.md §3.2) was dmlc's
+
+    ../../tools/launch.py -n $DEEPLEARNING_WORKERS_COUNT \
+        -H $DEEPLEARNING_WORKERS_PATH python train.py …
+
+This module accepts that exact argv shape:
+
+    python -m tpucfn.compat.launch_py -n $TPUCFN_WORKERS_COUNT \
+        -H $TPUCFN_WORKERS_PATH python train.py …
+
+and fans out through the tpucfn Launcher (ssh transport by default, like
+the dmlc tracker; ``--local`` for single-machine/test runs). The legacy
+env names still resolve, so a reference-era shell line works after
+s/launch.py/python -m tpucfn.compat.launch_py/.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from tpucfn.bootstrap import COORDINATOR_PORT, EnvContract
+from tpucfn.launch import Launcher, LocalTransport, SSHTransport, run_with_restarts
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="launch.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("-n", "--num-workers", type=int, required=True,
+                   help="number of worker hosts (≈ dmlc launch.py -n)")
+    p.add_argument("-H", "--hostfile", required=True,
+                   help="hostfile path (≈ dmlc launch.py -H)")
+    p.add_argument("--local", action="store_true",
+                   help="spawn locally instead of over ssh (tests/single box)")
+    p.add_argument("--restarts", type=int, default=0)
+    p.add_argument("cmd", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        print("error: no command given", file=sys.stderr)
+        return 2
+
+    hosts = Path(args.hostfile).read_text().split()
+    if len(hosts) < args.num_workers:
+        print(f"error: hostfile has {len(hosts)} hosts, -n asked for "
+              f"{args.num_workers}", file=sys.stderr)
+        return 2
+    hosts = hosts[: args.num_workers]
+
+    coord_host = hosts[0].rsplit(":", 1)[0]
+    contract = EnvContract(
+        workers_path=str(Path(args.hostfile).absolute()),
+        workers_count=args.num_workers,
+        worker_chip_count=0,  # unknown at this surface; runtime discovers
+        coordinator=f"{coord_host}:{COORDINATOR_PORT}",
+        host_id=0,
+        storage="",
+        generation=0,
+    )
+    transport = LocalTransport() if args.local else SSHTransport()
+    rc = run_with_restarts(Launcher(contract, transport), cmd,
+                           max_restarts=args.restarts)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

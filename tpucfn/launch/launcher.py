@@ -91,7 +91,9 @@ class Launcher:
         recovery paths (fail-fast wait, --restarts resume) can be
         exercised deterministically in tests and drills.
         """
-        hosts = self.contract.hosts()
+        # The contract's count wins over the hostfile's line count (the
+        # reference's launch.py -n had the same precedence over -H).
+        hosts = self.contract.hosts()[: self.contract.workers_count]
         procs = []
         for host_id, host in enumerate(hosts):
             procs.append(self.transport.run(host, argv, self.host_env(host_id)))
