@@ -95,6 +95,18 @@ void* tpurec_open(const char* path, char* err, int errlen) {
     set_err(err, errlen, "unsupported tpurecord version");
     return nullptr;
   }
+  // hdr.count is untrusted input: bound it by what the file could
+  // possibly hold before reserving, so a corrupt header can't throw
+  // length_error/bad_alloc across the C ABI (std::terminate).
+  uint64_t max_count =
+      (shard->data.size() - sizeof(FileHeader)) / sizeof(RecHeader);
+  if (hdr.count > max_count) {
+    delete shard;
+    set_err(err, errlen,
+            "corrupt header: record count " + std::to_string(hdr.count) +
+                " exceeds file capacity " + std::to_string(max_count));
+    return nullptr;
+  }
   uint64_t off = sizeof(FileHeader);
   shard->offsets.reserve(hdr.count);
   for (uint64_t i = 0; i < hdr.count; ++i) {

@@ -94,6 +94,16 @@ class Launcher:
         # The contract's count wins over the hostfile's line count (the
         # reference's launch.py -n had the same precedence over -H).
         hosts = self.contract.hosts()[: self.contract.workers_count]
+        if kill_host_after is not None and not (
+            0 <= kill_host_after[0] < len(hosts)
+        ):
+            # Validate before spawning: an out-of-range victim must not
+            # leak an already-launched gang.  (The CLI validates against
+            # the full hostfile, which may be longer than workers_count.)
+            raise ValueError(
+                f"kill_host_after host_id {kill_host_after[0]} out of range "
+                f"for {len(hosts)} launched hosts"
+            )
         procs = []
         for host_id, host in enumerate(hosts):
             procs.append(self.transport.run(host, argv, self.host_env(host_id)))

@@ -20,6 +20,7 @@ import contextlib
 import dataclasses
 import enum
 import itertools
+import threading
 from typing import Callable
 
 from tpucfn.spec import ClusterSpec
@@ -92,15 +93,22 @@ class FakeControlPlane(ControlPlane):
         self._gen = itertools.count(1)
         self.events: list[tuple[str, str]] = []  # (cluster, event) audit log
         self._state_file = state_file
+        self._in_txn = False
+        # Guards _in_txn/_clusters for threads sharing one instance; the
+        # flock serializes across processes, this across threads.  RLock
+        # so describe() inside a same-thread transaction doesn't deadlock.
+        self._ilock = threading.RLock()
         if state_file:
             self._load()
 
     # -- persistence -----------------------------------------------------
     #
     # Concurrent CLI invocations (e.g. a health-monitor loop racing a user
-    # resize) serialize on an flock'd sidecar; writes are atomic
-    # (tmp + rename) so readers never observe a torn JSON — the
-    # control-plane-race concern from SURVEY.md §5 (race detection row).
+    # resize) serialize on an flock'd sidecar: every mutation is a full
+    # read-modify-write transaction under the lock (reload state, apply,
+    # write), so no invocation can lose another's update.  Writes are
+    # atomic (tmp + rename) so lock-free readers never observe a torn
+    # JSON — the control-plane-race concern from SURVEY.md §5.
 
     @contextlib.contextmanager
     def _locked(self):
@@ -116,15 +124,33 @@ class FakeControlPlane(ControlPlane):
             finally:
                 fcntl.flock(lk, fcntl.LOCK_UN)
 
-    def _load(self) -> None:
+    @contextlib.contextmanager
+    def _transaction(self):
+        """Critical section for mutations: reload → mutate → persist,
+        all under one flock, so concurrent processes (kill-host racing
+        heal, monitor racing resize) serialize instead of last-writer-
+        wins over a stale in-memory copy."""
+        if not self._state_file:
+            yield
+            return
+        with self._ilock, self._locked():
+            self._load_unlocked()
+            self._in_txn = True
+            try:
+                yield
+            finally:
+                self._in_txn = False
+            self._save_unlocked()
+
+    def _load_unlocked(self) -> None:
         import json
         from pathlib import Path
 
         p = Path(self._state_file)
         if not p.exists():
             return
-        with self._locked():
-            raw = json.loads(p.read_text())
+        raw = json.loads(p.read_text())
+        self._clusters = {}
         for name, rec in raw.get("clusters", {}).items():
             self._clusters[name] = ClusterRecord(
                 spec=ClusterSpec.from_json(rec["spec"]),
@@ -136,9 +162,11 @@ class FakeControlPlane(ControlPlane):
         self._pending = dict(raw.get("pending", {}))
         self._gen = itertools.count(raw.get("next_gen", 1))
 
-    def _save(self) -> None:
-        if not self._state_file:
-            return
+    def _load(self) -> None:
+        with self._locked():
+            self._load_unlocked()
+
+    def _save_unlocked(self) -> None:
         import dataclasses as dc
         import json
         from pathlib import Path
@@ -161,66 +189,70 @@ class FakeControlPlane(ControlPlane):
         }
         p = Path(self._state_file)
         p.parent.mkdir(parents=True, exist_ok=True)
-        with self._locked():
-            tmp = p.with_suffix(".tmp")
-            tmp.write_text(json.dumps(data, indent=2))
-            tmp.replace(p)
+        tmp = p.with_suffix(".tmp")
+        tmp.write_text(json.dumps(data, indent=2))
+        tmp.replace(p)
 
     # -- ControlPlane ----------------------------------------------------
 
     def create(self, spec: ClusterSpec) -> ClusterRecord:
-        existing = self._clusters.get(spec.name)
-        if existing is not None and existing.state not in (
-            ClusterState.DELETED,
-            ClusterState.FAILED,
-        ):
-            raise ValueError(f"cluster {spec.name!r} already exists ({existing.state.value})")
-        rec = ClusterRecord(spec=spec, state=ClusterState.QUEUED, hosts=[],
-                            generation=next(self._gen))
-        self._clusters[spec.name] = rec
-        self._pending[spec.name] = self.steps_to_provision
-        self.events.append((spec.name, "create"))
-        self._save()
+        with self._transaction():
+            existing = self._clusters.get(spec.name)
+            if existing is not None and existing.state not in (
+                ClusterState.DELETED,
+                ClusterState.FAILED,
+            ):
+                raise ValueError(f"cluster {spec.name!r} already exists ({existing.state.value})")
+            rec = ClusterRecord(spec=spec, state=ClusterState.QUEUED, hosts=[],
+                                generation=next(self._gen))
+            self._clusters[spec.name] = rec
+            self._pending[spec.name] = self.steps_to_provision
+            self.events.append((spec.name, "create"))
         return rec
 
     def describe(self, name: str) -> ClusterRecord:
-        if name not in self._clusters:
-            raise KeyError(f"no cluster named {name!r}")
-        return self._clusters[name]
+        # Long-lived readers (health monitors) must see other processes'
+        # writes; inside a transaction the state was just reloaded.
+        with self._ilock:
+            if self._state_file and not self._in_txn:
+                self._load()
+            if name not in self._clusters:
+                raise KeyError(f"no cluster named {name!r}")
+            return self._clusters[name]
 
     def delete(self, name: str) -> None:
-        rec = self.describe(name)
-        rec.state = ClusterState.DELETED
-        rec.hosts = []
-        self._pending.pop(name, None)
-        self.events.append((name, "delete"))
-        self._save()
+        with self._transaction():
+            rec = self.describe(name)
+            rec.state = ClusterState.DELETED
+            rec.hosts = []
+            self._pending.pop(name, None)
+            self.events.append((name, "delete"))
 
     def tick(self) -> None:
-        for name, rec in self._clusters.items():
-            if rec.state in (ClusterState.QUEUED, ClusterState.PROVISIONING):
-                left = self._pending.get(name, 0) - 1
-                self._pending[name] = left
-                if left > 0:
-                    rec.state = ClusterState.PROVISIONING
-                elif self.fail_creation:
-                    rec.state = ClusterState.FAILED
-                    rec.message = "no capacity for requested topology"
-                    self.events.append((name, "failed"))
-                else:
-                    rec.state = ClusterState.ACTIVE
-                    rec.hosts = [
-                        HostRecord(host_id=i, address=f"10.0.0.{i + 1}:8471")
-                        for i in range(rec.spec.num_hosts)
-                    ]
-                    self.events.append((name, "active"))
-        self._save()
+        with self._transaction():
+            for name, rec in self._clusters.items():
+                if rec.state in (ClusterState.QUEUED, ClusterState.PROVISIONING):
+                    left = self._pending.get(name, 0) - 1
+                    self._pending[name] = left
+                    if left > 0:
+                        rec.state = ClusterState.PROVISIONING
+                    elif self.fail_creation:
+                        rec.state = ClusterState.FAILED
+                        rec.message = "no capacity for requested topology"
+                        self.events.append((name, "failed"))
+                    else:
+                        rec.state = ClusterState.ACTIVE
+                        rec.hosts = [
+                            HostRecord(host_id=i, address=f"10.0.0.{i + 1}:8471")
+                            for i in range(rec.spec.num_hosts)
+                        ]
+                        self.events.append((name, "active"))
 
     def kill_host(self, name: str, host_id: int) -> None:
-        rec = self.describe(name)
-        rec.hosts[host_id].healthy = False
-        self.events.append((name, f"host{host_id}-died"))
-        self._save()
+        with self._transaction():
+            rec = self.describe(name)
+            rec.hosts[host_id].healthy = False
+            self.events.append((name, f"host{host_id}-died"))
 
 
 WaitCallback = Callable[[ClusterRecord], None]
