@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import sys
+import time
 from pathlib import Path
 
 # Examples are runnable from a bare checkout (`python examples/x.py`)
@@ -34,11 +35,18 @@ def add_cluster_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--ckpt-every", type=int, default=200)
     p.add_argument("--resume", action="store_true",
-                   help="resume from the latest checkpoint in --run-dir")
+                   help="(default behavior, kept for compat) resume from the "
+                        "latest checkpoint in --run-dir")
+    p.add_argument("--fresh", action="store_true",
+                   help="delete existing checkpoints in --run-dir and train "
+                        "from step 0")
     p.add_argument("--eval-every", type=int, default=0,
                    help="run validation over the held-out split every N steps")
     p.add_argument("--profile", action="store_true",
                    help="capture a jax.profiler trace of steps 10-20")
+    p.add_argument("--profile-server", type=int, default=0, metavar="PORT",
+                   help="start the per-host jax profiler server on PORT so "
+                        "XProf/TensorBoard can attach a live capture (0=off)")
     # Parallelism surface (reference exposed only worker count; SURVEY §2.3
     # mandates the full set as first-class flags).
     p.add_argument("--kv-store", default="dist_sync",
@@ -106,9 +114,26 @@ def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=(),
     from tpucfn.obs import MetricLogger, StepTimer, profile_steps
     from tpucfn.parallel import shard_batch
 
+    from tpucfn.obs import enable_compile_cache, start_profiler_server
+
+    # Persistent XLA compile cache: a relaunch (or the restart supervisor's
+    # resume) skips recompilation, keeping time_to_first_step from being
+    # compile-dominated (SURVEY.md §7.4 item 6).
+    enable_compile_cache()
+    if getattr(args, "profile_server", 0):
+        start_profiler_server(args.profile_server)
+
     run_dir = Path(args.run_dir)
+    if args.fresh and (run_dir / "ckpt").exists():
+        # Clear, don't just ignore: stale checkpoints would swallow the
+        # fresh run's saves at colliding steps, and the next (auto-resume)
+        # relaunch would restore the pre-fresh weights.
+        import shutil
+
+        shutil.rmtree(run_dir / "ckpt")
     logger = MetricLogger(run_dir / "logs", stdout_every=args.log_every)
     timer = StepTimer()
+    t_start = time.perf_counter()
 
     def run_eval(state, step):
         if eval_ds is None or not args.eval_every:
@@ -123,7 +148,11 @@ def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=(),
             logger.log(step, {f"eval_{k}": v / n for k, v in sums.items()})
     with CheckpointManager(run_dir / "ckpt",
                            save_interval_steps=args.ckpt_every) as ckpt:
-        if args.resume and ckpt.latest_step() is not None:
+        # Restart implies resume: a relaunched job (restart supervisor,
+        # operator re-run) picks up at its latest checkpoint without the
+        # caller remembering --resume; --fresh opts out (SURVEY.md §5
+        # failure row — recovery must not silently retrain from step 0).
+        if not args.fresh and ckpt.latest_step() is not None:
             state = ckpt.restore(trainer.abstract_state())
             print(f"resumed from step {int(state.step)}", flush=True)
         else:
@@ -139,6 +168,11 @@ def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=(),
                 state, metrics = trainer.step(state, batch)
                 step = int(state.step)  # blocks -> honest step timing
                 timer.tick()
+                if t_start is not None:
+                    # data staging + init/restore + first compile+step
+                    logger.log(step, {"time_to_first_step": round(
+                        time.perf_counter() - t_start, 2)})
+                    t_start = None
                 if step % args.log_every == 0 or step == total:
                     logger.log(step, {**{k: float(v) for k, v in metrics.items()},
                                       "step_time": timer._last or 0.0})

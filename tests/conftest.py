@@ -11,13 +11,20 @@ when PALLAS_AXON_POOL_IPS is set, so we both scrub the env and pin
 jax_platforms to cpu explicitly.
 """
 
+import importlib.util
 import os
+from pathlib import Path
 
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+# One shared scrub rule (tpucfn/utils/env.py), loaded by file path so no
+# package (and no jax) import happens before the environment is fixed.
+_spec = importlib.util.spec_from_file_location(
+    "_tpucfn_env",
+    Path(__file__).resolve().parent.parent / "tpucfn" / "utils" / "env.py")
+_envmod = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_envmod)
+_clean = _envmod.scrub_accelerator_env(os.environ, n_devices=8)
+os.environ.clear()
+os.environ.update(_clean)
 
 import jax  # noqa: E402
 

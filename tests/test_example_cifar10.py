@@ -39,22 +39,31 @@ def test_cifar10_example_end_to_end(tmp_path):
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
     assert "items/sec" in r.stdout
 
-    # metrics were logged as JSONL with loss/accuracy/step_time
+    # metrics were logged as JSONL with loss/accuracy/step_time, plus the
+    # one-shot time_to_first_step record (SURVEY.md §7.4 item 6)
     logs = list((tmp_path / "logs").glob("*.jsonl"))
     assert logs, r.stdout
     records = [json.loads(line) for line in logs[0].read_text().splitlines()]
     assert any(rec["step"] == 10 for rec in records)
-    assert all("loss" in rec for rec in records)
+    assert any("time_to_first_step" in rec for rec in records)
+    loss_recs = [rec for rec in records if "time_to_first_step" not in rec]
+    assert loss_recs and all("loss" in rec for rec in loss_recs)
 
     # checkpoints exist
     assert (tmp_path / "ckpt").exists()
 
-    # resume continues from step 10 rather than restarting
-    r2 = _run_example(tmp_path, steps=14, resume=True)
+    # restart implies resume: a plain relaunch (no --resume) continues
+    # from step 10 rather than retraining from 0 (SURVEY.md §5 failure row)
+    r2 = _run_example(tmp_path, steps=14)
     assert r2.returncode == 0, f"stdout:\n{r2.stdout}\nstderr:\n{r2.stderr}"
     assert "resumed from step 10" in r2.stdout
     m = re.search(r"final: step=(\d+)", r2.stdout)
     assert m and int(m.group(1)) == 14
+
+    # --fresh opts out and retrains from step 0
+    r3 = _run_example(tmp_path, steps=3, extra=("--fresh",))
+    assert r3.returncode == 0, f"stdout:\n{r3.stdout}\nstderr:\n{r3.stderr}"
+    assert "resumed" not in r3.stdout
 
 
 def test_cifar10_example_fsdp_mode(tmp_path):

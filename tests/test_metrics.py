@@ -48,3 +48,29 @@ def test_step_timer_no_steady_state_is_none():
     t.tick()
     assert t.mean_step_time is None
     assert t.throughput(10) is None
+
+
+def test_profiler_server_starts_and_listens():
+    """--profile-server wiring (SURVEY.md §5 tracing row): the per-host
+    profiler server binds its port so XProf/TensorBoard can attach."""
+    import socket
+
+    from tpucfn.obs import start_profiler_server
+
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+    start_profiler_server(port)
+    # idempotent: second call must not try to rebind
+    start_profiler_server(port)
+    with socket.create_connection(("127.0.0.1", port), timeout=5):
+        pass
+
+
+def test_enable_compile_cache_sets_config(tmp_path):
+    import jax
+
+    from tpucfn.obs import enable_compile_cache
+
+    d = enable_compile_cache(str(tmp_path / "cache"))
+    assert jax.config.jax_compilation_cache_dir == d

@@ -205,6 +205,12 @@ def initialize_runtime(contract: EnvContract | None = None) -> EnvContract | Non
             num_processes=contract.workers_count,
             process_id=contract.host_id,
         )
+    # Every clustered process gets the persistent XLA compile cache — the
+    # relaunch-and-resume recovery path must not pay full recompilation
+    # (SURVEY.md §7.4 item 6).
+    from tpucfn.obs import enable_compile_cache
+
+    enable_compile_cache()
     return contract
 
 

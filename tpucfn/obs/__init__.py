@@ -1,2 +1,6 @@
 from tpucfn.obs.metrics import MetricLogger, StepTimer  # noqa: F401
-from tpucfn.obs.profiler import profile_steps  # noqa: F401
+from tpucfn.obs.profiler import (  # noqa: F401
+    enable_compile_cache,
+    profile_steps,
+    start_profiler_server,
+)

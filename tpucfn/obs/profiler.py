@@ -2,23 +2,51 @@
 
 The reference exposed no profiling story at all (delegated to nvprof/
 framework profilers, undocumented — SURVEY.md §5). tpucfn makes a step-
-range trace a launcher flag: traces capture XLA op timelines *and* ICI
-collective overlap, viewable in TensorBoard/XProf.
+range trace a flag on every example: traces capture XLA op timelines
+*and* ICI collective overlap, viewable in TensorBoard/XProf.
 """
 
 from __future__ import annotations
 
 import contextlib
+import os
 from pathlib import Path
 
 import jax
 
 
-def start_profiler_server(port: int = 9012) -> None:
+def start_profiler_server(port: int = 9012):
     """Start the per-host profiler server so XProf/TensorBoard can attach
-    a live capture to any host in the fleet (the launcher calls this when
-    ``--profile-server`` is set)."""
-    jax.profiler.start_server(port)
+    a live capture to any host in the fleet.  The examples call this when
+    ``--profile-server PORT`` is set (examples/common.py); standalone user
+    scripts can call it directly.  Idempotent per process for the same
+    port; a second call with a different port raises (jax allows one
+    profiler server per process, so silently returning the old one would
+    leave the requested port unreachable)."""
+    prev = getattr(start_profiler_server, "_port", None)
+    if prev is not None:
+        if prev != port:
+            raise ValueError(
+                f"profiler server already running on port {prev}; cannot "
+                f"start another on {port} (one per process)")
+        return start_profiler_server._server
+    start_profiler_server._server = jax.profiler.start_server(port)
+    start_profiler_server._port = port
+    return start_profiler_server._server
+
+
+def enable_compile_cache(cache_dir: str | None = None) -> str:
+    """Point XLA's persistent compilation cache at ``cache_dir`` (default
+    ``$TPUCFN_XLA_CACHE`` or /tmp/tpucfn_xla_cache).  A relaunch of the
+    same program — the restart supervisor's resume, or the second
+    ``tpucfn launch`` on a pod — then skips recompilation, which is what
+    keeps time_to_first_step from being compile-dominated (SURVEY.md §7.4
+    item 6, BASELINE.md metric 2).  Safe to call multiple times."""
+    cache_dir = cache_dir or os.environ.get(
+        "TPUCFN_XLA_CACHE", "/tmp/tpucfn_xla_cache")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return cache_dir
 
 
 @contextlib.contextmanager

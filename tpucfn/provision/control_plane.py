@@ -131,7 +131,8 @@ class FakeControlPlane(ControlPlane):
         heal, monitor racing resize) serialize instead of last-writer-
         wins over a stale in-memory copy."""
         if not self._state_file:
-            yield
+            with self._ilock:  # memory-only instances still serialize
+                yield
             return
         with self._ilock, self._locked():
             self._load_unlocked()

@@ -1,0 +1,38 @@
+"""Accelerator-environment scrubbing — the one copy of the load-bearing
+defense against the image's wedged-axon sitecustomize.
+
+The container force-registers an ``axon`` TPU PJRT plugin at interpreter
+start whenever ``PALLAS_AXON_POOL_IPS`` is set; when the tunnel behind it
+is wedged, any process that lets JAX pick that platform hangs at backend
+init.  Every subprocess that must run on fake CPU devices (the driver's
+multichip dryrun, bench.py's CPU fallback, the test suite) builds its
+child environment through :func:`scrub_accelerator_env` so the prefix
+list lives in exactly one place.
+
+This module must stay importable with no dependencies (no jax, no
+tpucfn package init): ``__graft_entry__.py`` and ``tests/conftest.py``
+load it by file path before any backend decision is made.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+_ACCEL_ENV_PREFIXES = ("JAX_", "XLA_", "TPU_", "LIBTPU", "PJRT_", "PALLAS_")
+
+
+def scrub_accelerator_env(
+    env: Mapping[str, str], n_devices: int | None = None
+) -> dict[str, str]:
+    """Return a copy of ``env`` with every accelerator-selection variable
+    removed; with ``n_devices`` set, additionally pin the environment to
+    ``n_devices`` fake CPU devices."""
+    out = {
+        k: v
+        for k, v in env.items()
+        if not (k.upper().startswith(_ACCEL_ENV_PREFIXES) or "AXON" in k.upper())
+    }
+    if n_devices is not None:
+        out["JAX_PLATFORMS"] = "cpu"
+        out["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
+    return out
