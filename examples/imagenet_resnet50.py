@@ -32,7 +32,16 @@ def main() -> int:
     add_cluster_args(p)
     p.add_argument("--network", default="resnet50", choices=["resnet50", "resnet18"])
     p.add_argument("--image-size", type=int, default=224)
-    p.add_argument("--num-examples", type=int, default=512)
+    p.add_argument("--num-examples", type=int, default=512,
+                   help="synthetic-data size (ignored with --data-url)")
+    p.add_argument("--data-url", default="",
+                   help="real dataset: tpurecord shards of encoded images "
+                        "(tpucfn convert-dataset) at a gs://, s3://, "
+                        "file:// URL or local dir; staged to --run-dir "
+                        "then decoded on the host input path")
+    p.add_argument("--num-classes", type=int, default=1000,
+                   help="label cardinality (set to the real dataset's "
+                        "class count with --data-url)")
     p.add_argument("--label-smoothing", type=float, default=0.1)
     p.add_argument("--augment", action="store_true",
                    help="inception-style random-resized-crop + mirror")
@@ -52,16 +61,27 @@ def main() -> int:
     from tpucfn.train import Trainer
 
     run_dir = Path(args.run_dir)
-    shards = stage_synthetic(
-        "imagenet", run_dir / "data", n=args.num_examples,
-        num_shards=max(8, jax.process_count()), seed=args.seed,
-        image_size=args.image_size,
-    )
+    if args.data_url:
+        # The reference's "aws s3 sync s3://bucket /efs" staging step
+        # (SURVEY.md §2.1 S3 row): sync shards down once, train from the
+        # local cache; shards hold encoded images, decoded on the host.
+        # Each process fetches only the shards it will read (owner_slice).
+        from tpucfn.data import stage_url
+
+        shards = stage_url(args.data_url, run_dir / "data-cache",
+                           owner_slice=(jax.process_index(),
+                                        jax.process_count()))
+    else:
+        shards = stage_synthetic(
+            "imagenet", run_dir / "data", n=args.num_examples,
+            num_shards=max(8, jax.process_count()), seed=args.seed,
+            image_size=args.image_size,
+        )
 
     mesh = build_example_mesh(args)
     cfg = {"resnet50": ResNetConfig.resnet50, "resnet18": ResNetConfig.resnet18}[
         args.network
-    ]()
+    ](num_classes=args.num_classes)
     model = ResNet(cfg)
     sample = jnp.zeros((1, args.image_size, args.image_size, 3))
 
@@ -94,12 +114,33 @@ def main() -> int:
     )
     trainer = Trainer(mesh, dense_rules(fsdp=args.fsdp > 1), loss_fn, tx, init_fn)
     transform = None
-    if args.augment:
+    if args.data_url:
+        # Encoded shards vary in size: decode, fix geometry (augment for
+        # training, center-crop otherwise) so batches stack, then
+        # normalize 0-255 pixels with the standard channel stats.
+        from tpucfn.data import center_crop_resize, decode_transform
+        from tpucfn.data.transforms import (
+            IMAGENET_MEAN,
+            IMAGENET_STD,
+            Compose,
+            normalize,
+            random_flip,
+            random_resized_crop,
+        )
+
+        geom = ([random_resized_crop(args.image_size), random_flip()]
+                if args.augment else [center_crop_resize(args.image_size)])
+        transform = Compose([decode_transform(), *geom,
+                             normalize(IMAGENET_MEAN, IMAGENET_STD)])
+    elif args.augment:
         from tpucfn.data.transforms import Compose, random_flip, random_resized_crop
 
         transform = Compose([random_resized_crop(args.image_size), random_flip()])
+    # Real datasets stream (constant host RAM); synthetic smoke data is
+    # small enough to cache decoded.
     ds = ShardedDataset(shards, batch_size_per_process=per_process_batch(args),
-                        seed=args.seed, transform=transform)
+                        seed=args.seed, transform=transform,
+                        cache_in_memory=not args.data_url)
     run_train_loop(trainer, ds, mesh, args, items_per_step=args.batch_size)
     return 0
 

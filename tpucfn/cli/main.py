@@ -157,6 +157,38 @@ def cmd_heal(args) -> int:
     return 0
 
 
+def cmd_convert_dataset(args) -> int:
+    """Pack a real dataset into tpurecord shards (≈ MXNet's im2rec step
+    the reference assumed had already happened off-cluster)."""
+    from tpucfn.data.convert import convert_cifar_binary, convert_image_tree
+
+    if args.kind == "image-tree":
+        paths = convert_image_tree(args.src, args.out, num_shards=args.num_shards)
+    else:
+        paths = convert_cifar_binary(args.src, args.out,
+                                     num_shards=args.num_shards,
+                                     train=not args.test_split)
+    print(f"wrote {len(paths)} shards to {args.out}")
+    if args.publish:
+        from tpucfn.data.store import store_for_url
+        from tpucfn.data.convert import upload_shards
+
+        store, prefix = store_for_url(args.publish)
+        sidecars = [p for p in Path(args.out).glob("*.json")]
+        upload_shards([*paths, *sidecars], store, prefix)
+        print(f"published {len(paths) + len(sidecars)} objects to {args.publish}")
+    return 0
+
+
+def cmd_stage_data(args) -> int:
+    """Sync a dataset prefix down to a local cache (≈ `aws s3 sync`)."""
+    from tpucfn.data.store import stage_url
+
+    paths = stage_url(args.url, args.dest)
+    print(f"staged {len(paths)} shards into {args.dest}")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tpucfn", description=__doc__)
     p.add_argument("--state-dir", default=os.environ.get("TPUCFN_STATE_DIR", "~/.tpucfn"))
@@ -206,6 +238,24 @@ def build_parser() -> argparse.ArgumentParser:
     h = sub.add_parser("heal", help="health check; re-acquire if hosts died")
     h.add_argument("--name", required=True)
     h.set_defaults(fn=cmd_heal)
+
+    cv = sub.add_parser("convert-dataset",
+                        help="pack an image tree / CIFAR binary into tpurecord shards")
+    cv.add_argument("--kind", choices=["image-tree", "cifar10"], required=True)
+    cv.add_argument("--src", required=True, help="dataset root directory")
+    cv.add_argument("--out", required=True, help="output shard directory")
+    cv.add_argument("--num-shards", type=int, default=16)
+    cv.add_argument("--test-split", action="store_true",
+                    help="cifar10: convert test_batch.bin instead of train")
+    cv.add_argument("--publish", metavar="URL",
+                    help="also upload shards to gs://, s3://, or file:// URL")
+    cv.set_defaults(fn=cmd_convert_dataset)
+
+    st = sub.add_parser("stage-data",
+                        help="sync dataset shards from a store URL to local cache")
+    st.add_argument("--url", required=True, help="gs://, s3://, file://, or path")
+    st.add_argument("--dest", required=True)
+    st.set_defaults(fn=cmd_stage_data)
 
     return p
 

@@ -1,5 +1,24 @@
 from tpucfn.data.records import RecordShardWriter, read_record_shard, write_dataset_shards  # noqa: F401
 from tpucfn.data.pipeline import ShardedDataset, prefetch_to_mesh  # noqa: F401
+from tpucfn.data.store import (  # noqa: F401
+    CliObjectStore,
+    LocalStore,
+    Store,
+    stage,
+    stage_url,
+    store_for_url,
+)
+from tpucfn.data.images import (  # noqa: F401
+    center_crop_resize,
+    decode_image,
+    decode_transform,
+    encode_jpeg,
+)
+from tpucfn.data.convert import (  # noqa: F401
+    convert_cifar_binary,
+    convert_image_tree,
+    upload_shards,
+)
 from tpucfn.data.synthetic import (  # noqa: F401
     synthetic_cifar10,
     synthetic_imagenet,

@@ -1,0 +1,114 @@
+"""Dataset conversion — real datasets into tpurecord shards.
+
+The reference assumed datasets already lived in S3 as RecordIO (packed
+once by MXNet's ``im2rec`` tool, off-cluster); tpucfn ships the packer:
+
+* :func:`convert_image_tree` — a ``root/class_name/img.jpeg`` tree (the
+  ImageNet/torchvision layout) into shards of **encoded** images (the
+  original file bytes pass through untouched; decode happens on the
+  training host via ``images.decode_transform``).  Writes
+  ``class_map.json`` next to the shards.
+* :func:`convert_cifar_binary` — the CIFAR-10 binary format (each record
+  1 label byte + 3072 CHW pixel bytes) into shards of decoded HWC uint8
+  arrays (CIFAR is small; decoded staging trades 10% disk for zero
+  decode cost per epoch).
+* :func:`upload_shards` — push converted shards to any :class:`Store`
+  (the ``im2rec → s3 cp`` publish step).
+
+CLI: ``tpucfn convert-dataset --kind image-tree|cifar10 --src .. --out ..``.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Iterator
+
+import numpy as np
+
+from tpucfn.data.records import write_dataset_shards
+from tpucfn.data.store import Store
+
+_IMAGE_SUFFIXES = {".jpg", ".jpeg", ".png", ".bmp", ".webp"}
+
+
+def iter_image_tree(root: str | Path) -> tuple[Iterator[dict], dict[str, int]]:
+    """(example iterator, class→index map) for a class-per-subdir tree.
+    Examples hold the *encoded* file bytes as 1-D uint8 arrays."""
+    root = Path(root)
+    classes = sorted(d.name for d in root.iterdir() if d.is_dir())
+    if not classes:
+        raise ValueError(f"{root} has no class subdirectories")
+    class_map = {c: i for i, c in enumerate(classes)}
+
+    def gen() -> Iterator[dict]:
+        for cls in classes:
+            for p in sorted((root / cls).iterdir()):
+                if p.suffix.lower() in _IMAGE_SUFFIXES:
+                    yield {
+                        "image": np.frombuffer(p.read_bytes(), dtype=np.uint8),
+                        "label": np.int32(class_map[cls]),
+                    }
+
+    return gen(), class_map
+
+
+def convert_image_tree(
+    src: str | Path, out_dir: str | Path, *, num_shards: int,
+    prefix: str = "data",
+) -> list[Path]:
+    examples, class_map = iter_image_tree(src)
+    out = Path(out_dir)
+    paths = write_dataset_shards(examples, out, num_shards=num_shards,
+                                 prefix=prefix)
+    (out / "class_map.json").write_text(json.dumps(class_map, indent=2))
+    return paths
+
+
+def iter_cifar_binary(src: str | Path, *, train: bool = True) -> Iterator[dict]:
+    """CIFAR-10 binary-version records → decoded HWC uint8 examples.
+
+    Format: each record is 1 uint8 label + 3×32×32 CHW uint8 pixels;
+    train split = data_batch_[1-5].bin, test split = test_batch.bin.
+    """
+    src = Path(src)
+    names = ([f"data_batch_{i}.bin" for i in range(1, 6)] if train
+             else ["test_batch.bin"])
+    files = [src / n for n in names if (src / n).exists()]
+    if not files:
+        # also accept a single .bin file path
+        if src.is_file() and src.suffix == ".bin":
+            files = [src]
+        else:
+            raise FileNotFoundError(
+                f"no CIFAR binary batches ({names[0]}…) under {src}")
+    rec_len = 1 + 3 * 32 * 32
+    for f in files:
+        blob = np.frombuffer(f.read_bytes(), dtype=np.uint8)
+        if blob.size % rec_len:
+            raise ValueError(f"{f}: size {blob.size} not a multiple of "
+                             f"record length {rec_len} — corrupt download?")
+        recs = blob.reshape(-1, rec_len)
+        for r in recs:
+            yield {
+                "image": r[1:].reshape(3, 32, 32).transpose(1, 2, 0).copy(),
+                "label": np.int32(r[0]),
+            }
+
+
+def convert_cifar_binary(
+    src: str | Path, out_dir: str | Path, *, num_shards: int,
+    train: bool = True, prefix: str | None = None,
+) -> list[Path]:
+    prefix = prefix or ("train" if train else "test")
+    return write_dataset_shards(
+        iter_cifar_binary(src, train=train), out_dir,
+        num_shards=num_shards, prefix=prefix)
+
+
+def upload_shards(paths: list[str | Path], store: Store, prefix: str = "") -> None:
+    """Publish converted shards (and any sidecar jsons) to a Store."""
+    for p in paths:
+        p = Path(p)
+        key = f"{prefix}/{p.name}" if prefix else p.name
+        store.write_bytes(key, p.read_bytes())

@@ -1,0 +1,84 @@
+"""Encoded-image handling on the host staging path.
+
+The reference's RecordIO shards stored JPEG bytes and decoded on the
+worker (MXNet DataIter's decode threads — SURVEY.md §3.2); tpurecord
+does the same: :func:`tpucfn.data.convert.convert_image_tree` packs the
+original encoded files, and :func:`decode_transform` turns them back
+into HWC uint8 arrays inside the ShardedDataset transform chain, before
+augmentation.  Decoding on the host keeps the TPU step pure MXU work;
+the C++ reader + prefetch thread hide the decode latency.
+
+Encoded images travel through tpurecord as 1-D uint8 arrays (the raw
+file bytes); decoded images are HWC.  ``ndim`` is the discriminator.
+"""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+
+def _pil():
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover - PIL is in the image
+        raise ImportError(
+            "Pillow is required for JPEG/PNG decode; install pillow or "
+            "stage pre-decoded arrays instead") from e
+    return Image
+
+
+def decode_image(data: bytes | np.ndarray) -> np.ndarray:
+    """JPEG/PNG bytes → HWC uint8 RGB array."""
+    if isinstance(data, np.ndarray):
+        data = data.tobytes()
+    img = _pil().open(io.BytesIO(data)).convert("RGB")
+    return np.asarray(img, dtype=np.uint8)
+
+
+def encode_jpeg(arr: np.ndarray, quality: int = 90) -> bytes:
+    """HWC uint8 array → JPEG bytes (used by tests and re-encoding
+    converters; the image-tree converter passes original bytes through)."""
+    buf = io.BytesIO()
+    _pil().fromarray(np.asarray(arr, dtype=np.uint8)).save(
+        buf, format="JPEG", quality=quality)
+    return buf.getvalue()
+
+
+def decode_transform(key: str = "image"):
+    """Transform: decode ``ex[key]`` if it holds encoded bytes (1-D uint8);
+    pass decoded (HWC) examples through untouched, so the same pipeline
+    runs on encoded and pre-decoded datasets."""
+
+    def t(ex: dict, rs) -> dict:
+        img = ex[key]
+        if getattr(img, "ndim", None) == 1:
+            ex = {**ex, key: decode_image(img)}
+        return ex
+
+    return t
+
+
+def center_crop_resize(out_hw: int, key: str = "image"):
+    """Eval-path geometry (the standard ImageNet recipe): resize shorter
+    side to ``1.14 * out_hw`` then center-crop ``out_hw``.  Nearest-
+    neighbor indexing, matching random_resized_crop's host-side-cheap
+    stance."""
+
+    def t(ex: dict, rs) -> dict:
+        img = ex[key]
+        h, w = img.shape[:2]
+        short = int(round(out_hw * 1.14))
+        if h < w:
+            nh, nw = short, max(out_hw, int(round(w * short / h)))
+        else:
+            nh, nw = max(out_hw, int(round(h * short / w))), short
+        yy = (np.arange(nh) * h / nh).astype(np.int64)
+        xx = (np.arange(nw) * w / nw).astype(np.int64)
+        img = img[yy][:, xx]
+        y0 = (nh - out_hw) // 2
+        x0 = (nw - out_hw) // 2
+        return {**ex, key: img[y0:y0 + out_hw, x0:x0 + out_hw]}
+
+    return t

@@ -46,7 +46,15 @@ class ShardedDataset:
         process_index: int | None = None,
         process_count: int | None = None,
         transform=None,  # per-example Transform (tpucfn.data.transforms)
+        cache_in_memory: bool = True,
+        shuffle_buffer: int = 2048,
     ):
+        """``cache_in_memory=False`` streams shards instead of
+        materializing every decoded example in host RAM — required for
+        ImageNet-scale datasets (~140 GB encoded; SURVEY.md §3.2's
+        DataIter streamed the same way).  Shuffling then uses shard-order
+        shuffling + a ``shuffle_buffer``-sized reservoir, seeded per
+        (seed, epoch, process) so batches stay reproducible."""
         if not shard_paths:
             raise ValueError("no shard paths given")
         self.all_shards = sorted(str(p) for p in shard_paths)
@@ -63,7 +71,10 @@ class ShardedDataset:
         self.seed = seed
         self.drop_remainder = drop_remainder
         self.transform = transform
+        self.cache_in_memory = cache_in_memory
+        self.shuffle_buffer = shuffle_buffer
         self._cache: list[dict[str, np.ndarray]] | None = None
+        self._len: int | None = None
 
     def _load(self) -> list[dict[str, np.ndarray]]:
         if self._cache is None:
@@ -79,32 +90,94 @@ class ShardedDataset:
             self._cache = out
         return self._cache
 
+    def _num_examples(self) -> int:
+        if self._len is None:
+            if self.cache_in_memory:
+                self._len = len(self._load())
+            else:
+                self._len = sum(records.shard_record_count(p)
+                                for p in self.local_shards)
+        return self._len
+
     def __len__(self) -> int:
-        n = len(self._load())
+        n = self._num_examples()
         return n // self.batch if self.drop_remainder else -(-n // self.batch)
 
     def epoch(self, epoch: int) -> Iterator[dict[str, np.ndarray]]:
         """One epoch of host-local batches (dicts of stacked arrays)."""
+        # One augmentation stream per (seed, epoch, process): consumed in
+        # iteration order, so any batch is reproducible from its epoch.
+        aug_rs = np.random.RandomState((self.seed, epoch, self.pi, 7))
+
+        def emit(chosen):
+            if self.transform is not None:
+                chosen = [self.transform(ex, aug_rs) for ex in chosen]
+            return {k: np.stack([ex[k] for ex in chosen]) for k in chosen[0]}
+
+        if not self.cache_in_memory:
+            yield from self._epoch_streaming(epoch, emit)
+            return
+
         examples = self._load()
         order = np.arange(len(examples))
         if self.shuffle:
             # Epoch-keyed seed, offset by process so local orders differ
             # but are reproducible.
             np.random.RandomState((self.seed, epoch, self.pi)).shuffle(order)
-        # One augmentation stream per (seed, epoch, process): consumed in
-        # iteration order, so any batch is reproducible from its epoch.
-        aug_rs = np.random.RandomState((self.seed, epoch, self.pi, 7))
-
-        def emit(idx):
-            chosen = [examples[i] for i in idx]
-            if self.transform is not None:
-                chosen = [self.transform(ex, aug_rs) for ex in chosen]
-            return {k: np.stack([ex[k] for ex in chosen]) for k in chosen[0]}
 
         for start in range(0, len(order) - self.batch + 1, self.batch):
-            yield emit(order[start : start + self.batch])
+            yield emit([examples[i] for i in order[start:start + self.batch]])
         if not self.drop_remainder and len(order) % self.batch:
-            yield emit(order[len(order) - len(order) % self.batch :])
+            yield emit([examples[i]
+                        for i in order[len(order) - len(order) % self.batch:]])
+
+    def _epoch_streaming(self, epoch: int, emit) -> Iterator[dict[str, np.ndarray]]:
+        """Constant-memory epoch: shuffled shard order + reservoir
+        shuffle over ``shuffle_buffer`` decoded examples (≈ one shard's
+        worth) instead of the whole dataset in RAM."""
+        from tpucfn.data import native
+
+        read = (native.read_record_shard_native if native.native_available()
+                else records.read_record_shard)
+        rs = np.random.RandomState((self.seed, epoch, self.pi))
+        shard_order = list(self.local_shards)
+        if self.shuffle:
+            rs.shuffle(shard_order)
+
+        def examples():
+            for p in shard_order:
+                for payload in read(p):
+                    yield records.decode_example(payload)
+
+        buf: list = []
+        pending: list = []
+
+        def drain_into_batches(ex_iter):
+            for ex in ex_iter:
+                pending.append(ex)
+                if len(pending) == self.batch:
+                    out = list(pending)
+                    pending.clear()
+                    yield emit(out)
+
+        def sampled():
+            for ex in examples():
+                if not self.shuffle:
+                    yield ex
+                elif len(buf) < self.shuffle_buffer:
+                    buf.append(ex)
+                else:
+                    j = rs.randint(len(buf))
+                    out, buf[j] = buf[j], ex
+                    yield out
+            if self.shuffle:
+                rs.shuffle(buf)
+            while buf:
+                yield buf.pop()
+
+        yield from drain_into_batches(sampled())
+        if not self.drop_remainder and pending:
+            yield emit(list(pending))
 
     def batches(self, num_epochs: int | None = None) -> Iterator[dict[str, np.ndarray]]:
         e = 0

@@ -84,6 +84,16 @@ def read_record_shard(path: str | Path) -> Iterator[bytes]:
             yield payload
 
 
+def shard_record_count(path: str | Path) -> int:
+    """Record count from the shard header alone (16 bytes read) — lets
+    streaming datasets report length without scanning payloads."""
+    with open(path, "rb") as f:
+        magic, version, count = _HEADER.unpack(f.read(_HEADER.size))
+    if magic != MAGIC:
+        raise ValueError(f"{path}: bad magic {magic:#x} — not a tpurecord shard")
+    return count
+
+
 def decode_example(payload: bytes) -> dict[str, np.ndarray]:
     with np.load(io.BytesIO(payload)) as z:
         return {k: z[k] for k in z.files}

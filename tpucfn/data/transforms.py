@@ -97,3 +97,7 @@ def normalize(mean: Sequence[float], std: Sequence[float],
 
 CIFAR_TRAIN = Compose([random_crop(4), random_flip()])
 IMAGENET_TRAIN = Compose([random_resized_crop(224), random_flip()])
+
+# Channel statistics for real (0-255 uint8) images, in pixel units.
+IMAGENET_MEAN = (123.675, 116.28, 103.53)
+IMAGENET_STD = (58.395, 57.12, 57.375)
