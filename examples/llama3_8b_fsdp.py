@@ -40,8 +40,8 @@ def main() -> int:
                         "ring attention")
     p.add_argument("--pipeline", type=int, default=1,
                    help="pipeline stages; >1 runs the GPipe schedule with "
-                        "stage-sharded layers (excludes --tensor/--context "
-                        "in this version)")
+                        "stage-sharded layers, composable with "
+                        "--fsdp/--tensor/--context")
     p.add_argument("--microbatches", type=int, default=4)
     p.add_argument("--num-examples", type=int, default=256)
     p.add_argument("--z-loss", type=float, default=1e-4)
@@ -75,8 +75,6 @@ def main() -> int:
         seq_len=args.seq_len, vocab=cfg.vocab_size,
     )
 
-    if args.pipeline > 1 and (args.tensor > 1 or args.context > 1):
-        raise SystemExit("--pipeline composes with --fsdp/data only (PARITY.md)")
     n = jax.device_count()
     mesh = build_mesh(MeshSpec.for_devices(
         n, fsdp=args.fsdp, tensor=args.tensor, context=args.context,
@@ -93,10 +91,16 @@ def main() -> int:
 
     if args.pipeline > 1:
         from tpucfn.models.llama_pp import pipelined_llama_apply
+        from tpucfn.parallel import bubble_fraction
+
+        bubble = bubble_fraction(args.microbatches, args.pipeline)
+        print(f"pipeline: {args.pipeline} stages x {args.microbatches} "
+              f"microbatches, bubble fraction {bubble:.3f}", flush=True)
 
         def forward(params, tokens):
             return pipelined_llama_apply(cfg, mesh, params, tokens,
-                                         num_microbatches=args.microbatches)
+                                         num_microbatches=args.microbatches,
+                                         context_parallel=args.context > 1)
     else:
         def forward(params, tokens):
             return model.apply({"params": params}, tokens)

@@ -62,6 +62,16 @@ def test_llama_pipeline_example(tmp_path):
              "--microbatches", "2"))
 
 
+def test_llama_pipeline_composed_example(tmp_path):
+    """PP × TP × SP in one run (VERDICT r1 item 5: --pipeline no longer
+    excludes --tensor/--context)."""
+    r = _run("llama3_8b_fsdp.py", tmp_path, "--model", "tiny", "--seq-len", "64",
+             "--batch-size", "8", "--num-examples", "32", "--pipeline", "2",
+             "--microbatches", "2", "--tensor", "2", "--context", "2")
+    _ok(r)
+    assert "bubble fraction" in r.stdout
+
+
 def test_sd15_unet_example(tmp_path):
     _ok(_run("sd15_unet.py", tmp_path, "--tiny", "--batch-size", "8",
              "--num-examples", "32"))

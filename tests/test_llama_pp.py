@@ -68,8 +68,9 @@ def test_pp_training_learns_with_stage_sharded_params(mesh_pp4d2):
     state = trainer.init(jax.random.key(0))
 
     # block params live stage-sharded: 4 layers / pipeline=4 -> 1 per stage
+    # (spec also carries fsdp/tensor entries — size-1 axes on this mesh)
     qk = state.params["layers"]["attn"]["q_proj"]["kernel"]
-    assert qk.sharding.spec == P("pipeline")
+    assert qk.sharding.spec[0] == "pipeline"
     assert qk.addressable_shards[0].data.shape[0] == 1
 
     batch = shard_batch(mesh_pp4d2, {"tokens": _tokens()})
@@ -101,3 +102,140 @@ def test_pp_gradients_match_scanned(mesh_pp4d2):
     emb_pp = np.asarray(g_pp["embed_tokens"]["embedding"])
     emb_ref = np.asarray(g_ref["embed_tokens"]["embedding"])
     np.testing.assert_allclose(emb_pp, emb_ref, atol=5e-4)
+
+
+# ---- composition: PP × FSDP / TP / SP (VERDICT r1 item 5) ----------------
+
+
+def _forward_on_mesh(mesh, cfg, params, toks, context_parallel=False, m=2):
+    return jax.jit(
+        lambda p, t: pipelined_llama_apply(cfg, mesh, p, t,
+                                           num_microbatches=m,
+                                           context_parallel=context_parallel)
+    )(params, toks)
+
+
+def _sharded_params(mesh, cfg, params):
+    from tpucfn.parallel.sharding import named_sharding_tree
+
+    return jax.device_put(params, named_sharding_tree(
+        mesh, pp_sharding_rules(cfg), params))
+
+
+def test_pp_fsdp_forward_matches_scanned():
+    """Stage params additionally sharded over fsdp: XLA gathers on use
+    inside the stage body (gather-on-use ZeRO-3)."""
+    mesh = build_mesh(MeshSpec(pipeline=2, fsdp=2, data=2))
+    cfg = _cfg()
+    model = Llama(cfg)
+    toks = jnp.asarray(_tokens())
+    params = model.init(jax.random.key(0), toks)["params"]
+    ref = model.apply({"params": params}, toks)
+    sharded = _sharded_params(mesh, cfg, params)
+    qk = sharded["layers"]["attn"]["q_proj"]["kernel"]
+    # layer dim over pipeline AND model dim over fsdp
+    assert qk.addressable_shards[0].data.shape[0] == cfg.n_layers // 2
+    assert qk.addressable_shards[0].data.shape[1] == qk.shape[1] // 2
+    out = _forward_on_mesh(mesh, cfg, sharded, toks)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+
+def test_pp_tensor_forward_matches_scanned():
+    mesh = build_mesh(MeshSpec(pipeline=2, tensor=2, data=2))
+    cfg = dataclasses.replace(_cfg(), n_heads=4, n_kv_heads=4)
+    model = Llama(cfg)
+    toks = jnp.asarray(_tokens())
+    params = model.init(jax.random.key(0), toks)["params"]
+    ref = model.apply({"params": params}, toks)
+    out = _forward_on_mesh(mesh, cfg, _sharded_params(mesh, cfg, params), toks)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+
+def test_pp_ring_context_forward_matches_scanned():
+    """PP × SP: one manual region over {pipeline, context} — the stage
+    body runs ring attention directly, RoPE offsets from axis_index."""
+    mesh = build_mesh(MeshSpec(pipeline=2, context=2, data=2))
+    cfg = _cfg()
+    model = Llama(cfg)
+    toks = jnp.asarray(_tokens(b=4, s=32))
+    params = model.init(jax.random.key(0), toks)["params"]
+    ref = model.apply({"params": params}, toks)
+    out = _forward_on_mesh(mesh, cfg, _sharded_params(mesh, cfg, params), toks,
+                           context_parallel=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+
+def test_pp_ring_context_grads_match_scanned():
+    """PP × SP gradients: the flat {pipeline, context} manual region
+    transposes cleanly (the nested-shard_map form did not — see
+    llama_pp.py docstring)."""
+    mesh = build_mesh(MeshSpec(pipeline=2, context=2, data=2))
+    cfg = _cfg()
+    model = Llama(cfg)
+    toks = jnp.asarray(_tokens(b=4, s=32))
+    params = model.init(jax.random.key(1), toks)["params"]
+
+    def loss_pp(p):
+        logits = pipelined_llama_apply(cfg, mesh, p, toks, num_microbatches=2,
+                                       context_parallel=True)
+        return causal_lm_loss(logits, toks)[0]
+
+    def loss_ref(p):
+        return causal_lm_loss(model.apply({"params": p}, toks), toks)[0]
+
+    g_pp = jax.jit(jax.grad(loss_pp))(params)
+    g_ref = jax.jit(jax.grad(loss_ref))(params)
+    np.testing.assert_allclose(
+        np.asarray(g_pp["layers"]["attn"]["q_proj"]["kernel"]),
+        np.asarray(g_ref["layers"]["attn"]["q_proj"]["kernel"]), atol=5e-4)
+
+
+def test_pp_fsdp_tensor_training_matches_replicated():
+    """Full composition under the Trainer: PP×FSDP×TP training step
+    numerics equal the plain scanned model on a DP-only mesh."""
+    cfg = dataclasses.replace(_cfg(), n_heads=4, n_kv_heads=4)
+    model = Llama(cfg)
+    sample = jnp.zeros((8, 16), jnp.int32)
+    toks = _tokens()
+
+    def init_fn(rng):
+        return model.init(rng, sample)["params"], {}
+
+    losses = {}
+    for name, spec_kw, pp in [
+        ("pp_fsdp_tp", dict(pipeline=2, fsdp=2, tensor=2), True),
+        ("plain", dict(data=8), False),
+    ]:
+        mesh = build_mesh(MeshSpec(**spec_kw))
+
+        if pp:
+            def loss_fn(params, mstate, batch, rng, mesh=mesh):
+                logits = pipelined_llama_apply(cfg, mesh, params,
+                                               batch["tokens"],
+                                               num_microbatches=2)
+                loss, acc = causal_lm_loss(logits, batch["tokens"])
+                return loss, ({"accuracy": acc}, mstate)
+            rules = pp_sharding_rules(cfg)
+        else:
+            def loss_fn(params, mstate, batch, rng):
+                logits = model.apply({"params": params}, batch["tokens"])
+                loss, acc = causal_lm_loss(logits, batch["tokens"])
+                return loss, ({"accuracy": acc}, mstate)
+            from tpucfn.models.llama import sharding_rules as llama_rules
+            rules = llama_rules(cfg)
+
+        trainer = Trainer(mesh, rules, loss_fn, optax.adamw(3e-3), init_fn)
+        state = trainer.init(jax.random.key(0))
+        batch = shard_batch(mesh, {"tokens": toks})
+        for _ in range(5):
+            state, m = trainer.step(state, batch)
+        losses[name] = float(m["loss"])
+    np.testing.assert_allclose(losses["pp_fsdp_tp"], losses["plain"], rtol=2e-3)
+
+
+def test_bubble_fraction():
+    from tpucfn.parallel import bubble_fraction
+
+    assert bubble_fraction(4, 4) == pytest.approx(3 / 7)
+    assert bubble_fraction(32, 4) == pytest.approx(3 / 35)
+    assert bubble_fraction(8, 1) == 0.0

@@ -343,3 +343,12 @@ def test_local_store_sibling_root_escape_rejected(tmp_path):
     store = LocalStore(tmp_path / "store")
     with pytest.raises(ValueError):
         store.read_bytes("../store-evil/x")
+
+
+def test_local_store_list_sibling_prefix_excluded(tmp_path):
+    store = LocalStore(tmp_path)
+    store.write_bytes("imagenet/a.tpurec", b"x")
+    store.write_bytes("imagenet2012/b.tpurec", b"y")
+    assert store.list("imagenet") == ["imagenet/a.tpurec"]
+    paths = stage(store, "imagenet", tmp_path / "cache")
+    assert [p.name for p in paths] == ["a.tpurec"]

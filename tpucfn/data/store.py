@@ -72,14 +72,17 @@ class LocalStore(Store):
         return p
 
     def list(self, prefix: str = "") -> list[str]:
+        # Directory semantics, matching CliObjectStore: prefix 'imagenet'
+        # must not match a sibling 'imagenet2012/...'.
         base = self.root
         out = []
         if not base.exists():
             return out
+        pfx = prefix.strip("/")
         for p in sorted(base.rglob("*")):
             if p.is_file():
                 key = p.relative_to(base).as_posix()
-                if key.startswith(prefix):
+                if not pfx or key == pfx or key.startswith(pfx + "/"):
                     out.append(key)
         return out
 
@@ -247,10 +250,12 @@ def stage(
         out.append(dest)
         if owner_slice is not None and i % owner_slice[1] != owner_slice[0]:
             continue
-        remote_size = store.size(key)
-        if (dest.exists() and remote_size is not None
-                and dest.stat().st_size == remote_size):
-            continue
+        # Check the cheap local condition first: a cold cache skips the
+        # per-shard remote stat entirely.
+        if dest.exists():
+            remote_size = store.size(key)
+            if remote_size is not None and dest.stat().st_size == remote_size:
+                continue
         tmp = dest.with_name(f".{dest.name}.{uuid.uuid4().hex[:8]}.tmp")
         store.download(key, tmp)
         _os.replace(tmp, dest)

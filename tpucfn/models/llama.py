@@ -147,15 +147,20 @@ class Llama(nn.Module):
         return logits
 
 
-def sharding_rules(cfg: LlamaConfig, *, fsdp: bool = True, tensor: bool = True) -> ShardingRules:
+def sharding_rules(cfg: LlamaConfig, *, fsdp: bool = True, tensor: bool = True,
+                   layer_lead_axis: str | None = None) -> ShardingRules:
     """Megatron TP × FSDP rules for the Llama param tree.
 
-    Scanned layers stack params with a leading ``layers`` axis, so every
-    spec under ``layers/`` starts with None (never shard the depth axis).
+    Scanned layers stack params with a leading ``layers`` axis; every
+    spec under ``layers/`` starts with ``layer_lead_axis`` there —
+    None (unsharded depth) normally, the pipeline axis for PP stage
+    sharding (llama_pp.pp_sharding_rules).  The ``spec()`` helper below
+    is used by exactly the per-layer rules, so this composes without
+    any pattern-matching on rule strings.
     """
     t = AXIS_TENSOR if tensor else None
     f = AXIS_FSDP if fsdp else None
-    lead = (None,) if cfg.scan_layers else ()
+    lead = (layer_lead_axis,) if cfg.scan_layers else ()
 
     def spec(*axes):
         full = lead + axes

@@ -4,13 +4,25 @@ Same params, different schedule: the scanned Llama param tree (leading
 ``layers`` axis) is sharded over the ``pipeline`` mesh axis — stage p
 holds layers [p·L/P, (p+1)·L/P) — and the forward runs the GPipe
 microbatch schedule from :mod:`tpucfn.parallel.pipeline` inside a
-``shard_map``. Embedding, final norm, and LM head compute replicated on
-every stage (cheap relative to the block stack; revisit for huge vocab).
+``shard_map`` that is **manual over the pipeline axis only**
+(``axis_names={"pipeline"}``).  Every other mesh axis stays on XLA's
+auto-sharding inside the stage body, which is what makes PP compose:
 
-Composition in this version: pipeline × data (batch shards ride along as
-unsharded-per-stage slices; the only cross-shard traffic is the
-stage-boundary ppermute). TP/FSDP × PP composition is a known gap tracked
-in PARITY.md.
+* **PP × FSDP**: stage params carry their fsdp-axis sharding into the
+  stage body; XLA inserts the all-gather on use and the reduce-scatter
+  on the grad transpose — gather-on-use ZeRO-3, compiler-scheduled.
+* **PP × TP**: the Megatron column/row specs on qkv/o/up/down propagate
+  through the block's einsums exactly as in the non-PP path.
+* **PP × SP**: pass ``context_parallel=True`` — the shard_map goes
+  manual over {pipeline, context} together and the stage body runs the
+  ring-attention body directly (RoPE offsets ride the block carry,
+  derived from ``lax.axis_index("context")``).  One flat manual region,
+  deliberately NOT a nested shard_map: transposing an outer partial-
+  manual shard_map through a nested one re-binds the outer axis and
+  Shardy rejects the backward program (observed on jax 0.9).
+
+Embedding, final norm, and LM head compute outside the pipeline body
+under plain auto-sharding (cheap relative to the block stack).
 
 Checkpoints interchange with the plain :class:`tpucfn.models.llama.Llama`
 — the param tree is identical; only placement and schedule differ.
@@ -25,23 +37,25 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 import flax.linen as nn
 
-from tpucfn.mesh import AXIS_PIPELINE, BATCH_AXES
+from tpucfn.kernels.ring_attention import ring_attention
+from tpucfn.mesh import AXIS_CONTEXT, AXIS_PIPELINE
 from tpucfn.models.layers import RMSNorm
-from tpucfn.models.llama import LlamaBlock, LlamaConfig
+from tpucfn.models.llama import LlamaBlock, LlamaConfig, sharding_rules
 from tpucfn.ops.attention import dot_product_attention
 from tpucfn.parallel.pipeline import gpipe, microbatch, unmicrobatch
 from tpucfn.parallel.sharding import ShardingRules
 
-
-def pp_sharding_rules(cfg: LlamaConfig) -> ShardingRules:
-    """Stage-sharded layout: every scanned block param shards its leading
-    (layer) dim over ``pipeline``; embed/norm/head replicate."""
+def pp_sharding_rules(cfg: LlamaConfig, *, fsdp: bool = True,
+                      tensor: bool = True) -> ShardingRules:
+    """Stage-sharded layout composed with FSDP/TP: every scanned block
+    param shards its leading (layer) dim over ``pipeline`` and keeps the
+    Megatron/FSDP specs from :func:`llama.sharding_rules` on its other
+    dims; embed/head keep their vocab-sharded specs (they run outside
+    the pipeline body)."""
     if not cfg.scan_layers:
         raise ValueError("pipeline execution needs scan_layers=True (stacked params)")
-    return ShardingRules((
-        (r"(^|/)layers/", P(AXIS_PIPELINE)),
-        (r".*", P()),
-    ))
+    return sharding_rules(cfg, fsdp=fsdp, tensor=tensor,
+                          layer_lead_axis=AXIS_PIPELINE)
 
 
 def pipelined_llama_apply(
@@ -51,11 +65,23 @@ def pipelined_llama_apply(
     tokens: jax.Array,
     *,
     num_microbatches: int = 4,
+    context_parallel: bool = False,
 ) -> jax.Array:
     """tokens (B, S) → logits (B, S, vocab), numerically equal to
-    ``Llama(cfg).apply`` with the same params (tests assert it)."""
+    ``Llama(cfg).apply`` with the same params (tests assert it).
+
+    ``context_parallel=True`` additionally shards the sequence over the
+    ``context`` axis with ring attention inside the stage body."""
     if not cfg.scan_layers:
         raise ValueError("pipeline execution needs scan_layers=True")
+
+    if context_parallel:
+        def att(q, k, v, *, causal=True, mask=None, q_offset=0, k_offset=0):
+            if mask is not None:
+                raise NotImplementedError("ring attention is causal-only")
+            return ring_attention(q, k, v, axis=AXIS_CONTEXT, causal=causal)
+    else:
+        att = dot_product_attention
 
     embed = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype)
@@ -63,34 +89,45 @@ def pipelined_llama_apply(
 
     def stage_fn(stage_params, h):
         """Apply this stage's layer slice (lax.scan over local layers)."""
+        if context_parallel:
+            # h is the local (mb, S/C, D) shard: RoPE needs the global
+            # position of this shard's first token.
+            q_off = lax.axis_index(AXIS_CONTEXT) * h.shape[-2]
+        else:
+            q_off = jnp.zeros((), jnp.int32)
 
         def body(carry, layer_params):
             if cfg.remat:
                 apply = jax.checkpoint(
-                    lambda p, c: LlamaBlock(cfg, dot_product_attention).apply(
+                    lambda p, c: LlamaBlock(cfg, att).apply(
                         {"params": p}, c
                     )[0],
                     prevent_cse=False,
                 )
                 carry = apply(layer_params, carry)
             else:
-                carry, _ = LlamaBlock(cfg, dot_product_attention).apply(
+                carry, _ = LlamaBlock(cfg, att).apply(
                     {"params": layer_params}, carry
                 )
             return carry, None
 
-        (h_out, _), _ = lax.scan(body, (h, jnp.zeros((), jnp.int32)), stage_params)
+        (h_out, _), _ = lax.scan(body, (h, q_off), stage_params)
         return h_out
 
     mb = microbatch(x, num_microbatches)  # (M, B/M, S, D)
+    # Manual over pipeline (and context, when sequence-parallel): specs
+    # name just the manual axes; fsdp/tensor/data shardings flow through
+    # as auto axes.
+    manual = {AXIS_PIPELINE} | ({AXIS_CONTEXT} if context_parallel else set())
     layer_specs = jax.tree.map(lambda _: P(AXIS_PIPELINE), params["layers"])
-    mb_spec = P(None, BATCH_AXES)
+    mb_spec = P(None, None, AXIS_CONTEXT) if context_parallel else P()
 
     run = jax.shard_map(
         lambda p, xs: gpipe(stage_fn, p, xs),
         mesh=mesh,
         in_specs=(layer_specs, mb_spec),
         out_specs=mb_spec,
+        axis_names=manual,
         check_vma=False,
     )
     x = unmicrobatch(run(params["layers"], mb))

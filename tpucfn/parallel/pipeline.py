@@ -56,23 +56,32 @@ def gpipe(
     p = lax.axis_size(axis)
     i = lax.axis_index(axis)
     m = microbatches.shape[0]
-    ticks = m + p - 1
     perm = [(j, (j + 1) % p) for j in range(p)]
 
-    def tick(recv, t):
-        # Stage 0 injects microbatch t (clamped during drain ticks);
-        # other stages consume what arrived from their left neighbor.
-        inject = microbatches[jnp.clip(t, 0, m - 1)]
+    # Feed microbatches through the scan as xs (padded with repeats of the
+    # last microbatch for the drain ticks) rather than dynamically
+    # indexing `microbatches[t]` inside the body: scan's per-tick slicing
+    # partitions cleanly, while a data-dependent gather on a batch-sharded
+    # operand under a manual pipeline axis trips XLA's SPMD partitioner
+    # (spmd_partitioner_util CHECK, observed on CPU XLA 0.9 — and a
+    # gather is the wrong op for a static schedule anyway).
+    pad = jnp.repeat(microbatches[-1:], p - 1, axis=0)
+    injects = jnp.concatenate([microbatches, pad], axis=0)  # (ticks, mb, ...)
+
+    def tick(recv, inject):
+        # Stage 0 injects this tick's microbatch; other stages consume
+        # what arrived from their left neighbor.
         x = jnp.where(i == 0, inject, recv)
         y = stage_fn(stage_params, x)
         send = lax.ppermute(y, axis, perm)
         return send, y
 
     zero = jnp.zeros_like(microbatches[0])
-    _, ys = lax.scan(tick, zero, jnp.arange(ticks))
+    _, ys = lax.scan(tick, zero, injects)
 
-    # Microbatch j finishes on the last stage at tick j + p - 1.
-    finished = ys[jnp.arange(m) + (p - 1)]
+    # Microbatch j finishes on the last stage at tick j + p - 1: a
+    # contiguous static slice of the tick outputs.
+    finished = lax.slice_in_dim(ys, p - 1, p - 1 + m, axis=0)
     # Broadcast the last stage's results to every stage (masked psum).
     return lax.psum(jnp.where(i == p - 1, finished, jnp.zeros_like(finished)), axis)
 
@@ -88,3 +97,13 @@ def microbatch(x: jax.Array, num_microbatches: int) -> jax.Array:
 def unmicrobatch(x: jax.Array) -> jax.Array:
     """(M, B/M, ...) -> (B, ...)."""
     return x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])
+
+
+def bubble_fraction(num_microbatches: int, num_stages: int,
+                    schedule: str = "gpipe") -> float:
+    """Fraction of stage-ticks wasted in pipeline fill/drain. Same fill/
+    drain count for GPipe and 1F1B — 1F1B's win is activation memory
+    (O(P) stashed microbatches instead of O(M)), not bubble size."""
+    if num_stages <= 1:
+        return 0.0
+    return (num_stages - 1) / (num_microbatches + num_stages - 1)
