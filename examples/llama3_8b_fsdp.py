@@ -43,6 +43,10 @@ def main() -> int:
                         "stage-sharded layers, composable with "
                         "--fsdp/--tensor/--context")
     p.add_argument("--microbatches", type=int, default=4)
+    p.add_argument("--pp-schedule", default="gpipe", choices=["gpipe", "1f1b"],
+                   help="gpipe: AD through the forward schedule (O(M) "
+                        "activation stash); 1f1b: interleaved fwd/bwd with "
+                        "an O(P) stash (no accuracy metric on this path)")
     p.add_argument("--num-examples", type=int, default=256)
     p.add_argument("--z-loss", type=float, default=1e-4)
     args = p.parse_args()
@@ -105,10 +109,42 @@ def main() -> int:
         def forward(params, tokens):
             return model.apply({"params": params}, tokens)
 
-    def loss_fn(params, mstate, batch, rng):
-        logits = forward(params, batch["tokens"])
-        loss, acc = causal_lm_loss(logits, batch["tokens"], z_loss=args.z_loss)
-        return loss, ({"accuracy": acc}, mstate)
+    if args.pipeline > 1 and args.pp_schedule == "1f1b":
+        from tpucfn.models.llama_pp import pipelined_llama_value_and_grad
+
+        def loss_fn(params, mstate, batch, rng):
+            # 1F1B computes its own backward; a custom_vjp hands the
+            # precomputed grads to the Trainer's value_and_grad. The
+            # undifferentiated primal (e.g. eval) stays forward-only.
+            tokens = batch["tokens"]
+
+            @jax.custom_vjp
+            def pp_loss(p):
+                logits = pipelined_llama_apply(
+                    cfg, mesh, p, tokens,
+                    num_microbatches=args.microbatches,
+                    context_parallel=args.context > 1)
+                return causal_lm_loss(logits, tokens, z_loss=args.z_loss)[0]
+
+            def pp_loss_fwd(p):
+                loss, grads = pipelined_llama_value_and_grad(
+                    cfg, mesh, p, tokens,
+                    num_microbatches=args.microbatches,
+                    context_parallel=args.context > 1,
+                    z_loss=args.z_loss)
+                return loss, grads
+
+            def pp_loss_bwd(grads, g):
+                return (jax.tree.map(lambda x: (x * g).astype(x.dtype),
+                                     grads),)
+
+            pp_loss.defvjp(pp_loss_fwd, pp_loss_bwd)
+            return pp_loss(params), ({}, mstate)
+    else:
+        def loss_fn(params, mstate, batch, rng):
+            logits = forward(params, batch["tokens"])
+            loss, acc = causal_lm_loss(logits, batch["tokens"], z_loss=args.z_loss)
+            return loss, ({"accuracy": acc}, mstate)
 
     total = args.steps or 1000
     tx = optax.chain(

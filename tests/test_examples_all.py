@@ -81,3 +81,10 @@ def test_sd15_unet_example(tmp_path):
 def test_bert_parallel_modes(tmp_path, flag):
     _ok(_run("bert_base.py", tmp_path, "--tiny", "--seq-len", "32",
              "--batch-size", "16", "--num-examples", "64", flag, "2"))
+
+
+def test_llama_pipeline_1f1b_example(tmp_path):
+    r = _run("llama3_8b_fsdp.py", tmp_path, "--model", "tiny", "--seq-len", "32",
+             "--batch-size", "16", "--num-examples", "64", "--pipeline", "2",
+             "--microbatches", "4", "--pp-schedule", "1f1b")
+    _ok(r)

@@ -42,7 +42,12 @@ from tpucfn.mesh import AXIS_CONTEXT, AXIS_PIPELINE
 from tpucfn.models.layers import RMSNorm
 from tpucfn.models.llama import LlamaBlock, LlamaConfig, sharding_rules
 from tpucfn.ops.attention import dot_product_attention
-from tpucfn.parallel.pipeline import gpipe, microbatch, unmicrobatch
+from tpucfn.parallel.pipeline import (
+    gpipe,
+    microbatch,
+    pipeline_1f1b,
+    unmicrobatch,
+)
 from tpucfn.parallel.sharding import ShardingRules
 
 def pp_sharding_rules(cfg: LlamaConfig, *, fsdp: bool = True,
@@ -58,35 +63,19 @@ def pp_sharding_rules(cfg: LlamaConfig, *, fsdp: bool = True,
                           layer_lead_axis=AXIS_PIPELINE)
 
 
-def pipelined_llama_apply(
-    cfg: LlamaConfig,
-    mesh: Mesh,
-    params,
-    tokens: jax.Array,
-    *,
-    num_microbatches: int = 4,
-    context_parallel: bool = False,
-) -> jax.Array:
-    """tokens (B, S) → logits (B, S, vocab), numerically equal to
-    ``Llama(cfg).apply`` with the same params (tests assert it).
+def _attention_for(context_parallel: bool):
+    if not context_parallel:
+        return dot_product_attention
 
-    ``context_parallel=True`` additionally shards the sequence over the
-    ``context`` axis with ring attention inside the stage body."""
-    if not cfg.scan_layers:
-        raise ValueError("pipeline execution needs scan_layers=True")
+    def att(q, k, v, *, causal=True, mask=None, q_offset=0, k_offset=0):
+        if mask is not None:
+            raise NotImplementedError("ring attention is causal-only")
+        return ring_attention(q, k, v, axis=AXIS_CONTEXT, causal=causal)
 
-    if context_parallel:
-        def att(q, k, v, *, causal=True, mask=None, q_offset=0, k_offset=0):
-            if mask is not None:
-                raise NotImplementedError("ring attention is causal-only")
-            return ring_attention(q, k, v, axis=AXIS_CONTEXT, causal=causal)
-    else:
-        att = dot_product_attention
+    return att
 
-    embed = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
-                     param_dtype=cfg.param_dtype)
-    x = embed.apply({"params": params["embed_tokens"]}, tokens)
 
+def _make_stage_fn(cfg: LlamaConfig, att, context_parallel: bool):
     def stage_fn(stage_params, h):
         """Apply this stage's layer slice (lax.scan over local layers)."""
         if context_parallel:
@@ -114,6 +103,45 @@ def pipelined_llama_apply(
         (h_out, _), _ = lax.scan(body, (h, q_off), stage_params)
         return h_out
 
+    return stage_fn
+
+
+def _apply_head(cfg: LlamaConfig, head_params, h) -> jax.Array:
+    """final_norm + fp32 lm_head — the one definition both PP schedules
+    share (and must keep matching llama.Llama's tail)."""
+    h = RMSNorm(cfg.norm_eps, cfg.dtype).apply(
+        {"params": head_params["final_norm"]}, h)
+    return nn.DenseGeneral(
+        cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+        param_dtype=cfg.param_dtype).apply(
+        {"params": head_params["lm_head"]}, h.astype(jnp.float32))
+
+
+def pipelined_llama_apply(
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    params,
+    tokens: jax.Array,
+    *,
+    num_microbatches: int = 4,
+    context_parallel: bool = False,
+) -> jax.Array:
+    """tokens (B, S) → logits (B, S, vocab), numerically equal to
+    ``Llama(cfg).apply`` with the same params (tests assert it).
+
+    ``context_parallel=True`` additionally shards the sequence over the
+    ``context`` axis with ring attention inside the stage body."""
+    if not cfg.scan_layers:
+        raise ValueError("pipeline execution needs scan_layers=True")
+
+    att = _attention_for(context_parallel)
+
+    embed = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype)
+    x = embed.apply({"params": params["embed_tokens"]}, tokens)
+
+    stage_fn = _make_stage_fn(cfg, att, context_parallel)
+
     mb = microbatch(x, num_microbatches)  # (M, B/M, S, D)
     # Manual over pipeline (and context, when sequence-parallel): specs
     # name just the manual axes; fsdp/tensor/data shardings flow through
@@ -131,10 +159,94 @@ def pipelined_llama_apply(
         check_vma=False,
     )
     x = unmicrobatch(run(params["layers"], mb))
+    return _apply_head(
+        cfg, {"final_norm": params["final_norm"], "lm_head": params["lm_head"]},
+        x)
 
-    x = RMSNorm(cfg.norm_eps, cfg.dtype).apply({"params": params["final_norm"]}, x)
-    logits = nn.DenseGeneral(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
-                             param_dtype=cfg.param_dtype).apply(
-        {"params": params["lm_head"]}, x.astype(jnp.float32)
+
+def pipelined_llama_value_and_grad(
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    params,
+    tokens: jax.Array,
+    *,
+    num_microbatches: int = 4,
+    context_parallel: bool = False,
+    z_loss: float = 0.0,
+):
+    """1F1B-scheduled causal-LM loss and gradients.
+
+    Returns ``(loss, grads)`` where ``grads`` matches the ``params`` tree
+    and ``loss`` is next-token cross entropy averaged over (B, S-1)
+    tokens plus the optional z-loss regularizer — the same quantity as
+    :func:`llama.causal_lm_loss` (accuracy is not computed on this path).
+
+    Unlike :func:`pipelined_llama_apply`, this is not meant to be
+    differentiated through — it IS the backward pass, scheduled 1F1B so
+    the per-stage activation stash is O(P) instead of O(M) (see
+    :func:`tpucfn.parallel.pipeline.pipeline_1f1b`).  Wrap it in a
+    ``jax.custom_vjp`` to feed optimizers that call ``value_and_grad``
+    (the llama example does exactly this for ``--pp-schedule 1f1b``).
+    """
+    if not cfg.scan_layers:
+        raise ValueError("pipeline execution needs scan_layers=True")
+    att = _attention_for(context_parallel)
+    b, s = tokens.shape
+    mb_size = b // num_microbatches
+
+    embed = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype)
+    x, embed_vjp = jax.vjp(
+        lambda ep: embed.apply({"params": ep}, tokens), params["embed_tokens"])
+
+    # Shifted targets with -1 at the (global) last position, computed
+    # BEFORE any context sharding so the shard-boundary next-token is
+    # still each position's target.
+    labels = jnp.concatenate(
+        [tokens[:, 1:], jnp.full((b, 1), -1, tokens.dtype)], axis=1)
+    denom = mb_size * (s - 1)  # per-micro global valid-token count
+
+    head_params = {"final_norm": params["final_norm"],
+                   "lm_head": params["lm_head"]}
+
+    def head_fn(hp, y, lbl):
+        """Local-shard loss sum / global per-micro token count (the
+        pipeline_1f1b HeadFn contract: contributions psum to the mean).
+        Matches causal_lm_loss's per-token loss incl. z-loss."""
+        import optax
+
+        logits = _apply_head(cfg, hp, y)
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(
+            logits, jnp.maximum(lbl, 0))
+        if z_loss:
+            per_tok = per_tok + z_loss * jax.nn.logsumexp(logits, axis=-1) ** 2
+        return jnp.sum(jnp.where(lbl >= 0, per_tok, 0.0)) / denom
+
+    stage_fn = _make_stage_fn(cfg, att, context_parallel)
+    mb = microbatch(x, num_microbatches)
+    lbl_mb = microbatch(labels, num_microbatches)
+
+    manual = {AXIS_PIPELINE} | ({AXIS_CONTEXT} if context_parallel else set())
+    layer_specs = jax.tree.map(lambda _: P(AXIS_PIPELINE), params["layers"])
+    head_specs = jax.tree.map(lambda _: P(), head_params)
+    mb_spec = P(None, None, AXIS_CONTEXT) if context_parallel else P()
+
+    run = jax.shard_map(
+        lambda lp, hp, xs, lb: pipeline_1f1b(
+            stage_fn, head_fn, lp, hp, xs, lb,
+            reduce_axes=(AXIS_CONTEXT,) if context_parallel else (),
+        ),
+        mesh=mesh,
+        in_specs=(layer_specs, head_specs, mb_spec, mb_spec),
+        out_specs=(P(), layer_specs, head_specs, mb_spec),
+        axis_names=manual,
+        check_vma=False,
     )
-    return logits
+    loss, dlayers, dhead, dmicro = run(params["layers"], head_params, mb, lbl_mb)
+    (d_embed,) = embed_vjp(unmicrobatch(dmicro).astype(x.dtype))
+    grads = dict(params)
+    grads["layers"] = dlayers
+    grads["embed_tokens"] = d_embed
+    grads["final_norm"] = dhead["final_norm"]
+    grads["lm_head"] = dhead["lm_head"]
+    return loss, grads

@@ -13,4 +13,10 @@ from tpucfn.parallel.presets import (  # noqa: F401
     transformer_rules,
     zero1_rules,
 )
-from tpucfn.parallel.pipeline import bubble_fraction, gpipe, microbatch, unmicrobatch  # noqa: F401
+from tpucfn.parallel.pipeline import (  # noqa: F401
+    bubble_fraction,
+    gpipe,
+    microbatch,
+    pipeline_1f1b,
+    unmicrobatch,
+)

@@ -10,17 +10,23 @@ activation it holds and hands the result to its neighbor with a single
 links, which is why ``pipeline`` is the outermost mesh axis —
 tpucfn/mesh/mesh.py).
 
-Schedule: GPipe with M microbatches over P stages → M + P - 1 ticks.
-Bubble fraction (P-1)/(M+P-1); raise M to amortize. Stages compute
-during their bubble ticks too (the result is discarded) — on SPMD
-hardware predication saves nothing, uniformity keeps the program one
-fused XLA computation. 1F1B is a planned optimization, not a semantic
-change.
+Two schedules:
 
-Differentiable by construction: the schedule is a ``lax.scan`` over
-ticks, so reverse-mode AD replays it backwards and the activation
-stash is handled by the scan's own mechanics (+ remat inside stage_fn if
-desired).
+* :func:`gpipe` — M + P - 1 forward ticks; reverse-mode AD replays the
+  scan backwards, so the activation stash is O(M) (scan mechanics + remat
+  inside stage_fn).  Differentiate through it normally.
+* :func:`pipeline_1f1b` — one-forward-one-backward: each tick runs a
+  forward slot and a backward slot, the head/loss computes on the last
+  stage as soon as a microbatch arrives, and cotangents ride the reverse
+  ring while later microbatches are still going forward.  The per-stage
+  input stash is a fixed 2P-1 ring buffer — O(P) activation memory
+  independent of M, which is 1F1B's point (the fill/drain bubble count
+  is the same as GPipe's; see :func:`bubble_fraction`).  It computes
+  grads itself (manual vjp per slot) rather than being transposed by AD.
+
+Both are uniform SPMD: stages compute during bubble ticks too (results
+masked) — on SPMD hardware predication saves nothing, uniformity keeps
+the program one fused XLA computation.
 """
 
 from __future__ import annotations
@@ -107,3 +113,139 @@ def bubble_fraction(num_microbatches: int, num_stages: int,
     if num_stages <= 1:
         return 0.0
     return (num_stages - 1) / (num_microbatches + num_stages - 1)
+
+
+# head_fn(head_params, y, labels) -> scalar loss CONTRIBUTION for one
+# microbatch: sum of per-token losses over this (local) shard divided by
+# the GLOBAL valid-token count, so contributions sum to the global mean
+# across microbatches, pipeline stages, and any reduce_axes shards.
+HeadFn = Callable[[Any, jax.Array, jax.Array], jax.Array]
+
+
+def pipeline_1f1b(
+    stage_fn: StageFn,
+    head_fn: HeadFn,
+    stage_params: Any,
+    head_params: Any,
+    microbatches: jax.Array,  # (M, mb, ...) activations entering stage 0
+    labels: jax.Array,        # (M, mb, ...) per-micro loss targets
+    *,
+    axis: str = AXIS_PIPELINE,
+    reduce_axes: tuple[str, ...] = (),
+):
+    """One-forward-one-backward pipelined loss+grads; call inside
+    shard_map (manual over ``axis`` and every ``reduce_axes`` entry).
+
+    Returns ``(loss, dstage_params, dhead_params, dmicrobatches)`` where
+    the grads are exact for ``loss = Σ_m head_fn(hp, stages(x_m), l_m)``
+    (tests assert parity with jax.grad of the sequential model).
+
+    Timing: stage i forwards micro m at tick m+i (GPipe fill); the last
+    stage runs head+backward of micro m in the same tick its forward
+    completes, and stage i backwards micro m at tick m + 2(P-1) - i.
+    Each stage therefore holds at most 2(P-1-i)+1 stage inputs —
+    the fixed (2P-1)-slot stash below, read/written with one-hot masks
+    (a data-dependent gather on batch-sharded operands under a manual
+    axis trips XLA's SPMD partitioner, and a one-hot select over ≤2P-1
+    slots is cheap relative to a stage of transformer layers).
+
+    The backward slot recomputes the stage forward from the stashed
+    input (jax.vjp) — the same flops-for-memory trade remat makes.
+
+    ``reduce_axes`` (e.g. the context axis when the sequence is sharded
+    into the manual region): param/head grads and the loss are psum'd
+    over them; activation cotangents stay sharded.
+    """
+    p = lax.axis_size(axis)
+    i = lax.axis_index(axis)
+    m = microbatches.shape[0]
+    ticks = m + 2 * (p - 1)
+    depth = 2 * p - 1
+    perm_fwd = [(j, (j + 1) % p) for j in range(p)]
+    perm_bwd = [(j, (j - 1) % p) for j in range(p)]
+    scale = 1.0 / m
+
+    def scaled_head(hp, y, lbl):
+        return head_fn(hp, y, lbl) * scale
+
+    # Scan xs: stage-0 injections (padded at the end for drain ticks) and
+    # last-stage labels (padded at the front for fill ticks) — static
+    # padding instead of in-body dynamic indexing, as in gpipe().
+    injects = jnp.concatenate(
+        [microbatches, jnp.repeat(microbatches[-1:], ticks - m, axis=0)])
+    lbl_pad = jnp.repeat(labels[:1], p - 1, axis=0)
+    lbl_tail = jnp.repeat(labels[-1:], ticks - m - (p - 1), axis=0)
+    lbls = jnp.concatenate([lbl_pad, labels, lbl_tail])
+
+    zero_act = jnp.zeros_like(microbatches[0])
+    stash0 = jnp.zeros((depth,) + microbatches.shape[1:], microbatches.dtype)
+    dstage0 = jax.tree.map(jnp.zeros_like, stage_params)
+    dhead0 = jax.tree.map(jnp.zeros_like, head_params)
+
+    def slot_mask(slot):
+        return (jnp.arange(depth) == slot % depth)
+
+    def tick(carry, xs):
+        fwd_recv, bwd_recv, stash, dstage, dhead, loss_acc, t = carry
+        inject, lbl = xs
+
+        # ---- forward slot: stage i forwards micro m_f = t - i ----------
+        m_f = t - i
+        fwd_valid = (m_f >= 0) & (m_f < m)
+        x_in = jnp.where(i == 0, inject, fwd_recv)
+        y = stage_fn(stage_params, x_in)
+        wmask = slot_mask(t)  # (t - i) + i == t: write slot is uniform
+        stash = jnp.where(
+            wmask.reshape((depth,) + (1,) * x_in.ndim) & fwd_valid,
+            x_in[None], stash)
+
+        # Last stage: head + loss for the arriving micro; dy seeds its
+        # own backward in this same tick.
+        (loss_t, (dhead_t, dy_t)) = jax.value_and_grad(
+            scaled_head, argnums=(0, 1))(head_params, y, lbl)
+        at_head = (i == p - 1) & fwd_valid
+        loss_acc = loss_acc + jnp.where(at_head, loss_t, 0.0)
+        dhead = jax.tree.map(
+            lambda a, g: a + jnp.where(at_head, g, jnp.zeros_like(g)),
+            dhead, dhead_t)
+
+        # ---- backward slot: stage i backwards micro m_b ----------------
+        m_b = t - 2 * (p - 1) + i
+        bwd_valid = (m_b >= 0) & (m_b < m)
+        rmask = slot_mask(m_b + i)  # stashed at its forward tick m_b + i
+        x_b = jnp.sum(
+            jnp.where(rmask.reshape((depth,) + (1,) * x_in.ndim), stash, 0.0),
+            axis=0).astype(stash.dtype)
+        ct_in = jnp.where(i == p - 1, dy_t.astype(bwd_recv.dtype), bwd_recv)
+        _, vjp = jax.vjp(stage_fn, stage_params, x_b)
+        dstage_t, dx = vjp(ct_in.astype(y.dtype))
+        dstage = jax.tree.map(
+            lambda a, g: a + jnp.where(bwd_valid, g, jnp.zeros_like(g)),
+            dstage, dstage_t)
+
+        fwd_send = lax.ppermute(y, axis, perm_fwd)
+        bwd_send = lax.ppermute(
+            jnp.where(bwd_valid, dx, jnp.zeros_like(dx)), axis, perm_bwd)
+        new_carry = (fwd_send, bwd_send, stash, dstage, dhead, loss_acc, t + 1)
+        return new_carry, dx
+
+    carry0 = (zero_act, jnp.zeros_like(zero_act), stash0, dstage0, dhead0,
+              jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32))
+    (_, _, _, dstage, dhead, loss_acc, _), dxs = lax.scan(
+        tick, carry0, (injects, lbls))
+
+    # Stage 0 emitted micro m's input-cotangent at tick m + 2(p-1):
+    # a contiguous static slice, broadcast from stage 0 via masked psum.
+    dmicro = lax.slice_in_dim(dxs, 2 * (p - 1), 2 * (p - 1) + m, axis=0)
+    dmicro = lax.psum(
+        jnp.where(i == 0, dmicro, jnp.zeros_like(dmicro)), axis)
+
+    # Loss and head grads live on the last stage; param grads are
+    # per-stage (stay sharded over `axis`).
+    loss = lax.psum(loss_acc, axis)
+    dhead = jax.tree.map(lambda g: lax.psum(g, axis), dhead)
+    for r in reduce_axes:
+        loss = lax.psum(loss, r)
+        dstage = jax.tree.map(lambda g: lax.psum(g, r), dstage)
+        dhead = jax.tree.map(lambda g: lax.psum(g, r), dhead)
+    return loss, dstage, dhead, dmicro
