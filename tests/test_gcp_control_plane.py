@@ -1,0 +1,198 @@
+"""The real (GCP queued-resource) control plane behind the same
+ControlPlane interface, exercised against recorded gcloud argv/JSON
+fixtures — the SURVEY.md §7.2 step 4 contract: the same Provisioner
+lifecycle that runs against the fake runs against this backend."""
+
+import json
+import subprocess
+
+import pytest
+
+from tpucfn.provision import (
+    AuthError,
+    ClusterState,
+    GcpQueuedResourceControlPlane,
+    Provisioner,
+    QuotaError,
+)
+from tpucfn.provision.provisioner import ProvisioningError
+from tpucfn.spec import ClusterSpec
+
+
+def _qr(state, name="drill", acc="v5e-8", failed=None):
+    body = {
+        "name": f"projects/p/locations/z/queuedResources/{name}",
+        "state": {"state": state},
+        "createTime": "2026-07-29T12:00:00Z",
+        "tpu": {"nodeSpec": [{"node": {"acceleratorType": acc}}]},
+    }
+    if failed:
+        body["state"]["failedData"] = {"error": {"message": failed}}
+    return json.dumps(body)
+
+
+def _node(n_hosts=2, health="HEALTHY"):
+    return json.dumps({
+        "health": health,
+        "networkEndpoints": [
+            {"ipAddress": f"10.8.0.{i + 1}", "port": 8471}
+            for i in range(n_hosts)
+        ],
+    })
+
+
+class GcloudReplay:
+    """Scripted gcloud: each entry is (argv-prefix-after-gcloud, response).
+    A response that is an Exception is raised; a list plays one element
+    per matching call (to model state transitions across polls)."""
+
+    def __init__(self, script):
+        self.script = dict(script)
+        self.calls = []
+
+    def __call__(self, argv):
+        self.calls.append(list(argv))
+        assert argv[0] == "gcloud", argv
+        for key, resp in self.script.items():
+            if tuple(argv[1:1 + len(key)]) == key:
+                if isinstance(resp, list):
+                    resp = resp.pop(0) if len(resp) > 1 else resp[0]
+                if isinstance(resp, Exception):
+                    raise resp
+                return resp
+        raise AssertionError(f"unscripted gcloud call: {argv}")
+
+
+AUTH_OK = {("auth", "print-access-token"): "ya29.token\n"}
+QR = ("compute", "tpus", "queued-resources")
+VM = ("compute", "tpus", "tpu-vm")
+
+
+def _cp(script, tmp_path):
+    return GcpQueuedResourceControlPlane(
+        project="p", zone="z", runner=GcloudReplay({**AUTH_OK, **script}),
+        spec_cache_file=str(tmp_path / "specs.json"), delete_timeout=2.0)
+
+
+def test_lifecycle_create_to_active_same_provisioner_path(tmp_path):
+    cp = _cp({
+        (*QR, "create"): "{}",
+        (*QR, "describe"): [_qr("ACCEPTED"), _qr("PROVISIONING"),
+                            _qr("ACTIVE")],
+        (*VM, "describe"): _node(2),
+    }, tmp_path)
+    prov = Provisioner(cp)
+    rec = prov.create(ClusterSpec(name="drill", accelerator="v5e-8"))
+    assert rec.state is ClusterState.ACTIVE
+    assert [h.address for h in rec.hosts] == ["10.8.0.1:8471", "10.8.0.2:8471"]
+    assert all(h.healthy for h in rec.hosts)
+    # the argv surface is the documented CLI
+    runner = cp.runner
+    assert ["gcloud", *QR, "create", "drill", "--node-id", "drill-node",
+            "--accelerator-type", "v5e-8", "--runtime-version",
+            "tpu-ubuntu2204-base", "--zone", "z", "--project", "p",
+            "--format", "json"] in runner.calls
+
+
+def test_capacity_failure_maps_to_failed_and_provisioner_raises(tmp_path):
+    cp = _cp({
+        (*QR, "create"): "{}",
+        (*QR, "describe"): [_qr("PROVISIONING"),
+                            _qr("FAILED", failed="There is no capacity in zone")],
+    }, tmp_path)
+    with pytest.raises(ProvisioningError, match="no capacity"):
+        Provisioner(cp).create(ClusterSpec(name="drill", accelerator="v5e-8"))
+
+
+def test_quota_error_is_typed(tmp_path):
+    cp = _cp({
+        (*QR, "create"): subprocess.CalledProcessError(
+            1, ["gcloud"], stderr="ERROR: RESOURCE_EXHAUSTED: Quota exceeded "
+                                  "for TPUV5sLitepodPerProjectPerZone"),
+    }, tmp_path)
+    with pytest.raises(QuotaError, match="Quota exceeded"):
+        cp.create(ClusterSpec(name="drill", accelerator="v5e-8"))
+
+
+def test_auth_failure_is_typed_and_actionable(tmp_path):
+    cp = GcpQueuedResourceControlPlane(
+        project="p", zone="z",
+        runner=GcloudReplay({("auth", "print-access-token"):
+                             subprocess.CalledProcessError(
+                                 1, ["gcloud"],
+                                 stderr="Reauthentication required.")}),
+        spec_cache_file=str(tmp_path / "specs.json"))
+    with pytest.raises(AuthError, match="gcloud auth login"):
+        cp.create(ClusterSpec(name="drill", accelerator="v5e-8"))
+
+
+def test_delete_and_unhealthy_host_detection(tmp_path):
+    not_found = subprocess.CalledProcessError(
+        1, ["gcloud"], stderr="ERROR: NOT_FOUND: queued resource not found")
+    cp = _cp({
+        (*QR, "create"): "{}",
+        (*QR, "describe"): [_qr("ACTIVE"), _qr("ACTIVE"), _qr("ACTIVE"),
+                            not_found],
+        (*VM, "describe"): [_node(2), _node(2),
+                            _node(2, health="UNHEALTHY_TENSORFLOW")],
+        (*QR, "delete"): "{}",
+    }, tmp_path)
+    prov = Provisioner(cp)
+    prov.create(ClusterSpec(name="drill", accelerator="v5e-8"))
+    assert prov.unhealthy_hosts("drill") == [0, 1]
+    prov.delete("drill")  # polls describe until NOT_FOUND
+    assert ["gcloud", *QR, "delete", "drill", "--force", "--quiet",
+            "--zone", "z", "--project", "p", "--format", "json"] \
+        in cp.runner.calls
+
+
+def test_missing_project_zone_is_loud(monkeypatch):
+    monkeypatch.delenv("TPUCFN_GCP_PROJECT", raising=False)
+    monkeypatch.delenv("TPUCFN_GCP_ZONE", raising=False)
+    with pytest.raises(ValueError, match="TPUCFN_GCP_PROJECT"):
+        GcpQueuedResourceControlPlane()
+
+
+def test_kill_host_is_test_only(tmp_path):
+    cp = _cp({}, tmp_path)
+    with pytest.raises(NotImplementedError, match="FakeControlPlane"):
+        cp.kill_host("drill", 0)
+
+
+def test_cli_backend_gcp_wiring(monkeypatch, capsys):
+    """tpucfn --backend gcp resolves to the real control plane (and fails
+    loudly without project/zone instead of silently using the fake)."""
+    from tpucfn.cli.main import build_parser, _control_plane
+
+    monkeypatch.delenv("TPUCFN_GCP_PROJECT", raising=False)
+    monkeypatch.delenv("TPUCFN_GCP_ZONE", raising=False)
+    args = build_parser().parse_args(
+        ["--backend", "gcp", "status", "--name", "x"])
+    with pytest.raises(ValueError, match="TPUCFN_GCP_PROJECT"):
+        _control_plane(args)
+
+    monkeypatch.setenv("TPUCFN_GCP_PROJECT", "p")
+    monkeypatch.setenv("TPUCFN_GCP_ZONE", "z")
+    cp = _control_plane(args)
+    assert isinstance(cp, GcpQueuedResourceControlPlane)
+
+
+def test_spec_cache_survives_process_restart(tmp_path):
+    """A second CLI process (heal/monitor) sees the full original spec —
+    storage_path included — not a lossy reconstruction."""
+    script = {
+        (*QR, "create"): "{}",
+        (*QR, "describe"): [_qr("ACTIVE")],
+        (*VM, "describe"): _node(2),
+    }
+    cp1 = _cp(script, tmp_path)
+    spec = ClusterSpec(name="drill", accelerator="v5e-8",
+                       storage_path="/shared/efs")
+    Provisioner(cp1).create(spec)
+
+    cp2 = _cp({(*QR, "describe"): _qr("ACTIVE"),
+               (*VM, "describe"): _node(2)}, tmp_path)
+    rec = cp2.describe("drill")
+    assert rec.spec.storage_path == "/shared/efs"
+    # generation is stable across processes (crc32, not randomized hash)
+    assert rec.generation == cp1.describe("drill").generation

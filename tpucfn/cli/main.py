@@ -15,9 +15,10 @@ Command-for-command parity with the reference's documented workflow
     (ssh master; env already exported)     tpucfn env --name p   (print/export contract)
 
 State lives in ``--state-dir`` (default ``~/.tpucfn``) through the fake
-control plane; a real cloud backend slots in behind the same interface.
-``--backend local`` "provisions" this machine (the single-host path used
-with the real TPU chip and in CI).
+control plane. ``--backend fake`` (default) "provisions" local state —
+the single-host path used with the real TPU chip and in CI;
+``--backend gcp`` drives real TPU queued resources via gcloud
+(tpucfn/provision/gcp.py; needs TPUCFN_GCP_PROJECT/_ZONE).
 """
 
 from __future__ import annotations
@@ -33,7 +34,11 @@ from tpucfn.provision import FakeControlPlane, Provisioner
 from tpucfn.spec import ClusterSpec
 
 
-def _control_plane(args) -> FakeControlPlane:
+def _control_plane(args):
+    if getattr(args, "backend", "fake") == "gcp":
+        from tpucfn.provision import GcpQueuedResourceControlPlane
+
+        return GcpQueuedResourceControlPlane()
     state = Path(args.state_dir).expanduser() / "control_plane.json"
     # steps_to_provision=1: CLI ticks are driven by wait_active polling.
     return FakeControlPlane(steps_to_provision=1, state_file=str(state))
@@ -192,6 +197,11 @@ def cmd_stage_data(args) -> int:
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tpucfn", description=__doc__)
     p.add_argument("--state-dir", default=os.environ.get("TPUCFN_STATE_DIR", "~/.tpucfn"))
+    p.add_argument("--backend", choices=["fake", "gcp"],
+                   default=os.environ.get("TPUCFN_BACKEND", "fake"),
+                   help="control plane: 'fake' (local state file; CI and "
+                        "single-host) or 'gcp' (TPU queued resources via "
+                        "gcloud; needs TPUCFN_GCP_PROJECT/_ZONE)")
     sub = p.add_subparsers(dest="command", required=True)
 
     c = sub.add_parser("create-stack", help="provision a cluster (≈ CFN create-stack)")

@@ -19,10 +19,15 @@ Two schedules:
   forward slot and a backward slot, the head/loss computes on the last
   stage as soon as a microbatch arrives, and cotangents ride the reverse
   ring while later microbatches are still going forward.  The per-stage
-  input stash is a fixed 2P-1 ring buffer — O(P) activation memory
-  independent of M, which is 1F1B's point (the fill/drain bubble count
-  is the same as GPipe's; see :func:`bubble_fraction`).  It computes
-  grads itself (manual vjp per slot) rather than being transposed by AD.
+  *stage-input* stash is a fixed 2P-1 ring buffer — O(P) in M, which is
+  1F1B's point (GPipe+AD stashes O(M) per stage, and that multiplies by
+  the layers-per-stage remat boundary).  Two O(M) buffers remain, both
+  one-hidden-layer-sized like the batch itself: the pre-embedded inputs
+  built outside the region, and the scan-stacked stage-0 input
+  cotangents (the embedding backward needs them all).  Fill/drain
+  bubble count is the same as GPipe's; see :func:`bubble_fraction`.
+  It computes grads itself (manual vjp per slot) rather than being
+  transposed by AD.
 
 Both are uniform SPMD: stages compute during bubble ticks too (results
 masked) — on SPMD hardware predication saves nothing, uniformity keeps

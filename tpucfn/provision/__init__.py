@@ -6,3 +6,8 @@ from tpucfn.provision.control_plane import (  # noqa: F401
     ClusterRecord,
 )
 from tpucfn.provision.provisioner import Provisioner  # noqa: F401
+from tpucfn.provision.gcp import (  # noqa: F401
+    AuthError,
+    GcpQueuedResourceControlPlane,
+    QuotaError,
+)
