@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Flash-attention vs XLA-dense micro-benchmark (VERDICT r1 item 4).
+
+Measures forward and forward+backward wall time at S ∈ {2k, 8k, 32k}
+(or --seqs) on whatever backend jax selects — meaningful numbers need
+the real chip. Prints one JSON line per config:
+
+    {"s": 8192, "fwd_flash_ms": ..., "fwd_dense_ms": ...,
+     "bwd_flash_ms": ..., "bwd_dense_ms": ..., "speedup_fwd": ...}
+
+Usage (on a TPU host):  python benches/flash_bench.py [--heads 16 ...]
+Block tuning: TPUCFN_FLASH_BLOCK_Q/_K or --block-q/--block-k sweeps.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _time(fn, *args, iters=10):
+    import jax
+
+    fn(*args)[0].block_until_ready() if isinstance(fn(*args), tuple) else \
+        jax.block_until_ready(fn(*args))  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--seqs", type=int, nargs="+", default=[2048, 8192, 32768])
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--heads", type=int, default=16)
+    p.add_argument("--kv-heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=128)
+    p.add_argument("--block-q", type=int, default=None)
+    p.add_argument("--block-k", type=int, default=None)
+    p.add_argument("--iters", type=int, default=10)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpucfn.kernels import flash_attention
+    from tpucfn.ops.attention import dot_product_attention
+
+    print(f"# backend={jax.default_backend()} "
+          f"device={jax.devices()[0].device_kind}", file=sys.stderr)
+
+    for s in args.seqs:
+        rs = jax.random.key(0)
+        kq, kk, kv = jax.random.split(rs, 3)
+        shape_q = (args.batch, s, args.heads, args.head_dim)
+        shape_kv = (args.batch, s, args.kv_heads, args.head_dim)
+        q = jax.random.normal(kq, shape_q, jnp.bfloat16)
+        k = jax.random.normal(kk, shape_kv, jnp.bfloat16)
+        v = jax.random.normal(kv, shape_kv, jnp.bfloat16)
+
+        flash = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, block_q=args.block_q, block_k=args.block_k))
+        dense = jax.jit(lambda q, k, v: dot_product_attention(
+            q, k, v, causal=True))
+
+        def g(fn):
+            return jax.jit(jax.grad(
+                lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2),
+                argnums=(0, 1, 2)))
+
+        row = {"s": s, "heads": args.heads, "kv_heads": args.kv_heads,
+               "d": args.head_dim}
+        row["fwd_flash_ms"] = round(_time(flash, q, k, v, iters=args.iters), 3)
+        try:
+            row["fwd_dense_ms"] = round(
+                _time(dense, q, k, v, iters=args.iters), 3)
+        except Exception as e:  # dense S=32k logits can OOM — that's the point
+            row["fwd_dense_ms"] = None
+            row["dense_error"] = type(e).__name__
+        row["bwd_flash_ms"] = round(
+            _time(g(flash), q, k, v, iters=args.iters), 3)
+        if row["fwd_dense_ms"] is not None:
+            row["bwd_dense_ms"] = round(
+                _time(g(dense), q, k, v, iters=args.iters), 3)
+            row["speedup_fwd"] = round(
+                row["fwd_dense_ms"] / row["fwd_flash_ms"], 2)
+            row["speedup_bwd"] = round(
+                row["bwd_dense_ms"] / row["bwd_flash_ms"], 2)
+        print(json.dumps(row), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -97,3 +97,118 @@ def test_bf16_forward_close():
     assert out.dtype == jnp.bfloat16
     np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
                                atol=3e-2)
+
+
+# ---- round-2 additions: segments, padding, blocks, GQA-unrepeated bwd ----
+
+
+def _seg_mask(q_ids, kv_ids):
+    """(B,Sq),(B,Sk) -> broadcastable boolean mask (B,1,Sq,Sk)."""
+    return (q_ids[:, :, None] == kv_ids[:, None, :])[:, None]
+
+
+def test_segment_ids_forward_matches_masked_dense():
+    rs = np.random.RandomState(0)
+    b, s, h, d = 2, 64, 4, 32
+    q = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    k = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    # two packed documents per row
+    segs = jnp.asarray(np.concatenate(
+        [np.zeros((b, 24), np.int32), np.ones((b, s - 24), np.int32)], 1))
+    out = flash_attention(q, k, v, causal=True, segment_ids=segs,
+                          interpret=True)
+    ref = dot_product_attention(q, k, v, causal=True,
+                                mask=_seg_mask(segs, segs))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_segment_ids_gradients_match_masked_dense():
+    rs = np.random.RandomState(1)
+    b, s, h, d = 1, 32, 2, 16
+    q = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    k = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    segs = jnp.asarray((np.arange(s) >= 20).astype(np.int32))[None].repeat(b, 0)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       segment_ids=segs, interpret=True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dot_product_attention(
+            q, k, v, causal=True, mask=_seg_mask(segs, segs)) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, bb, name in zip(gf, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb), atol=3e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+@pytest.mark.parametrize("s", [100, 57, 130])
+def test_odd_sequence_lengths_pad_and_match(s):
+    """Non-tile-aligned S works via pad+mask (ADVICE r1: un-padded odd
+    blocks would mis-tile on real TPU)."""
+    rs = np.random.RandomState(2)
+    b, h, d = 1, 2, 16
+    q = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    k = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    for causal in (True, False):
+        out = flash_attention(q, k, v, causal=causal, interpret=True)
+        ref = dot_product_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, err_msg=f"causal={causal}")
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       interpret=True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, bb in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb), atol=3e-4)
+
+
+def test_block_size_override_matches():
+    rs = np.random.RandomState(3)
+    q = jnp.asarray(rs.randn(1, 256, 2, 32).astype(np.float32))
+    k, v = q + 1.0, q - 1.0
+    base = flash_attention(q, k, v, causal=True, interpret=True)
+    for bq, bk in [(64, 128), (128, 64), (256, 256)]:
+        out = flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk,
+                              interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(base),
+                                   atol=1e-5, err_msg=f"blocks {bq}x{bk}")
+
+
+@pytest.mark.parametrize("blocks", [(32, 32), (None, None)])
+def test_gqa_backward_without_kv_repeat(blocks):
+    """dK/dV accumulate over the query-head group inside the kernel;
+    grads must equal the dense GQA reference. The (32, 32) case forces
+    MULTIPLE KV blocks per head group — the configuration where a wrong
+    grid ordering (rep outside ki) corrupts the shared accumulator."""
+    rs = np.random.RandomState(4)
+    b, s, d = 1, 160, 16  # 160 also exercises the padding path
+    bq, bk = blocks
+    q = jnp.asarray(rs.randn(b, s, 8, d).astype(np.float32))
+    k = jnp.asarray(rs.randn(b, s, 2, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, s, 2, d).astype(np.float32))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=bq,
+                                       block_k=bk, interpret=True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, bb, name in zip(gf, gd, "qkv"):
+        assert a.shape == bb.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb), atol=3e-4,
+                                   err_msg=f"d{name} mismatch blocks={blocks}")

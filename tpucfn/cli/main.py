@@ -197,8 +197,14 @@ def cmd_stage_data(args) -> int:
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tpucfn", description=__doc__)
     p.add_argument("--state-dir", default=os.environ.get("TPUCFN_STATE_DIR", "~/.tpucfn"))
+    env_backend = os.environ.get("TPUCFN_BACKEND", "fake").lower()
+    if env_backend not in ("fake", "gcp"):
+        # argparse never validates defaults — a typo'd env var must not
+        # silently fall back to the fake backend.
+        raise SystemExit(
+            f"error: TPUCFN_BACKEND={env_backend!r} is not one of fake, gcp")
     p.add_argument("--backend", choices=["fake", "gcp"],
-                   default=os.environ.get("TPUCFN_BACKEND", "fake"),
+                   default=env_backend,
                    help="control plane: 'fake' (local state file; CI and "
                         "single-host) or 'gcp' (TPU queued resources via "
                         "gcloud; needs TPUCFN_GCP_PROJECT/_ZONE)")

@@ -166,14 +166,17 @@ class GcpQueuedResourceControlPlane(ControlPlane):
 
     def create(self, spec: ClusterSpec) -> ClusterRecord:
         self.check_auth()
-        self._specs[spec.name] = spec
-        self._save_specs()
         self._run([
             "gcloud", "compute", "tpus", "queued-resources", "create",
             spec.name, "--node-id", self._node_id(spec.name),
             "--accelerator-type", spec.accelerator,
             "--runtime-version", self.runtime_version, *self._scope(),
         ])
+        # Persist only after the create command succeeded: a quota/auth/
+        # capacity failure must not leave a stale cache entry for a
+        # cluster that never existed.
+        self._specs[spec.name] = spec
+        self._save_specs()
         return self.describe(spec.name)
 
     def describe(self, name: str) -> ClusterRecord:
@@ -184,6 +187,9 @@ class GcpQueuedResourceControlPlane(ControlPlane):
             ])
         except subprocess.CalledProcessError as e:
             if "NOT_FOUND" in (e.stderr or ""):
+                if name in self._specs:  # prune stale cache entries
+                    self._specs.pop(name)
+                    self._save_specs()
                 # Interface parity with FakeControlPlane.describe.
                 raise KeyError(f"no cluster named {name!r}") from e
             raise
