@@ -38,6 +38,10 @@ def main() -> int:
     p.add_argument("--context", type=int, default=1,
                    help="context (sequence-parallel) axis size; >1 enables "
                         "ring attention")
+    p.add_argument("--ring-flash", action="store_true",
+                   help="run each ring-attention hop through the Pallas "
+                        "flash kernel (O(S_loc*D) VMEM per hop — the "
+                        "long-context configuration)")
     p.add_argument("--pipeline", type=int, default=1,
                    help="pipeline stages; >1 runs the GPipe schedule with "
                         "stage-sharded layers, composable with "
@@ -84,7 +88,9 @@ def main() -> int:
         n, fsdp=args.fsdp, tensor=args.tensor, context=args.context,
         pipeline=args.pipeline,
     ))
-    attention = (make_ring_attention(mesh) if args.context > 1 else None)
+    attention = (make_ring_attention(
+        mesh, hop_attention="flash" if args.ring_flash else "dense")
+        if args.context > 1 else None)
     model = Llama(cfg, **({"attention_fn": attention} if attention else {}))
     # init sample must divide evenly over the batch/context mesh axes
     dp = mesh.shape["data"] * mesh.shape["fsdp"]

@@ -212,3 +212,36 @@ def test_gqa_backward_without_kv_repeat(blocks):
         assert a.shape == bb.shape
         np.testing.assert_allclose(np.asarray(a), np.asarray(bb), atol=3e-4,
                                    err_msg=f"d{name} mismatch blocks={blocks}")
+
+
+def test_flash_with_lse_matches_dense_and_dlse_grads():
+    """LSE is a differentiable output (ring-hop merges consume it): a
+    loss that uses BOTH o and lse must match the dense reference grads."""
+    from tpucfn.kernels import flash_attention_with_lse
+    from tpucfn.ops.attention import dot_product_attention_with_lse
+
+    rs = np.random.RandomState(5)
+    b, s, h, d = 1, 48, 2, 16
+    q = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    k = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+
+    of, lf = flash_attention_with_lse(q, k, v, causal=True, interpret=True)
+    od, ld = dot_product_attention_with_lse(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(of), np.asarray(od), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(lf), np.asarray(ld), atol=2e-5)
+
+    def loss_flash(q, k, v):
+        o, lse = flash_attention_with_lse(q, k, v, causal=True,
+                                          interpret=True)
+        return jnp.sum(o ** 2) + jnp.sum(jnp.sin(lse))
+
+    def loss_dense(q, k, v):
+        o, lse = dot_product_attention_with_lse(q, k, v, causal=True)
+        return jnp.sum(o ** 2) + jnp.sum(jnp.sin(lse))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, bb, name in zip(gf, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb), atol=3e-4,
+                                   err_msg=f"d{name} mismatch")

@@ -1,3 +1,6 @@
-from tpucfn.kernels.flash_attention import flash_attention  # noqa: F401
+from tpucfn.kernels.flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_attention_with_lse,
+)
 from tpucfn.kernels.ring_attention import make_ring_attention, ring_attention  # noqa: F401
 from tpucfn.kernels.ulysses import make_ulysses_attention  # noqa: F401

@@ -247,7 +247,11 @@ def _flash_fwd(q, k, v, q_seg, kv_seg, *, causal, q_offset, k_offset,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    *rest, scale, causal, block_q, block_k, q_offset, k_offset,
-                   kv_len, have_segs):
+                   kv_len, have_segs, have_dlse):
+    if have_dlse:
+        dlse_ref, *rest = rest
+    else:
+        dlse_ref = None
     if have_segs:
         qseg_ref, kseg_ref, dq_ref, dq_acc = rest
     else:
@@ -288,7 +292,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.where(s > NEG_INF / 2, jnp.exp(s - lse[:, None]), 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        resid = dp - delta[:, None]
+        if have_dlse:
+            # When LSE is itself an output (ring-hop merge weights),
+            # its cotangent flows through d lse / d s = p.
+            resid = resid + dlse_ref[0, 0][:, 0][:, None]
+        ds = p * resid * scale
         dq_acc[:] += jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
 
     @pl.when(ki == pl.num_programs(3) - 1)
@@ -298,7 +307,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     *rest, scale, causal, block_q, block_k, q_offset, k_offset,
-                    kv_len, have_segs):
+                    kv_len, have_segs, have_dlse):
+    if have_dlse:
+        dlse_ref, *rest = rest
+    else:
+        dlse_ref = None
     if have_segs:
         qseg_ref, kseg_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
     else:
@@ -349,7 +362,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                          preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale  # (BQ, BK)
+        resid = dp - delta[:, None]
+        if have_dlse:
+            resid = resid + dlse_ref[0, 0][:, 0][:, None]
+        ds = p * resid * scale  # (BQ, BK)
         dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
 
@@ -361,18 +377,23 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, o, lse, do, q_seg, kv_seg, *, causal, q_offset,
-               k_offset, kv_len, block_sizes, interpret):
-    """q/do: (B, H, SQ, D); k/v: (B, HKV, SK, D) — KV stays un-repeated."""
+               k_offset, kv_len, block_sizes, interpret, dlse=None):
+    """q/do: (B, H, SQ, D); k/v: (B, HKV, SK, D) — KV stays un-repeated.
+    ``dlse`` (B, H, SQ) is the LSE-output cotangent for the with-lse
+    variant (ring hops); None when only O was consumed."""
     b, h, sq, d = q.shape
     hkv, sk = k.shape[1], k.shape[2]
     rep = h // hkv
     block_q, block_k = block_sizes
     scale = d ** -0.5
     have_segs = q_seg is not None
+    have_dlse = dlse is not None
     skip_dma = causal and q_offset == 0 and k_offset == 0
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta = delta[..., None] * jnp.ones((1, LANES), jnp.float32)  # (B,H,SQ,LANES)
+    dlse_l = (dlse.astype(jnp.float32)[..., None]
+              * jnp.ones((1, LANES), jnp.float32) if have_dlse else None)
 
     qb = jnp.broadcast_to(q_seg[:, :, None], (b, sq, LANES)) if have_segs else None
     kb = jnp.broadcast_to(kv_seg[:, None, :], (b, SUBLANES, sk)) if have_segs else None
@@ -384,6 +405,9 @@ def _flash_bwd(q, k, v, o, lse, do, q_seg, kv_seg, *, causal, q_offset,
     qrow = pl.BlockSpec((1, 1, block_q, LANES), lambda bi, hi, qi, ki: (bi, hi, qi, 0))
     in_specs = [qspec, kspec, kspec, qspec, qrow, qrow]
     args = [q, k, v, do, lse, delta]
+    if have_dlse:
+        in_specs.append(qrow)
+        args.append(dlse_l)
     if have_segs:
         in_specs.append(pl.BlockSpec((1, block_q, LANES),
                                      lambda bi, hi, qi, ki: (bi, qi, 0)))
@@ -395,7 +419,8 @@ def _flash_bwd(q, k, v, o, lse, do, q_seg, kv_seg, *, causal, q_offset,
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k,
                           q_offset=q_offset, k_offset=k_offset,
-                          kv_len=kv_len, have_segs=have_segs),
+                          kv_len=kv_len, have_segs=have_segs,
+                          have_dlse=have_dlse),
         grid=(b, h, sq // block_q, sk // block_k),
         in_specs=in_specs,
         out_specs=[qspec],
@@ -421,6 +446,9 @@ def _flash_bwd(q, k, v, o, lse, do, q_seg, kv_seg, *, causal, q_offset,
     qrow2 = pl.BlockSpec((1, 1, block_q, LANES), q_map)
     in_specs2 = [qspec2, kspec2, kspec2, qspec2, qrow2, qrow2]
     args2 = [q, k, v, do, lse, delta]
+    if have_dlse:
+        in_specs2.append(qrow2)
+        args2.append(dlse_l)
     if have_segs:
         in_specs2.append(pl.BlockSpec((1, block_q, LANES),
                                       lambda bi, hk, ki, ri, qi: (bi, qi, 0)))
@@ -432,7 +460,8 @@ def _flash_bwd(q, k, v, o, lse, do, q_seg, kv_seg, *, causal, q_offset,
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k,
                           q_offset=q_offset, k_offset=k_offset,
-                          kv_len=kv_len, have_segs=have_segs),
+                          kv_len=kv_len, have_segs=have_segs,
+                          have_dlse=have_dlse),
         grid=(b, hkv, sk // block_k, rep, sq // block_q),
         in_specs=in_specs2,
         out_specs=[kspec2, kspec2],
@@ -492,6 +521,82 @@ def _make_flash(causal, q_offset, k_offset, kv_len, block_sizes, interpret):
     return run
 
 
+def _make_flash_with_lse(causal, q_offset, k_offset, kv_len, block_sizes,
+                         interpret):
+    """Like _make_flash but LSE is a first-class differentiable output
+    (the ring-hop merge consumes it): the backward takes (do, dlse) and
+    routes dlse through the kernels' p·dlse term."""
+
+    def _fwd_pair(q, k, v):
+        o, lse_l = _flash_fwd(q, k, v, None, None, causal=causal,
+                              q_offset=q_offset, k_offset=k_offset,
+                              kv_len=kv_len, block_sizes=block_sizes,
+                              interpret=interpret)
+        return o, lse_l[..., 0]  # (B, H, SQ) float32
+
+    @jax.custom_vjp
+    def run(q, k, v):
+        return _fwd_pair(q, k, v)
+
+    def fwd(q, k, v):
+        o, lse = _fwd_pair(q, k, v)
+        return (o, lse), (q, k, v, o, lse)
+
+    def bwd(res, cts):
+        do, dlse = cts
+        q, k, v, o, lse = res
+        lse_l = lse[..., None] * jnp.ones((1, LANES), jnp.float32)
+        dq, dk, dv = _flash_bwd(q, k, v, o, lse_l, do, None, None,
+                                causal=causal, q_offset=q_offset,
+                                k_offset=k_offset, kv_len=kv_len,
+                                block_sizes=block_sizes, interpret=interpret,
+                                dlse=dlse)
+        return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+    run.defvjp(fwd, bwd)
+    return run
+
+
+def _prep_inputs(q, k, v, block_q, block_k, interpret):
+    """Shared wrapper prologue: interpret default, block selection, and
+    layout/pad of (B, S, H, D) inputs into kernel (B, H, S_pad, D)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bq0, bk0 = _default_blocks()
+    sq, sk = q.shape[1], k.shape[1]
+    blk_q, sq_pad = _block_and_pad(sq, block_q or bq0)
+    blk_k, sk_pad = _block_and_pad(sk, block_k or bk0)
+    qt = _pad_seq(jnp.swapaxes(q, 1, 2), sq_pad, 2)
+    kt = _pad_seq(jnp.swapaxes(k, 1, 2), sk_pad, 2)
+    vt = _pad_seq(jnp.swapaxes(v, 1, 2), sk_pad, 2)
+    return qt, kt, vt, (blk_q, blk_k), (sq, sk, sq_pad, sk_pad), interpret
+
+
+def flash_attention_with_lse(
+    q: jax.Array,  # (B, SQ, H, D)
+    k: jax.Array,  # (B, SK, HKV, D)
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    q_offset: int = 0,
+    k_offset: int = 0,
+    block_q: int | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """(out (B,SQ,H,D), lse (B,SQ,H)) — the flash counterpart of
+    :func:`tpucfn.ops.attention.dot_product_attention_with_lse`, for
+    ring-attention hops (rows attending to nothing give lse = NEG_INF).
+    Differentiable in both outputs."""
+    qt, kt, vt, blocks, (sq, sk, _, _), interpret = _prep_inputs(
+        q, k, v, block_q, block_k, interpret)
+    run = _make_flash_with_lse(causal, int(q_offset), int(k_offset), sk,
+                               blocks, interpret)
+    o, lse = run(qt, kt, vt)
+    return (jnp.swapaxes(o[:, :, :sq], 1, 2),
+            jnp.swapaxes(lse[:, :, :sq], 1, 2))
+
+
 def _default_blocks() -> tuple[int, int]:
     return (int(os.environ.get("TPUCFN_FLASH_BLOCK_Q", "128")),
             int(os.environ.get("TPUCFN_FLASH_BLOCK_K", "128")))
@@ -523,12 +628,8 @@ def flash_attention(
     if mask is not None:
         raise NotImplementedError(
             "flash_attention supports causal/segment masking only")
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    bq0, bk0 = _default_blocks()
-    sq, sk = q.shape[1], k.shape[1]
-    blk_q, sq_pad = _block_and_pad(sq, block_q or bq0)
-    blk_k, sk_pad = _block_and_pad(sk, block_k or bk0)
+    qt, kt, vt, blocks, (sq, sk, sq_pad, sk_pad), interpret = _prep_inputs(
+        q, k, v, block_q, block_k, interpret)
 
     q_seg = kv_seg = None
     if segment_ids is not None:
@@ -542,10 +643,7 @@ def flash_attention(
             jnp.arange(sk_pad)[None, :] < sk,
             _pad_seq(kv_seg.astype(jnp.int32), sk_pad, 1), -1)
 
-    qt = _pad_seq(jnp.swapaxes(q, 1, 2), sq_pad, 2)
-    kt = _pad_seq(jnp.swapaxes(k, 1, 2), sk_pad, 2)
-    vt = _pad_seq(jnp.swapaxes(v, 1, 2), sk_pad, 2)
     run = _make_flash(causal, int(q_offset), int(k_offset), sk,
-                      (blk_q, blk_k), interpret)
+                      blocks, interpret)
     o = run(qt, kt, vt, q_seg, kv_seg)
     return jnp.swapaxes(o[:, :, :sq], 1, 2)

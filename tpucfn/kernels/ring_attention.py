@@ -48,9 +48,22 @@ def ring_attention(
     *,
     axis: str = AXIS_CONTEXT,
     causal: bool = True,
+    hop_attention: str = "dense",  # "dense" (XLA) | "flash" (Pallas kernel)
 ) -> jax.Array:
     """Per-shard ring attention body. Requires an active ``axis`` context
-    (shard_map); sequence shards must be equal-sized and in axis order."""
+    (shard_map); sequence shards must be equal-sized and in axis order.
+
+    ``hop_attention="flash"`` runs each hop through the Pallas
+    FlashAttention kernel instead of XLA dense — O(S_loc·D) VMEM per hop
+    instead of O(S_loc²) logits, the long-context configuration.  The
+    kernel needs static masking, so hops use the causal *trichotomy*:
+    relative to this shard, a KV source is either the same shard (true
+    causal), strictly in the past (no mask), or strictly in the future
+    (fully masked — contribute nothing); ``lax.cond`` picks per hop.
+    """
+    if hop_attention not in ("dense", "flash"):
+        raise ValueError(f"hop_attention {hop_attention!r} not in "
+                         "('dense', 'flash')")
     n = lax.axis_size(axis)
     idx = lax.axis_index(axis)
     sq, sk = q.shape[1], k.shape[1]
@@ -62,9 +75,13 @@ def ring_attention(
     kk, vv = k, v
     for step in range(n):
         src = (idx - step) % n  # whose KV shard we hold this hop
-        blk_o, blk_lse = dot_product_attention_with_lse(
-            q, kk, vv, causal=causal, q_offset=q_off, k_offset=src * sk
-        )
+        if hop_attention == "flash":
+            blk_o, blk_lse = _flash_hop(q, kk, vv, step=step, src=src,
+                                        idx=idx, causal=causal)
+        else:
+            blk_o, blk_lse = dot_product_attention_with_lse(
+                q, kk, vv, causal=causal, q_offset=q_off, k_offset=src * sk
+            )
         o, lse = _merge(o, lse, blk_o, blk_lse)
         if step < n - 1:
             perm = [(i, (i + 1) % n) for i in range(n)]
@@ -73,17 +90,41 @@ def ring_attention(
     return o.astype(q.dtype)
 
 
+def _flash_hop(q, kk, vv, *, step, src, idx, causal):
+    """One ring hop through the flash kernel, mask chosen by the causal
+    trichotomy. ``step`` is static: step 0 holds the shard's own KV
+    (true-causal, decided in Python); later hops branch past/future at
+    runtime (src/idx are traced)."""
+    from tpucfn.kernels.flash_attention import flash_attention_with_lse
+
+    def past(_):
+        return flash_attention_with_lse(q, kk, vv, causal=False)
+
+    def future(_):
+        return (jnp.zeros(q.shape, q.dtype),
+                jnp.full(q.shape[:3], NEG_INF, jnp.float32))
+
+    if not causal:
+        return past(None)
+    if step == 0:  # src == idx exactly when step == 0
+        return flash_attention_with_lse(q, kk, vv, causal=True)
+    return lax.cond(src < idx, past, future, None)
+
+
 def make_ring_attention(
     mesh: Mesh,
     *,
     seq_axis: str = AXIS_CONTEXT,
     heads_axis: str | None = AXIS_TENSOR,
     batch_axes: Sequence[str] = BATCH_AXES,
+    hop_attention: str = "dense",
 ):
     """AttentionFn for the model layer: global (B, S, H, D) arrays in, ring
     attention over the context axis inside. Plugs into
     ``CausalSelfAttention(attention_fn=...)`` — the model stays identical;
     only the attention inner op changes (SURVEY.md §5 long-context row).
+    ``hop_attention="flash"`` routes each hop through the Pallas kernel
+    (see :func:`ring_attention`).
     """
     spec = P(tuple(batch_axes), seq_axis, heads_axis)
 
@@ -91,7 +132,9 @@ def make_ring_attention(
         if mask is not None:
             raise NotImplementedError("ring attention is causal-only")
         fn = jax.shard_map(
-            lambda q_, k_, v_: ring_attention(q_, k_, v_, axis=seq_axis, causal=causal),
+            lambda q_, k_, v_: ring_attention(q_, k_, v_, axis=seq_axis,
+                                              causal=causal,
+                                              hop_attention=hop_attention),
             mesh=mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,
