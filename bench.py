@@ -172,6 +172,120 @@ def orchestrate() -> int:
 # environment selects.
 # --------------------------------------------------------------------------
 
+
+def _measure_trainer(trainer, state, batch, *, steps, warmup):
+    """Shared measurement scaffold: compile step, XLA cost analysis,
+    warmup, timed async chain. Returns (state, dict)."""
+    import time as _time
+
+    import jax
+
+    t0 = _time.perf_counter()
+    state, metrics = trainer.step(state, batch)
+    float(metrics["loss"])  # value fetch forces a true device sync
+    compile_s = _time.perf_counter() - t0
+
+    flops_per_dev_step = None
+    try:
+        cost = (trainer._jit_step.lower(trainer.abstract_state(), batch)
+                .compile().cost_analysis())
+        if cost and cost.get("flops"):
+            flops_per_dev_step = float(cost["flops"])
+    except Exception:  # noqa: BLE001 — cost analysis is best-effort
+        pass
+
+    for _ in range(warmup):
+        state, metrics = trainer.step(state, batch)
+    float(metrics["loss"])
+
+    # Timed region: enqueue steps and sync once at the end — the state
+    # dependency chain forces serial device execution; one final fetch
+    # avoids per-step host round-trips (dominant on the tunneled chip).
+    t0 = _time.perf_counter()
+    for _ in range(steps):
+        state, metrics = trainer.step(state, batch)
+    final_loss = float(metrics["loss"])
+    mean_step = (_time.perf_counter() - t0) / steps
+
+    device = jax.devices()[0]
+    peak = _peak_tflops(device.device_kind)
+    mfu = None
+    if flops_per_dev_step and peak and device.platform == "tpu":
+        mfu = round(flops_per_dev_step / mean_step / (peak * 1e12), 4)
+    return state, {
+        "mean_step_s": round(mean_step, 5),
+        "compile_s": round(compile_s, 2),
+        "final_loss": round(final_loss, 4),
+        "flops_per_dev_step_g": (round(flops_per_dev_step / 1e9, 1)
+                                 if flops_per_dev_step else None),
+        "peak_bf16_tflops": peak,
+        "mfu": mfu,
+        "platform": device.platform,
+        "device_kind": device.device_kind,
+    }
+
+
+def _worker_llama(tiny: bool) -> int:
+    """Secondary bench (TPUCFN_BENCH_MODEL=llama): Llama causal-LM
+    training tokens/sec/chip + MFU. The reference never trained an LLM,
+    so vs_baseline is reported as 0.0 (no denominator exists); MFU is
+    the self-contained number."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tpucfn.mesh import MeshSpec, build_mesh
+    from tpucfn.models.llama import Llama, LlamaConfig, causal_lm_loss, sharding_rules
+    from tpucfn.parallel import shard_batch
+    from tpucfn.train import Trainer
+
+    n_dev = jax.device_count()
+    if tiny:
+        cfg = LlamaConfig.tiny()
+        seq, per_chip_batch, steps, warmup = 128, 4, 6, 2
+    else:
+        cfg = LlamaConfig.llama3_1b()
+        seq, per_chip_batch, steps, warmup = 2048, 8, 20, 3
+    per_chip_batch = int(os.environ.get("TPUCFN_BENCH_BATCH", per_chip_batch))
+    global_batch = per_chip_batch * n_dev
+
+    mesh = build_mesh(MeshSpec.for_devices(n_dev))
+    model = Llama(cfg)
+    sample = jnp.zeros((max(2, n_dev), seq), jnp.int32)
+
+    def init_fn(rng):
+        return model.init(rng, sample)["params"], {}
+
+    def loss_fn(params, mstate, batch, rng):
+        loss, acc = causal_lm_loss(
+            model.apply({"params": params}, batch["tokens"]), batch["tokens"])
+        return loss, ({"accuracy": acc}, mstate)
+
+    trainer = Trainer(mesh, sharding_rules(cfg), loss_fn,
+                      optax.adamw(1e-4), init_fn)
+    state = trainer.init(jax.random.key(0))
+    rs = np.random.RandomState(0)
+    batch = shard_batch(mesh, {"tokens": rs.randint(
+        0, cfg.vocab_size, (global_batch, seq)).astype(np.int32)})
+
+    state, m = _measure_trainer(trainer, state, batch, steps=steps,
+                                warmup=warmup)
+    toks_chip = global_batch * seq / m["mean_step_s"] / n_dev
+    print(json.dumps({
+        "metric": ("llama3_1b_train_tokens_per_sec_per_chip" if not tiny
+                   else "tiny_llama_train_tokens_per_sec_per_chip"),
+        "value": round(toks_chip, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 0.0,
+        "detail": {"devices": n_dev, "global_batch": global_batch,
+                   "seq_len": seq, **m},
+    }))
+    return 0
+
+
 def worker() -> int:
     import jax
 
@@ -200,6 +314,8 @@ def worker() -> int:
     from tpucfn.train import Trainer
 
     tiny = os.environ.get("TPUCFN_BENCH_PRESET", "full") == "tiny"
+    if os.environ.get("TPUCFN_BENCH_MODEL", "resnet") == "llama":
+        return _worker_llama(tiny)
     n_dev = jax.device_count()
 
     # --- "create-stack" leg of time-to-first-step (BASELINE metric 2).
@@ -258,46 +374,9 @@ def worker() -> int:
         "label": rs.randint(0, classes, (global_batch,)).astype(np.int32),
     })
 
-    t0 = time.perf_counter()
-    state, metrics = trainer.step(state, batch)
-    float(metrics["loss"])  # value fetch forces a true device sync
-    compile_s = time.perf_counter() - t0
-
-    # Measured model flops from the compiled program (per device, per
-    # step): the MFU numerator — self-contained, unlike vs_baseline's
-    # era-lore denominator (VERDICT r1 weak #4).
-    flops_per_dev_step = None
-    try:
-        cost = (trainer._jit_step.lower(trainer.abstract_state(), batch)
-                .compile().cost_analysis())
-        if cost and cost.get("flops"):
-            flops_per_dev_step = float(cost["flops"])
-    except Exception:  # noqa: BLE001 — cost analysis is best-effort
-        pass
-
-    # Warmup steps (post-compile jitter), fully synced.
-    for _ in range(warmup):
-        state, metrics = trainer.step(state, batch)
-    float(metrics["loss"])
-
-    # Timed region: enqueue `steps` steps and sync once at the end. The
-    # chain of state dependencies forces serial device execution; a single
-    # final value fetch avoids paying host↔device round-trip latency per
-    # step (which on the tunneled dev chip dominates and on a real pod
-    # would not exist — the input pipeline keeps the queue full).
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = trainer.step(state, batch)
-    final_loss = float(metrics["loss"])
-    mean_step = (time.perf_counter() - t0) / steps
-
-    ips_chip = global_batch / mean_step / n_dev
-    device_kind = jax.devices()[0].device_kind
-    peak = _peak_tflops(device_kind)
-    mfu = None
-    if flops_per_dev_step and peak and jax.devices()[0].platform == "tpu":
-        mfu = round(flops_per_dev_step / mean_step / (peak * 1e12), 4)
-
+    state, m = _measure_trainer(trainer, state, batch, steps=steps,
+                                warmup=warmup)
+    ips_chip = global_batch / m["mean_step_s"] / n_dev
     print(json.dumps({
         "metric": "resnet50_imagenet_train_images_per_sec_per_chip"
         if not tiny else "tiny_resnet_train_images_per_sec_per_chip",
@@ -306,18 +385,11 @@ def worker() -> int:
         "vs_baseline": round(ips_chip / REFERENCE_IMAGES_PER_SEC_PER_ACCEL, 3),
         "detail": {
             "devices": n_dev,
-            "platform": jax.devices()[0].platform,
-            "device_kind": device_kind,
             "global_batch": global_batch,
-            "mean_step_s": round(mean_step, 5),
-            "compile_s": round(compile_s, 2),
             "init_s": round(init_s, 2),
-            "time_to_first_step_s": round(provision_s + init_s + compile_s, 2),
-            "final_loss": round(final_loss, 4),
-            "flops_per_dev_step_g": (round(flops_per_dev_step / 1e9, 1)
-                                     if flops_per_dev_step else None),
-            "peak_bf16_tflops": peak,
-            "mfu": mfu,
+            "time_to_first_step_s": round(
+                provision_s + init_s + m["compile_s"], 2),
+            **m,
         },
     }))
     return 0

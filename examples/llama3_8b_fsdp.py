@@ -107,10 +107,13 @@ def main() -> int:
         print(f"pipeline: {args.pipeline} stages x {args.microbatches} "
               f"microbatches, bubble fraction {bubble:.3f}", flush=True)
 
+        hop = "flash" if args.ring_flash else "dense"
+
         def forward(params, tokens):
             return pipelined_llama_apply(cfg, mesh, params, tokens,
                                          num_microbatches=args.microbatches,
-                                         context_parallel=args.context > 1)
+                                         context_parallel=args.context > 1,
+                                         hop_attention=hop)
     else:
         def forward(params, tokens):
             return model.apply({"params": params}, tokens)
@@ -129,7 +132,8 @@ def main() -> int:
                 logits = pipelined_llama_apply(
                     cfg, mesh, p, tokens,
                     num_microbatches=args.microbatches,
-                    context_parallel=args.context > 1)
+                    context_parallel=args.context > 1,
+                    hop_attention="flash" if args.ring_flash else "dense")
                 return causal_lm_loss(logits, tokens, z_loss=args.z_loss)[0]
 
             def pp_loss_fwd(p):
@@ -137,6 +141,7 @@ def main() -> int:
                     cfg, mesh, p, tokens,
                     num_microbatches=args.microbatches,
                     context_parallel=args.context > 1,
+                    hop_attention="flash" if args.ring_flash else "dense",
                     z_loss=args.z_loss)
                 return loss, grads
 

@@ -184,3 +184,17 @@ def test_ring_flash_hops_gradients(mesh_ctx4):
     for a, b, name in zip(gr, gd, "qkv"):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-4,
                                    err_msg=f"d{name} mismatch")
+
+
+def test_ulysses_flash_inner(mesh_ctx4):
+    """Ulysses + flash: after the head-scatter all-to-all each device
+    holds the FULL sequence for its head subset, so the flash kernel
+    drops in as the inner op unchanged."""
+    from tpucfn.kernels import flash_attention
+
+    q, k, v = _qkv(s=64, h=8, hkv=8)
+    ul = make_ulysses_attention(mesh_ctx4, heads_axis=None,
+                                inner=flash_attention)
+    out = ul(q, k, v, causal=True)
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)

@@ -63,14 +63,15 @@ def pp_sharding_rules(cfg: LlamaConfig, *, fsdp: bool = True,
                           layer_lead_axis=AXIS_PIPELINE)
 
 
-def _attention_for(context_parallel: bool):
+def _attention_for(context_parallel: bool, hop_attention: str = "dense"):
     if not context_parallel:
         return dot_product_attention
 
     def att(q, k, v, *, causal=True, mask=None, q_offset=0, k_offset=0):
         if mask is not None:
             raise NotImplementedError("ring attention is causal-only")
-        return ring_attention(q, k, v, axis=AXIS_CONTEXT, causal=causal)
+        return ring_attention(q, k, v, axis=AXIS_CONTEXT, causal=causal,
+                              hop_attention=hop_attention)
 
     return att
 
@@ -125,16 +126,18 @@ def pipelined_llama_apply(
     *,
     num_microbatches: int = 4,
     context_parallel: bool = False,
+    hop_attention: str = "dense",
 ) -> jax.Array:
     """tokens (B, S) → logits (B, S, vocab), numerically equal to
     ``Llama(cfg).apply`` with the same params (tests assert it).
 
     ``context_parallel=True`` additionally shards the sequence over the
-    ``context`` axis with ring attention inside the stage body."""
+    ``context`` axis with ring attention inside the stage body
+    (``hop_attention="flash"`` for Pallas-kernel hops)."""
     if not cfg.scan_layers:
         raise ValueError("pipeline execution needs scan_layers=True")
 
-    att = _attention_for(context_parallel)
+    att = _attention_for(context_parallel, hop_attention)
 
     embed = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype)
@@ -172,6 +175,7 @@ def pipelined_llama_value_and_grad(
     *,
     num_microbatches: int = 4,
     context_parallel: bool = False,
+    hop_attention: str = "dense",
     z_loss: float = 0.0,
 ):
     """1F1B-scheduled causal-LM loss and gradients.
@@ -190,7 +194,7 @@ def pipelined_llama_value_and_grad(
     """
     if not cfg.scan_layers:
         raise ValueError("pipeline execution needs scan_layers=True")
-    att = _attention_for(context_parallel)
+    att = _attention_for(context_parallel, hop_attention)
     b, s = tokens.shape
     mb_size = b // num_microbatches
 
