@@ -42,8 +42,9 @@ def enable_compile_cache(cache_dir: str | None = None) -> str:
     ``tpucfn launch`` on a pod — then skips recompilation, which is what
     keeps time_to_first_step from being compile-dominated (SURVEY.md §7.4
     item 6, BASELINE.md metric 2).  Safe to call multiple times."""
-    cache_dir = cache_dir or os.environ.get(
-        "TPUCFN_XLA_CACHE", "/tmp/tpucfn_xla_cache")
+    from tpucfn.utils.env import xla_cache_dir
+
+    cache_dir = cache_dir or xla_cache_dir()
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     return cache_dir

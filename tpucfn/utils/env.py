@@ -16,9 +16,18 @@ load it by file path before any backend decision is made.
 
 from __future__ import annotations
 
+import os
 from typing import Mapping
 
 _ACCEL_ENV_PREFIXES = ("JAX_", "XLA_", "TPU_", "LIBTPU", "PJRT_", "PALLAS_")
+
+
+def xla_cache_dir() -> str:
+    """The one resolution rule for the persistent XLA compile cache
+    location ($TPUCFN_XLA_CACHE or /tmp/tpucfn_xla_cache) — shared by
+    obs.enable_compile_cache (runtime/bench path) and the dryrun child
+    env, so every invocation hits the same cache."""
+    return os.environ.get("TPUCFN_XLA_CACHE", "/tmp/tpucfn_xla_cache")
 
 
 def scrub_accelerator_env(
