@@ -26,8 +26,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 def _time(fn, *args, iters=10):
     import jax
 
-    fn(*args)[0].block_until_ready() if isinstance(fn(*args), tuple) else \
-        jax.block_until_ready(fn(*args))  # compile + warm
+    jax.block_until_ready(fn(*args))  # compile + warm (pytree-safe)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)

@@ -124,13 +124,20 @@ def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=(),
         start_profiler_server(args.profile_server)
 
     run_dir = Path(args.run_dir)
-    if args.fresh and (run_dir / "ckpt").exists():
+    if args.fresh:
         # Clear, don't just ignore: stale checkpoints would swallow the
         # fresh run's saves at colliding steps, and the next (auto-resume)
-        # relaunch would restore the pre-fresh weights.
-        import shutil
+        # relaunch would restore the pre-fresh weights. Process 0 owns
+        # the delete (the run dir may be a shared EFS-style mount) and
+        # everyone barriers before the CheckpointManager opens.
+        if jax.process_index() == 0 and (run_dir / "ckpt").exists():
+            import shutil
 
-        shutil.rmtree(run_dir / "ckpt")
+            shutil.rmtree(run_dir / "ckpt", ignore_errors=True)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("tpucfn-fresh-ckpt-clear")
     logger = MetricLogger(run_dir / "logs", stdout_every=args.log_every)
     timer = StepTimer()
     t_start = time.perf_counter()

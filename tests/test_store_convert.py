@@ -247,11 +247,9 @@ def test_imagenet_example_trains_from_converted_tree(tmp_path):
     _make_image_tree(src, classes=("a", "b"), per_class=16)
     convert_image_tree(src, tmp_path / "shards", num_shards=4)
 
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
-                        + " --xla_force_host_platform_device_count=8")
+    from tpucfn.utils.env import scrub_accelerator_env
+
+    env = scrub_accelerator_env(os.environ, n_devices=8)
     env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
     r = subprocess.run([
         sys.executable, str(REPO / "examples" / "imagenet_resnet50.py"),

@@ -107,8 +107,9 @@ def convert_cifar_binary(
 
 
 def upload_shards(paths: list[str | Path], store: Store, prefix: str = "") -> None:
-    """Publish converted shards (and any sidecar jsons) to a Store."""
+    """Publish converted shards (and any sidecar jsons) to a Store —
+    streamed from disk (Store.upload), no per-shard RAM pass."""
     for p in paths:
         p = Path(p)
         key = f"{prefix}/{p.name}" if prefix else p.name
-        store.write_bytes(key, p.read_bytes())
+        store.upload(p, key)

@@ -60,6 +60,11 @@ class Store:
         """Object size in bytes, or None if unknown/cheaply unavailable."""
         return None
 
+    def upload(self, src: str | Path, key: str) -> None:
+        """Publish a local file to ``key``. Default round-trips through
+        memory; path-capable backends override to stream from disk."""
+        self.write_bytes(key, Path(src).read_bytes())
+
 
 class LocalStore(Store):
     def __init__(self, root: str | Path):
@@ -97,6 +102,13 @@ class LocalStore(Store):
     def size(self, key: str) -> int | None:
         p = self._p(key)
         return p.stat().st_size if p.exists() else None
+
+    def upload(self, src: str | Path, key: str) -> None:
+        import shutil
+
+        dest = self._p(key)
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copyfile(src, dest)
 
 
 class CliObjectStore(Store):
@@ -174,8 +186,12 @@ class CliObjectStore(Store):
         with tempfile.TemporaryDirectory() as td:
             src = Path(td) / "obj"
             src.write_bytes(data)
-            cli = ["gsutil", "cp"] if self.scheme == "gs" else ["aws", "s3", "cp"]
-            self.runner(cli + [str(src), self._url(key)])
+            self.upload(src, key)
+
+    def upload(self, src: str | Path, key: str) -> None:
+        # Stream straight from disk: no RAM pass, no temp copy.
+        cli = ["gsutil", "cp"] if self.scheme == "gs" else ["aws", "s3", "cp"]
+        self.runner(cli + [str(src), self._url(key)])
 
     def size(self, key: str) -> int | None:
         try:
