@@ -134,6 +134,13 @@ def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=(),
             import shutil
 
             shutil.rmtree(run_dir / "ckpt", ignore_errors=True)
+            if (run_dir / "ckpt").exists():
+                # A silent partial delete would recreate exactly the
+                # stale-resume corruption --fresh exists to prevent.
+                raise RuntimeError(
+                    f"--fresh could not clear {run_dir / 'ckpt'} (shared-"
+                    "mount file still held open, or permissions?) — clear "
+                    "it manually or use a new --run-dir")
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 

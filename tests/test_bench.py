@@ -11,7 +11,10 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 
 
-def _run_bench(extra_env=None, timeout=900):
+def _run_bench(extra_env=None, timeout=1200):
+    # Outer timeout must exceed bench.py's internal CPU-worker budget
+    # (TPUCFN_BENCH_CPU_TIMEOUT_S=900) so a slow worker surfaces as the
+    # orchestrator's bench_failed record, not an opaque harness kill.
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)  # forces the CPU-fallback path
     env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")

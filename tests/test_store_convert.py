@@ -350,3 +350,11 @@ def test_local_store_list_sibling_prefix_excluded(tmp_path):
     assert store.list("imagenet") == ["imagenet/a.tpurec"]
     paths = stage(store, "imagenet", tmp_path / "cache")
     assert [p.name for p in paths] == ["a.tpurec"]
+
+
+def test_local_store_upload_onto_itself_is_noop(tmp_path):
+    store = LocalStore(tmp_path)
+    f = tmp_path / "x.tpurec"
+    f.write_bytes(b"data")
+    store.upload(f, "x.tpurec")  # same file: must not raise
+    assert f.read_bytes() == b"data"

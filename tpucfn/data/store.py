@@ -23,6 +23,7 @@ the hot path; steps stream from local disk/page cache.
 
 from __future__ import annotations
 
+import os
 import subprocess
 from pathlib import Path
 from typing import Callable, Sequence
@@ -107,6 +108,8 @@ class LocalStore(Store):
         import shutil
 
         dest = self._p(key)
+        if dest.exists() and os.path.samefile(src, dest):
+            return  # publishing a file onto itself is a no-op
         dest.parent.mkdir(parents=True, exist_ok=True)
         shutil.copyfile(src, dest)
 
