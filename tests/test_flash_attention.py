@@ -245,3 +245,29 @@ def test_flash_with_lse_matches_dense_and_dlse_grads():
     for a, bb, name in zip(gf, gd, "qkv"):
         np.testing.assert_allclose(np.asarray(a), np.asarray(bb), atol=3e-4,
                                    err_msg=f"d{name} mismatch")
+
+
+def test_gqa_with_segments_combined_gradients():
+    """GQA (rep grid dim) and segment masking together in the dK/dV
+    kernel — each is covered alone above; this pins the combination."""
+    rs = np.random.RandomState(6)
+    b, s, d = 1, 96, 16
+    q = jnp.asarray(rs.randn(b, s, 8, d).astype(np.float32))
+    k = jnp.asarray(rs.randn(b, s, 2, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, s, 2, d).astype(np.float32))
+    segs = jnp.asarray((np.arange(s) >= 40).astype(np.int32))[None].repeat(b, 0)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, segment_ids=segs,
+                                       block_q=32, block_k=32,
+                                       interpret=True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dot_product_attention(
+            q, k, v, causal=True, mask=_seg_mask(segs, segs)) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, bb, name in zip(gf, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb), atol=3e-4,
+                                   err_msg=f"d{name} mismatch")
