@@ -130,21 +130,37 @@ def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=(),
         # relaunch would restore the pre-fresh weights. Process 0 owns
         # the delete (the run dir may be a shared EFS-style mount) and
         # everyone barriers before the CheckpointManager opens.
+        delete_err = ""
         if jax.process_index() == 0 and (run_dir / "ckpt").exists():
             import shutil
 
-            shutil.rmtree(run_dir / "ckpt", ignore_errors=True)
-            if (run_dir / "ckpt").exists():
+            try:
+                shutil.rmtree(run_dir / "ckpt", ignore_errors=True)
+            except OSError as e:  # defensive: ignore_errors should eat these
+                delete_err = f"--fresh delete of {run_dir / 'ckpt'} failed: {e}"
+            if not delete_err and (run_dir / "ckpt").exists():
                 # A silent partial delete would recreate exactly the
                 # stale-resume corruption --fresh exists to prevent.
-                raise RuntimeError(
+                delete_err = (
                     f"--fresh could not clear {run_dir / 'ckpt'} (shared-"
                     "mount file still held open, or permissions?) — clear "
                     "it manually or use a new --run-dir")
         if jax.process_count() > 1:
+            import numpy as np
             from jax.experimental import multihost_utils
 
-            multihost_utils.sync_global_devices("tpucfn-fresh-ckpt-clear")
+            # The broadcast doubles as the barrier AND carries process 0's
+            # outcome: a failed delete must abort the whole gang together,
+            # not leave the other processes wedged in a barrier while
+            # process 0 unwinds (ADVICE r2).
+            failed = int(multihost_utils.broadcast_one_to_all(
+                np.int32(1 if delete_err else 0)))
+            if failed:
+                raise RuntimeError(
+                    delete_err or "--fresh checkpoint clear failed on "
+                    "process 0 — see its log for the path")
+        elif delete_err:
+            raise RuntimeError(delete_err)
     logger = MetricLogger(run_dir / "logs", stdout_every=args.log_every)
     timer = StepTimer()
     t_start = time.perf_counter()

@@ -564,6 +564,10 @@ def _prep_inputs(q, k, v, block_q, block_k, interpret):
         interpret = jax.default_backend() != "tpu"
     bq0, bk0 = _default_blocks()
     sq, sk = q.shape[1], k.shape[1]
+    if block_q is not None:
+        block_q = _check_block(block_q, "block_q")
+    if block_k is not None:
+        block_k = _check_block(block_k, "block_k")
     blk_q, sq_pad = _block_and_pad(sq, block_q or bq0)
     blk_k, sk_pad = _block_and_pad(sk, block_k or bk0)
     qt = _pad_seq(jnp.swapaxes(q, 1, 2), sq_pad, 2)
@@ -597,9 +601,28 @@ def flash_attention_with_lse(
             jnp.swapaxes(lse[:, :, :sq], 1, 2))
 
 
+def _check_block(value: int, origin: str) -> int:
+    """Block targets must be positive multiples of the sublane tile —
+    anything else would surface later as a divide-by-zero or an opaque
+    Mosaic lowering failure on TPU (ADVICE r2)."""
+    try:
+        as_int = int(value)
+        if as_int != float(value):  # reject silent truncation (136.5 -> 136)
+            raise ValueError
+        value = as_int
+    except (TypeError, ValueError) as e:
+        raise ValueError(f"{origin} must be an integer, got {value!r}") from e
+    if value <= 0 or value % SUBLANES:
+        raise ValueError(
+            f"{origin} must be a positive multiple of {SUBLANES}, got {value}")
+    return value
+
+
 def _default_blocks() -> tuple[int, int]:
-    return (int(os.environ.get("TPUCFN_FLASH_BLOCK_Q", "128")),
-            int(os.environ.get("TPUCFN_FLASH_BLOCK_K", "128")))
+    return (_check_block(os.environ.get("TPUCFN_FLASH_BLOCK_Q", "128"),
+                         "TPUCFN_FLASH_BLOCK_Q"),
+            _check_block(os.environ.get("TPUCFN_FLASH_BLOCK_K", "128"),
+                         "TPUCFN_FLASH_BLOCK_K"))
 
 
 def flash_attention(
@@ -635,10 +658,15 @@ def flash_attention(
     if segment_ids is not None:
         q_seg, kv_seg = (segment_ids if isinstance(segment_ids, tuple)
                          else (segment_ids, segment_ids))
-        # Padded positions get segment -1 (matches nothing, including
-        # other padding — kv_len already masks padded keys; this also
-        # keeps padded *query* rows finite-but-ignored).
-        q_seg = _pad_seq(q_seg.astype(jnp.int32), sq_pad, 1)
+        # Padded positions (query AND key) get segment -1. Padded keys
+        # are already excluded by kv_len; -1 on both sides keeps padded
+        # query rows from sharing a segment with real id-0 tokens (they
+        # end up fully masked -> zero rows, sliced off below). Note
+        # -1 == -1 would let padded queries see padded keys, but kv_len
+        # masks those keys first.
+        q_seg = jnp.where(
+            jnp.arange(sq_pad)[None, :] < sq,
+            _pad_seq(q_seg.astype(jnp.int32), sq_pad, 1), -1)
         kv_seg = jnp.where(
             jnp.arange(sk_pad)[None, :] < sk,
             _pad_seq(kv_seg.astype(jnp.int32), sk_pad, 1), -1)

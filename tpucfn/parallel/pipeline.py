@@ -122,8 +122,11 @@ def bubble_fraction(num_microbatches: int, num_stages: int,
 
 # head_fn(head_params, y, labels) -> scalar loss CONTRIBUTION for one
 # microbatch: sum of per-token losses over this (local) shard divided by
-# the GLOBAL valid-token count, so contributions sum to the global mean
-# across microbatches, pipeline stages, and any reduce_axes shards.
+# that MICROBATCH's global valid-token count (i.e. the per-microbatch
+# mean after psum over any reduce_axes shards). pipeline_1f1b itself
+# averages over microbatches (the 1/M scale in its tick loop), so a
+# head_fn must NOT divide by the all-microbatch token count — that would
+# shrink loss and grads by another factor of M.
 HeadFn = Callable[[Any, jax.Array, jax.Array], jax.Array]
 
 
@@ -142,8 +145,10 @@ def pipeline_1f1b(
     shard_map (manual over ``axis`` and every ``reduce_axes`` entry).
 
     Returns ``(loss, dstage_params, dhead_params, dmicrobatches)`` where
-    the grads are exact for ``loss = Σ_m head_fn(hp, stages(x_m), l_m)``
-    (tests assert parity with jax.grad of the sequential model).
+    the grads are exact for
+    ``loss = (1/M) Σ_m head_fn(hp, stages(x_m), l_m)`` — the microbatch
+    mean, per the HeadFn contract above (tests assert parity with
+    jax.grad of the sequential model).
 
     Timing: stage i forwards micro m at tick m+i (GPipe fill); the last
     stage runs head+backward of micro m in the same tick its forward
