@@ -50,7 +50,14 @@ def main() -> int:
     p.add_argument("--pp-schedule", default="gpipe", choices=["gpipe", "1f1b"],
                    help="gpipe: AD through the forward schedule (O(M) "
                         "activation stash); 1f1b: interleaved fwd/bwd with "
-                        "an O(P) stash (no accuracy metric on this path)")
+                        "an O(P) stash")
+    p.add_argument("--moe-experts", type=int, default=0,
+                   help="replace the dense MLP with a MoE of N experts "
+                        "sharded over the expert axis (0 = dense); aux "
+                        "load-balancing losses are collected on every "
+                        "schedule incl. 1F1B")
+    p.add_argument("--expert", type=int, default=1,
+                   help="expert (MoE) mesh axis size")
     p.add_argument("--num-examples", type=int, default=256)
     p.add_argument("--z-loss", type=float, default=1e-4)
     args = p.parse_args()
@@ -75,6 +82,12 @@ def main() -> int:
         "1b": LlamaConfig.llama3_1b,
         "tiny": LlamaConfig.tiny,
     }[args.model]()
+    if args.moe_experts:
+        import dataclasses
+
+        from tpucfn.models.moe import MoEConfig
+
+        cfg = dataclasses.replace(cfg, moe=MoEConfig(n_experts=args.moe_experts))
 
     run_dir = Path(args.run_dir)
     shards = stage_synthetic(
@@ -86,7 +99,7 @@ def main() -> int:
     n = jax.device_count()
     mesh = build_mesh(MeshSpec.for_devices(
         n, fsdp=args.fsdp, tensor=args.tensor, context=args.context,
-        pipeline=args.pipeline,
+        pipeline=args.pipeline, expert=args.expert,
     ))
     attention = (make_ring_attention(
         mesh, hop_attention="flash" if args.ring_flash else "dense")
@@ -110,13 +123,22 @@ def main() -> int:
         hop = "flash" if args.ring_flash else "dense"
 
         def forward(params, tokens):
-            return pipelined_llama_apply(cfg, mesh, params, tokens,
-                                         num_microbatches=args.microbatches,
-                                         context_parallel=args.context > 1,
-                                         hop_attention=hop)
+            """Returns (logits, moe_aux) — aux is 0.0 for dense models."""
+            out = pipelined_llama_apply(
+                cfg, mesh, params, tokens,
+                num_microbatches=args.microbatches,
+                context_parallel=args.context > 1,
+                hop_attention=hop, with_aux=cfg.moe is not None)
+            return out if cfg.moe is not None else (out, 0.0)
     else:
         def forward(params, tokens):
-            return model.apply({"params": params}, tokens)
+            if cfg.moe is not None:
+                from tpucfn.models.moe import collect_moe_aux
+
+                logits, lcl = model.apply({"params": params}, tokens,
+                                          mutable=["losses"])
+                return logits, collect_moe_aux(lcl)
+            return model.apply({"params": params}, tokens), 0.0
 
     if args.pipeline > 1 and args.pp_schedule == "1f1b":
         from tpucfn.models.llama_pp import pipelined_llama_value_and_grad
@@ -129,33 +151,32 @@ def main() -> int:
 
             @jax.custom_vjp
             def pp_loss(p):
-                logits = pipelined_llama_apply(
-                    cfg, mesh, p, tokens,
-                    num_microbatches=args.microbatches,
-                    context_parallel=args.context > 1,
-                    hop_attention="flash" if args.ring_flash else "dense")
-                return causal_lm_loss(logits, tokens, z_loss=args.z_loss)[0]
+                logits, aux = forward(p, tokens)
+                loss, acc = causal_lm_loss(logits, tokens, z_loss=args.z_loss)
+                return loss + aux, acc
 
             def pp_loss_fwd(p):
-                loss, grads = pipelined_llama_value_and_grad(
+                loss, metrics, grads = pipelined_llama_value_and_grad(
                     cfg, mesh, p, tokens,
                     num_microbatches=args.microbatches,
                     context_parallel=args.context > 1,
                     hop_attention="flash" if args.ring_flash else "dense",
-                    z_loss=args.z_loss)
-                return loss, grads
+                    z_loss=args.z_loss, with_metrics=True)
+                return (loss, metrics["accuracy"]), grads
 
-            def pp_loss_bwd(grads, g):
+            def pp_loss_bwd(grads, cts):
+                g, _ = cts  # accuracy is value-only
                 return (jax.tree.map(lambda x: (x * g).astype(x.dtype),
                                      grads),)
 
             pp_loss.defvjp(pp_loss_fwd, pp_loss_bwd)
-            return pp_loss(params), ({}, mstate)
+            loss, acc = pp_loss(params)
+            return loss, ({"accuracy": acc}, mstate)
     else:
         def loss_fn(params, mstate, batch, rng):
-            logits = forward(params, batch["tokens"])
+            logits, aux = forward(params, batch["tokens"])
             loss, acc = causal_lm_loss(logits, batch["tokens"], z_loss=args.z_loss)
-            return loss, ({"accuracy": acc}, mstate)
+            return loss + aux, ({"accuracy": acc}, mstate)
 
     total = args.steps or 1000
     tx = optax.chain(

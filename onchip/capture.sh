@@ -1,0 +1,23 @@
+#!/bin/bash
+# Sequential on-chip benchmark capture (VERDICT r2 item 1).
+# Runs each bench as its own bounded step so partial results survive a
+# tunnel wedge; never runs two JAX clients concurrently.
+set -u
+cd /root/repo
+mkdir -p onchip
+log=onchip/capture.log
+echo "=== capture start $(date -u +%FT%TZ) ===" >> "$log"
+
+run() {
+  name=$1; shift
+  echo "--- $name start $(date -u +%FT%TZ)" >> "$log"
+  "$@" > "onchip/$name.out" 2> "onchip/$name.err"
+  echo "--- $name rc=$? end $(date -u +%FT%TZ)" >> "$log"
+}
+
+run bench_resnet_full timeout 3600 python bench.py
+run bench_llama      timeout 3600 env TPUCFN_BENCH_MODEL=llama python bench.py
+run flash_s2k        timeout 1800 python benches/flash_bench.py --seqs 2048
+run flash_s8k        timeout 1800 python benches/flash_bench.py --seqs 8192
+run flash_s32k       timeout 2400 python benches/flash_bench.py --seqs 32768
+echo "=== capture done $(date -u +%FT%TZ) ===" >> "$log"

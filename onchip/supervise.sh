@@ -1,0 +1,16 @@
+#!/bin/bash
+# Retry megabench until it completes; a failed client creation (rc 42)
+# means the tunnel is wedged — sleep on the recovery timescale and retry.
+# Never kills a running attempt (killed clients extend the wedge).
+cd /root/repo
+log=onchip/megabench.log
+for attempt in $(seq 1 14); do
+  echo "=== attempt $attempt $(date -u +%FT%TZ) ===" >> "$log"
+  python onchip/megabench.py >> "$log" 2>&1
+  rc=$?
+  echo "=== attempt $attempt rc=$rc $(date -u +%FT%TZ) ===" >> "$log"
+  if [ "$rc" -eq 0 ]; then exit 0; fi
+  sleep 420
+done
+echo "=== supervisor exhausted $(date -u +%FT%TZ) ===" >> "$log"
+exit 1

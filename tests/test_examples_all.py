@@ -88,3 +88,18 @@ def test_llama_pipeline_1f1b_example(tmp_path):
              "--batch-size", "16", "--num-examples", "64", "--pipeline", "2",
              "--microbatches", "4", "--pp-schedule", "1f1b")
     _ok(r)
+
+def test_llama_moe_1f1b_example(tmp_path):
+    """MoE + expert axis + 1F1B: aux losses collected, accuracy logged."""
+    r = _run("llama3_8b_fsdp.py", tmp_path, "--model", "tiny", "--seq-len", "32",
+             "--batch-size", "16", "--num-examples", "64", "--pipeline", "2",
+             "--microbatches", "4", "--pp-schedule", "1f1b",
+             "--moe-experts", "4", "--expert", "2")
+    _ok(r)
+
+
+def test_llama_moe_dense_path_example(tmp_path):
+    """MoE on the non-PP path: sown aux collected via mutable apply."""
+    _ok(_run("llama3_8b_fsdp.py", tmp_path, "--model", "tiny", "--seq-len",
+             "32", "--batch-size", "16", "--num-examples", "64",
+             "--moe-experts", "4", "--expert", "2"))

@@ -41,6 +41,7 @@ from tpucfn.kernels.ring_attention import ring_attention
 from tpucfn.mesh import AXIS_CONTEXT, AXIS_PIPELINE
 from tpucfn.models.layers import RMSNorm
 from tpucfn.models.llama import LlamaBlock, LlamaConfig, sharding_rules
+from tpucfn.models.moe import collect_moe_aux
 from tpucfn.ops.attention import dot_product_attention
 from tpucfn.parallel.pipeline import (
     gpipe,
@@ -63,6 +64,14 @@ def pp_sharding_rules(cfg: LlamaConfig, *, fsdp: bool = True,
                           layer_lead_axis=AXIS_PIPELINE)
 
 
+def _check_moe_cp(with_aux: bool, context_parallel: bool) -> None:
+    if with_aux and context_parallel:
+        raise NotImplementedError(
+            "MoE aux collection under context parallelism is not defined "
+            "yet (per-context-shard routing would need its own aux "
+            "normalization); run MoE pipelines without --context")
+
+
 def _attention_for(context_parallel: bool, hop_attention: str = "dense"):
     if not context_parallel:
         return dot_product_attention
@@ -76,9 +85,17 @@ def _attention_for(context_parallel: bool, hop_attention: str = "dense"):
     return att
 
 
-def _make_stage_fn(cfg: LlamaConfig, att, context_parallel: bool):
+def _make_stage_fn(cfg: LlamaConfig, att, context_parallel: bool,
+                   with_aux: bool = False):
     def stage_fn(stage_params, h):
-        """Apply this stage's layer slice (lax.scan over local layers)."""
+        """Apply this stage's layer slice (lax.scan over local layers).
+
+        ``with_aux``: returns ``(h_out, aux)`` where aux sums the MoE
+        losses sown by this stage's layers — the ``sow`` collection
+        cannot cross the shard_map boundary, so it is collected here per
+        block apply and threaded through the pipeline schedules' aux
+        plumbing instead.
+        """
         if context_parallel:
             # h is the local (mb, S/C, D) shard: RoPE needs the global
             # position of this shard's first token.
@@ -87,6 +104,16 @@ def _make_stage_fn(cfg: LlamaConfig, att, context_parallel: bool):
             q_off = jnp.zeros((), jnp.int32)
 
         def body(carry, layer_params):
+            if with_aux:
+                def apply_fn(p, c):
+                    out, lcl = LlamaBlock(cfg, att).apply(
+                        {"params": p}, c, mutable=["losses"])
+                    return out[0], collect_moe_aux(lcl)
+
+                if cfg.remat:
+                    apply_fn = jax.checkpoint(apply_fn, prevent_cse=False)
+                carry, aux = apply_fn(layer_params, carry)
+                return carry, aux
             if cfg.remat:
                 apply = jax.checkpoint(
                     lambda p, c: LlamaBlock(cfg, att).apply(
@@ -101,7 +128,9 @@ def _make_stage_fn(cfg: LlamaConfig, att, context_parallel: bool):
                 )
             return carry, None
 
-        (h_out, _), _ = lax.scan(body, (h, q_off), stage_params)
+        (h_out, _), auxs = lax.scan(body, (h, q_off), stage_params)
+        if with_aux:
+            return h_out, jnp.sum(auxs)
         return h_out
 
     return stage_fn
@@ -127,15 +156,24 @@ def pipelined_llama_apply(
     num_microbatches: int = 4,
     context_parallel: bool = False,
     hop_attention: str = "dense",
-) -> jax.Array:
+    with_aux: bool = False,
+):
     """tokens (B, S) → logits (B, S, vocab), numerically equal to
     ``Llama(cfg).apply`` with the same params (tests assert it).
 
     ``context_parallel=True`` additionally shards the sequence over the
     ``context`` axis with ring attention inside the stage body
-    (``hop_attention="flash"`` for Pallas-kernel hops)."""
+    (``hop_attention="flash"`` for Pallas-kernel hops).
+
+    ``with_aux=True`` (MoE training through the GPipe schedule) returns
+    ``(logits, aux)`` where aux is the microbatch-mean of the sown MoE
+    losses summed over all layers — differentiable, so
+    ``loss = ce + aux`` trains the router. Per-microbatch routing means
+    aux is defined per microbatch (matching per-micro sequential
+    application, not one full-batch apply)."""
     if not cfg.scan_layers:
         raise ValueError("pipeline execution needs scan_layers=True")
+    _check_moe_cp(with_aux, context_parallel)
 
     att = _attention_for(context_parallel, hop_attention)
 
@@ -143,7 +181,7 @@ def pipelined_llama_apply(
                      param_dtype=cfg.param_dtype)
     x = embed.apply({"params": params["embed_tokens"]}, tokens)
 
-    stage_fn = _make_stage_fn(cfg, att, context_parallel)
+    stage_fn = _make_stage_fn(cfg, att, context_parallel, with_aux=with_aux)
 
     mb = microbatch(x, num_microbatches)  # (M, B/M, S, D)
     # Manual over pipeline (and context, when sequence-parallel): specs
@@ -154,17 +192,19 @@ def pipelined_llama_apply(
     mb_spec = P(None, None, AXIS_CONTEXT) if context_parallel else P()
 
     run = jax.shard_map(
-        lambda p, xs: gpipe(stage_fn, p, xs),
+        lambda p, xs: gpipe(stage_fn, p, xs, with_aux=with_aux),
         mesh=mesh,
         in_specs=(layer_specs, mb_spec),
-        out_specs=mb_spec,
+        out_specs=(mb_spec, P()) if with_aux else mb_spec,
         axis_names=manual,
         check_vma=False,
     )
-    x = unmicrobatch(run(params["layers"], mb))
-    return _apply_head(
+    out = run(params["layers"], mb)
+    x, aux = out if with_aux else (out, None)
+    logits = _apply_head(
         cfg, {"final_norm": params["final_norm"], "lm_head": params["lm_head"]},
-        x)
+        unmicrobatch(x))
+    return (logits, aux) if with_aux else logits
 
 
 def pipelined_llama_value_and_grad(
@@ -177,13 +217,19 @@ def pipelined_llama_value_and_grad(
     context_parallel: bool = False,
     hop_attention: str = "dense",
     z_loss: float = 0.0,
+    with_metrics: bool = False,
 ):
     """1F1B-scheduled causal-LM loss and gradients.
 
-    Returns ``(loss, grads)`` where ``grads`` matches the ``params`` tree
+    Returns ``(loss, grads)`` — or ``(loss, metrics, grads)`` with
+    ``with_metrics=True``, where ``metrics["accuracy"]`` is next-token
+    accuracy over valid tokens — ``grads`` matches the ``params`` tree
     and ``loss`` is next-token cross entropy averaged over (B, S-1)
-    tokens plus the optional z-loss regularizer — the same quantity as
-    :func:`llama.causal_lm_loss` (accuracy is not computed on this path).
+    tokens plus the optional z-loss regularizer, the same quantity as
+    :func:`llama.causal_lm_loss`. MoE configs (``cfg.moe``) additionally
+    include the per-microbatch-mean MoE aux losses in ``loss`` with
+    exact gradients (threaded through the schedule's aux plumbing — the
+    ``sow`` collection cannot cross the shard_map boundary).
 
     Unlike :func:`pipelined_llama_apply`, this is not meant to be
     differentiated through — it IS the backward pass, scheduled 1F1B so
@@ -194,6 +240,8 @@ def pipelined_llama_value_and_grad(
     """
     if not cfg.scan_layers:
         raise ValueError("pipeline execution needs scan_layers=True")
+    with_aux = cfg.moe is not None
+    _check_moe_cp(with_aux, context_parallel)
     att = _attention_for(context_parallel, hop_attention)
     b, s = tokens.shape
     mb_size = b // num_microbatches
@@ -216,7 +264,9 @@ def pipelined_llama_value_and_grad(
     def head_fn(hp, y, lbl):
         """Local-shard loss sum / global per-micro token count (the
         pipeline_1f1b HeadFn contract: contributions psum to the mean).
-        Matches causal_lm_loss's per-token loss incl. z-loss."""
+        Matches causal_lm_loss's per-token loss incl. z-loss; the
+        metrics dict carries next-token accuracy on the same per-micro
+        mean convention."""
         import optax
 
         logits = _apply_head(cfg, hp, y)
@@ -224,9 +274,12 @@ def pipelined_llama_value_and_grad(
             logits, jnp.maximum(lbl, 0))
         if z_loss:
             per_tok = per_tok + z_loss * jax.nn.logsumexp(logits, axis=-1) ** 2
-        return jnp.sum(jnp.where(lbl >= 0, per_tok, 0.0)) / denom
+        valid = lbl >= 0
+        loss = jnp.sum(jnp.where(valid, per_tok, 0.0)) / denom
+        correct = jnp.where(valid, jnp.argmax(logits, -1) == lbl, False)
+        return loss, {"accuracy": jnp.sum(correct.astype(jnp.float32)) / denom}
 
-    stage_fn = _make_stage_fn(cfg, att, context_parallel)
+    stage_fn = _make_stage_fn(cfg, att, context_parallel, with_aux=with_aux)
     mb = microbatch(x, num_microbatches)
     lbl_mb = microbatch(labels, num_microbatches)
 
@@ -239,18 +292,23 @@ def pipelined_llama_value_and_grad(
         lambda lp, hp, xs, lb: pipeline_1f1b(
             stage_fn, head_fn, lp, hp, xs, lb,
             reduce_axes=(AXIS_CONTEXT,) if context_parallel else (),
+            stage_aux=with_aux,
+            head_metrics=True,
         ),
         mesh=mesh,
         in_specs=(layer_specs, head_specs, mb_spec, mb_spec),
-        out_specs=(P(), layer_specs, head_specs, mb_spec),
+        out_specs=(P(), layer_specs, head_specs, mb_spec, {"accuracy": P()}),
         axis_names=manual,
         check_vma=False,
     )
-    loss, dlayers, dhead, dmicro = run(params["layers"], head_params, mb, lbl_mb)
+    loss, dlayers, dhead, dmicro, metrics = run(
+        params["layers"], head_params, mb, lbl_mb)
     (d_embed,) = embed_vjp(unmicrobatch(dmicro).astype(x.dtype))
     grads = dict(params)
     grads["layers"] = dlayers
     grads["embed_tokens"] = d_embed
     grads["final_norm"] = dhead["final_norm"]
     grads["lm_head"] = dhead["lm_head"]
+    if with_metrics:
+        return loss, metrics, grads
     return loss, grads

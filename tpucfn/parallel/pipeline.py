@@ -54,12 +54,22 @@ def gpipe(
     microbatches: jax.Array,  # (M, mb, ...) — replicated across the axis
     *,
     axis: str = AXIS_PIPELINE,
-) -> jax.Array:
+    with_aux: bool = False,
+):
     """Run ``stage_fn`` as a P-stage pipeline; call inside ``shard_map``.
 
     ``stage_params`` is this stage's slice (shard the stacked layer dim
     over ``axis``). Returns (M, mb, ...) — the composition of all P stages
     applied to every microbatch, replicated to all stages.
+
+    ``with_aux=True`` changes the stage contract to
+    ``stage_fn(params, x) -> (y, aux_scalar)`` (e.g. MoE load-balancing
+    losses sown inside the stage) and returns ``(ys, aux)`` where ``aux``
+    is the per-microbatch MEAN of the per-stage scalars summed over all
+    stages — bubble-tick applications (garbage activations) are masked
+    out. The aux accumulator rides the scan carry, so reverse-mode AD
+    transposes it like any other carry: gradients of aux flow into stage
+    params and activations.
 
     Activations must keep one shape/dtype through stages (true for
     transformer blocks).
@@ -78,23 +88,38 @@ def gpipe(
     # gather is the wrong op for a static schedule anyway).
     pad = jnp.repeat(microbatches[-1:], p - 1, axis=0)
     injects = jnp.concatenate([microbatches, pad], axis=0)  # (ticks, mb, ...)
+    ticks = injects.shape[0]
 
-    def tick(recv, inject):
+    def tick(carry, xs):
         # Stage 0 injects this tick's microbatch; other stages consume
         # what arrived from their left neighbor.
+        recv, aux_acc = carry
+        inject, t = xs
         x = jnp.where(i == 0, inject, recv)
-        y = stage_fn(stage_params, x)
+        if with_aux:
+            y, aux = stage_fn(stage_params, x)
+            # Stage i holds microbatch t - i this tick; bubble ticks
+            # (fill/drain) compute on garbage and must not contribute.
+            m_f = t - i
+            valid = (m_f >= 0) & (m_f < m)
+            aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+        else:
+            y = stage_fn(stage_params, x)
         send = lax.ppermute(y, axis, perm)
-        return send, y
+        return (send, aux_acc), y
 
     zero = jnp.zeros_like(microbatches[0])
-    _, ys = lax.scan(tick, zero, injects)
+    carry0 = (zero, jnp.zeros((), jnp.float32))
+    (_, aux_acc), ys = lax.scan(tick, carry0, (injects, jnp.arange(ticks)))
 
     # Microbatch j finishes on the last stage at tick j + p - 1: a
     # contiguous static slice of the tick outputs.
     finished = lax.slice_in_dim(ys, p - 1, p - 1 + m, axis=0)
     # Broadcast the last stage's results to every stage (masked psum).
-    return lax.psum(jnp.where(i == p - 1, finished, jnp.zeros_like(finished)), axis)
+    out = lax.psum(jnp.where(i == p - 1, finished, jnp.zeros_like(finished)), axis)
+    if with_aux:
+        return out, lax.psum(aux_acc, axis) / m
+    return out
 
 
 def microbatch(x: jax.Array, num_microbatches: int) -> jax.Array:
@@ -140,6 +165,8 @@ def pipeline_1f1b(
     *,
     axis: str = AXIS_PIPELINE,
     reduce_axes: tuple[str, ...] = (),
+    stage_aux: bool = False,
+    head_metrics: bool = False,
 ):
     """One-forward-one-backward pipelined loss+grads; call inside
     shard_map (manual over ``axis`` and every ``reduce_axes`` entry).
@@ -149,6 +176,23 @@ def pipeline_1f1b(
     ``loss = (1/M) Σ_m head_fn(hp, stages(x_m), l_m)`` — the microbatch
     mean, per the HeadFn contract above (tests assert parity with
     jax.grad of the sequential model).
+
+    ``stage_aux=True`` switches the stage contract to
+    ``stage_fn(params, x) -> (y, aux_scalar)``: each stage's aux scalar
+    (e.g. its layers' MoE load-balancing losses for that microbatch) is
+    added into the loss with the same 1/M microbatch averaging, and its
+    gradient flows through the backward slot's vjp (the aux cotangent is
+    the constant 1/M), so
+    ``loss = (1/M) Σ_m [head_fn(...) + Σ_stages aux(stage, m)]``.
+
+    ``head_metrics=True`` switches the head contract to
+    ``head_fn(hp, y, lbl) -> (loss, metrics_dict)`` where each metric
+    scalar follows the same per-microbatch-mean convention as the loss
+    (e.g. accuracy = correct-count / per-micro token count); the dict is
+    accumulated on the last stage, averaged over microbatches, psum'd
+    over ``axis`` and ``reduce_axes``, and appended to the return tuple:
+    ``(loss, dstage, dhead, dmicro, metrics)``. Metrics are value-only
+    (no gradient).
 
     Timing: stage i forwards micro m at tick m+i (GPipe fill); the last
     stage runs head+backward of micro m in the same tick its forward
@@ -175,8 +219,29 @@ def pipeline_1f1b(
     perm_bwd = [(j, (j - 1) % p) for j in range(p)]
     scale = 1.0 / m
 
-    def scaled_head(hp, y, lbl):
-        return head_fn(hp, y, lbl) * scale
+    def run_stage(params, x):
+        """Stage forward normalized to (y, aux_scalar)."""
+        if stage_aux:
+            return stage_fn(params, x)
+        return stage_fn(params, x), jnp.zeros((), jnp.float32)
+
+    if head_metrics:
+        def scaled_head(hp, y, lbl):
+            loss, metrics = head_fn(hp, y, lbl)
+            return loss * scale, metrics
+
+        grad_head = jax.value_and_grad(scaled_head, argnums=(0, 1),
+                                       has_aux=True)
+        metrics0 = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype),
+            jax.eval_shape(lambda hp, y, lbl: head_fn(hp, y, lbl)[1],
+                           head_params, microbatches[0], labels[0]))
+    else:
+        def scaled_head(hp, y, lbl):
+            return head_fn(hp, y, lbl) * scale
+
+        grad_head = jax.value_and_grad(scaled_head, argnums=(0, 1))
+        metrics0 = ()
 
     # Scan xs: stage-0 injections (padded at the end for drain ticks) and
     # last-stage labels (padded at the front for fill ticks) — static
@@ -196,14 +261,18 @@ def pipeline_1f1b(
         return (jnp.arange(depth) == slot % depth)
 
     def tick(carry, xs):
-        fwd_recv, bwd_recv, stash, dstage, dhead, loss_acc, t = carry
+        (fwd_recv, bwd_recv, stash, dstage, dhead, loss_acc, metrics_acc,
+         t) = carry
         inject, lbl = xs
 
         # ---- forward slot: stage i forwards micro m_f = t - i ----------
         m_f = t - i
         fwd_valid = (m_f >= 0) & (m_f < m)
         x_in = jnp.where(i == 0, inject, fwd_recv)
-        y = stage_fn(stage_params, x_in)
+        y, aux = run_stage(stage_params, x_in)
+        # Every stage contributes its own aux for its current microbatch
+        # (bubble ticks masked); the final psum over `axis` sums stages.
+        loss_acc = loss_acc + jnp.where(fwd_valid, aux * scale, 0.0)
         wmask = slot_mask(t)  # (t - i) + i == t: write slot is uniform
         stash = jnp.where(
             wmask.reshape((depth,) + (1,) * x_in.ndim) & fwd_valid,
@@ -211,9 +280,16 @@ def pipeline_1f1b(
 
         # Last stage: head + loss for the arriving micro; dy seeds its
         # own backward in this same tick.
-        (loss_t, (dhead_t, dy_t)) = jax.value_and_grad(
-            scaled_head, argnums=(0, 1))(head_params, y, lbl)
         at_head = (i == p - 1) & fwd_valid
+        if head_metrics:
+            (loss_t, metrics_t), (dhead_t, dy_t) = grad_head(
+                head_params, y, lbl)
+            metrics_acc = jax.tree.map(
+                lambda a, g: a + jnp.where(at_head, g * scale,
+                                           jnp.zeros_like(g)),
+                metrics_acc, metrics_t)
+        else:
+            loss_t, (dhead_t, dy_t) = grad_head(head_params, y, lbl)
         loss_acc = loss_acc + jnp.where(at_head, loss_t, 0.0)
         dhead = jax.tree.map(
             lambda a, g: a + jnp.where(at_head, g, jnp.zeros_like(g)),
@@ -227,8 +303,11 @@ def pipeline_1f1b(
             jnp.where(rmask.reshape((depth,) + (1,) * x_in.ndim), stash, 0.0),
             axis=0).astype(stash.dtype)
         ct_in = jnp.where(i == p - 1, dy_t.astype(bwd_recv.dtype), bwd_recv)
-        _, vjp = jax.vjp(stage_fn, stage_params, x_b)
-        dstage_t, dx = vjp(ct_in.astype(y.dtype))
+        (_, aux_b), vjp = jax.vjp(run_stage, stage_params, x_b)
+        # d loss / d aux is the constant microbatch-mean weight; invalid
+        # slots are masked below exactly like the activation path.
+        dstage_t, dx = vjp((ct_in.astype(y.dtype),
+                            jnp.full_like(aux_b, scale)))
         dstage = jax.tree.map(
             lambda a, g: a + jnp.where(bwd_valid, g, jnp.zeros_like(g)),
             dstage, dstage_t)
@@ -236,12 +315,13 @@ def pipeline_1f1b(
         fwd_send = lax.ppermute(y, axis, perm_fwd)
         bwd_send = lax.ppermute(
             jnp.where(bwd_valid, dx, jnp.zeros_like(dx)), axis, perm_bwd)
-        new_carry = (fwd_send, bwd_send, stash, dstage, dhead, loss_acc, t + 1)
+        new_carry = (fwd_send, bwd_send, stash, dstage, dhead, loss_acc,
+                     metrics_acc, t + 1)
         return new_carry, dx
 
     carry0 = (zero_act, jnp.zeros_like(zero_act), stash0, dstage0, dhead0,
-              jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32))
-    (_, _, _, dstage, dhead, loss_acc, _), dxs = lax.scan(
+              jnp.zeros((), jnp.float32), metrics0, jnp.zeros((), jnp.int32))
+    (_, _, _, dstage, dhead, loss_acc, metrics_acc, _), dxs = lax.scan(
         tick, carry0, (injects, lbls))
 
     # Stage 0 emitted micro m's input-cotangent at tick m + 2(p-1):
@@ -254,8 +334,12 @@ def pipeline_1f1b(
     # per-stage (stay sharded over `axis`).
     loss = lax.psum(loss_acc, axis)
     dhead = jax.tree.map(lambda g: lax.psum(g, axis), dhead)
+    metrics = jax.tree.map(lambda g: lax.psum(g, axis), metrics_acc)
     for r in reduce_axes:
         loss = lax.psum(loss, r)
         dstage = jax.tree.map(lambda g: lax.psum(g, r), dstage)
         dhead = jax.tree.map(lambda g: lax.psum(g, r), dhead)
+        metrics = jax.tree.map(lambda g: lax.psum(g, r), metrics)
+    if head_metrics:
+        return loss, dstage, dhead, dmicro, metrics
     return loss, dstage, dhead, dmicro
