@@ -28,11 +28,35 @@ os.chdir(REPO)
 
 STATE = HERE / "megabench_state.json"
 RESULTS = HERE / "megabench_results.jsonl"
-WATCHDOG_S = float(os.environ.get("MEGABENCH_WATCHDOG_S", "5400"))
+WATCHDOG_S = float(os.environ.get("MEGABENCH_WATCHDOG_S", "2700"))
 
 
 def log(msg: str) -> None:
     print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+class Watchdog:
+    """Per-PHASE hang guard: fires only if a single phase exceeds the
+    budget (a dead-tunnel device sync never returns on its own). Daemon
+    timer + cancel() so a finished run exits with its real rc instead of
+    blocking on the timer thread."""
+
+    def __init__(self, budget_s: float):
+        self.budget_s = budget_s
+        self._timer = None
+        self.reset()
+
+    def reset(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+        self._timer = threading.Timer(
+            self.budget_s, lambda: (log("WATCHDOG fired"), os._exit(43)))
+        self._timer.daemon = True
+        self._timer.start()
+
+    def cancel(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
 
 
 def load_state() -> dict:
@@ -41,9 +65,14 @@ def load_state() -> dict:
     return {"done": []}
 
 
+_WD: list = []  # set in main(); mark_done resets the per-phase watchdog
+
+
 def mark_done(state: dict, phase: str) -> None:
     state["done"].append(phase)
     STATE.write_text(json.dumps(state))
+    if _WD:
+        _WD[0].reset()
 
 
 def record(phase: str, payload) -> None:
@@ -76,25 +105,30 @@ def main() -> int:
     state = load_state()
     log(f"megabench start; already done: {state['done']}")
 
-    # Watchdog: if a phase hangs on a dead tunnel, exit so the supervisor
-    # can decide (a hung device sync never returns on its own).
-    threading.Timer(WATCHDOG_S, lambda: (log("WATCHDOG fired"),
-                                         os._exit(43))).start()
+    wd = Watchdog(WATCHDOG_S)
+    _WD.append(wd)
 
     # ---- phase 0: connect (the risky step; one client per process) ----
     t0 = time.time()
     try:
         import jax
 
+        if os.environ.get("JAX_PLATFORMS") == "cpu":
+            # sitecustomize force-registers the axon plugin at interpreter
+            # start; pinning post-import is the only reliable override —
+            # without it a "CPU" dry-run would contact the tunnel.
+            jax.config.update("jax_platforms", "cpu")
         devs = jax.devices()
     except Exception as e:  # noqa: BLE001
         log(f"client creation failed after {time.time()-t0:.0f}s: {e!r}")
+        wd.cancel()
         return 42
     dev = devs[0]
     log(f"connected in {time.time()-t0:.1f}s: {dev.device_kind} "
         f"({dev.platform})")
     if dev.platform != "tpu":
         log("not a TPU — refusing to record CPU numbers as on-chip")
+        wd.cancel()
         return 42
     record("connect", {"device_kind": dev.device_kind,
                        "connect_s": round(time.time() - t0, 1)})
@@ -154,6 +188,7 @@ def main() -> int:
                    "--block-k", str(bk), "--iters", "5"])
 
     log("megabench complete")
+    wd.cancel()
     return 0
 
 

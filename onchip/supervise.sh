@@ -1,7 +1,10 @@
 #!/bin/bash
-# Retry megabench until it completes; a failed client creation (rc 42)
-# means the tunnel is wedged — sleep on the recovery timescale and retry.
-# Never kills a running attempt (killed clients extend the wedge).
+# Retry megabench until it completes. rc 42 = client creation failed
+# (tunnel wedged): sleep on the recovery timescale and retry. rc 43 =
+# per-phase watchdog fired with phases checkpointed: retry immediately
+# (the next attempt skips completed phases). Any other nonzero rc is a
+# deterministic failure: give up rather than stall. Never kills a
+# running attempt (killed clients extend the wedge).
 cd /root/repo
 log=onchip/megabench.log
 for attempt in $(seq 1 14); do
@@ -9,8 +12,13 @@ for attempt in $(seq 1 14); do
   python onchip/megabench.py >> "$log" 2>&1
   rc=$?
   echo "=== attempt $attempt rc=$rc $(date -u +%FT%TZ) ===" >> "$log"
-  if [ "$rc" -eq 0 ]; then exit 0; fi
-  sleep 420
+  case "$rc" in
+    0)  exit 0 ;;
+    42) sleep 420 ;;
+    43) ;;
+    *)  echo "=== fatal rc=$rc, giving up $(date -u +%FT%TZ) ===" >> "$log"
+        exit "$rc" ;;
+  esac
 done
 echo "=== supervisor exhausted $(date -u +%FT%TZ) ===" >> "$log"
 exit 1

@@ -1,0 +1,169 @@
+"""Golden loss-curve convergence tests (SURVEY.md §4 "Convergence smoke
+… loss-curve golden values"; VERDICT r2 item 5).
+
+Fixed-seed, fixed-data, ≥50-step training curves pinned against stored
+goldens at tight tolerance. The point is to catch SILENT numerics
+regressions — a masking or RoPE-offset bug that still "learns" sails
+through loss-decreases tests but cannot reproduce a 50-step curve to
+2e-4 relative. Three configs cover the main code paths:
+
+* cifar10_resnet20 — conv/batchnorm/SGD on a pure-DP mesh (the
+  reference's convergence config, BASELINE.json:7);
+* tiny_llama — attention/RoPE/RMSNorm/AdamW, full-batch DP;
+* tiny_llama PP×FSDP — the composed-mesh schedule (gpipe + gather-on-
+  use ZeRO-3).
+
+Regenerate after an INTENTIONAL numerics change:
+    TPUCFN_REGEN_GOLDENS=1 python -m pytest tests/test_golden_curves.py
+then review the diff of tests/golden_curves.json like any other code
+change — an unexplained curve shift is the bug this file exists to stop.
+"""
+
+import json
+import os
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tpucfn.mesh import MeshSpec, build_mesh
+from tpucfn.parallel import shard_batch
+from tpucfn.train import Trainer
+
+GOLDEN_PATH = Path(__file__).parent / "golden_curves.json"
+STEPS = 50
+RECORD_EVERY = 2  # 25 points per curve keeps the file reviewable
+RTOL = 2e-4
+
+
+def _curve(trainer, state, batches):
+    losses = []
+    for i in range(STEPS):
+        state, m = trainer.step(state, batches[i % len(batches)])
+        if (i + 1) % RECORD_EVERY == 0:
+            losses.append(round(float(m["loss"]), 6))
+    return losses
+
+
+def _batches_from(gen, mesh, batch_size, n_batches, extra_axes=()):
+    items = list(gen)
+    batches = []
+    for j in range(n_batches):
+        sl = [items[(j * batch_size + i) % len(items)]
+              for i in range(batch_size)]
+        batch = {k: np.stack([it[k] for it in sl]) for k in sl[0]}
+        batches.append(shard_batch(mesh, batch, extra_axes))
+    return batches
+
+
+def _cifar_resnet20_curve():
+    from tpucfn.data import synthetic_cifar10
+    from tpucfn.models import ResNet, ResNetConfig
+    from tpucfn.parallel import dense_rules
+
+    cfg = ResNetConfig(stage_sizes=(3, 3, 3), num_classes=10,
+                       bottleneck=False, width=16, cifar_stem=True,
+                       dtype=jnp.float32)
+    mesh = build_mesh(MeshSpec(data=8))
+    model = ResNet(cfg)
+    sample = jnp.zeros((1, 32, 32, 3))
+
+    def init_fn(rng):
+        v = model.init(rng, sample, train=True)
+        return v["params"], {"batch_stats": v["batch_stats"]}
+
+    def loss_fn(params, mstate, batch, rng):
+        logits, upd = model.apply(
+            {"params": params, **mstate}, batch["image"], train=True,
+            mutable=["batch_stats"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]).mean()
+        return loss, ({}, dict(upd))
+
+    trainer = Trainer(mesh, dense_rules(fsdp=False), loss_fn,
+                      optax.sgd(0.05, momentum=0.9), init_fn)
+    state = trainer.init(jax.random.key(0))
+    batches = _batches_from(synthetic_cifar10(256, seed=0), mesh, 64, 4)
+    return _curve(trainer, state, batches)
+
+
+def _tiny_llama_setup(mesh, rules_fn, loss_fn_maker):
+    from tpucfn.data import synthetic_tokens
+    from tpucfn.models.llama import Llama, LlamaConfig
+
+    cfg = LlamaConfig.tiny()
+    model = Llama(cfg)
+    sample = jnp.zeros((8, 32), jnp.int32)
+
+    def init_fn(rng):
+        return model.init(rng, sample)["params"], {}
+
+    trainer = Trainer(mesh, rules_fn(cfg), loss_fn_maker(cfg, model),
+                      optax.adamw(1e-3), init_fn)
+    state = trainer.init(jax.random.key(0))
+    gen = ({"tokens": it["tokens"]} for it in
+           synthetic_tokens(64, seq_len=32, vocab=cfg.vocab_size, seed=0))
+    batches = _batches_from(gen, mesh, 16, 4)
+    return trainer, state, batches
+
+
+def _tiny_llama_curve():
+    from tpucfn.models.llama import causal_lm_loss, sharding_rules
+
+    def loss_maker(cfg, model):
+        def loss_fn(params, mstate, batch, rng):
+            logits = model.apply({"params": params}, batch["tokens"])
+            loss, _ = causal_lm_loss(logits, batch["tokens"])
+            return loss, ({}, mstate)
+        return loss_fn
+
+    mesh = build_mesh(MeshSpec(data=8))
+    return _curve(*_tiny_llama_setup(mesh, sharding_rules, loss_maker))
+
+
+def _llama_pp_fsdp_curve():
+    from tpucfn.models.llama import causal_lm_loss
+    from tpucfn.models.llama_pp import pipelined_llama_apply, pp_sharding_rules
+
+    mesh = build_mesh(MeshSpec(pipeline=2, fsdp=2, data=2))
+
+    def loss_maker(cfg, model):
+        def loss_fn(params, mstate, batch, rng):
+            logits = pipelined_llama_apply(cfg, mesh, params, batch["tokens"],
+                                           num_microbatches=2)
+            loss, _ = causal_lm_loss(logits, batch["tokens"])
+            return loss, ({}, mstate)
+        return loss_fn
+
+    return _curve(*_tiny_llama_setup(mesh, pp_sharding_rules, loss_maker))
+
+
+CURVES = {
+    "cifar10_resnet20": _cifar_resnet20_curve,
+    "tiny_llama": _tiny_llama_curve,
+    "tiny_llama_pp_fsdp": _llama_pp_fsdp_curve,
+}
+
+
+@pytest.mark.parametrize("name", sorted(CURVES))
+def test_golden_curve(name):
+    got = CURVES[name]()
+    assert got[-1] < got[0], f"{name}: loss did not decrease at all"
+    if os.environ.get("TPUCFN_REGEN_GOLDENS"):
+        goldens = (json.loads(GOLDEN_PATH.read_text())
+                   if GOLDEN_PATH.exists() else {})
+        goldens[name] = got
+        GOLDEN_PATH.write_text(json.dumps(goldens, indent=1, sort_keys=True))
+        pytest.skip(f"regenerated golden for {name}")
+    goldens = json.loads(GOLDEN_PATH.read_text())
+    want = goldens[name]
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=RTOL,
+        err_msg=(f"{name}: loss curve diverged from the stored golden — "
+                 "if this change was an intentional numerics change, "
+                 "regenerate with TPUCFN_REGEN_GOLDENS=1 and review the "
+                 "golden diff; otherwise this is a silent numerics "
+                 "regression"))
