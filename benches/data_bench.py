@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Input-pipeline throughput bench (VERDICT r2 item 6; SURVEY.md §7.4
+item 4 "keeping TPUs fed").
+
+Host-side measurements — meaningful on any machine, no accelerator
+involved. Prints one JSON line per phase:
+
+* ``reader``: raw shard scan MB/s, C++ native reader vs the pure-Python
+  fallback, over the same tpurecord shards.
+* ``decode``: end-to-end ShardedDataset images/sec per host process on
+  JPEG-encoded shards (read → CRC → decode_example → JPEG decode →
+  center-crop → stack), streaming mode, with the decoded-array path for
+  comparison.
+
+The third leg — proof that training is NOT input-bound — lives inside
+``bench.py`` (detail.overlap): step time fed by the real
+ShardedDataset+prefetch loader vs the pre-staged batch, on the bench
+hardware itself.
+
+Usage: python benches/data_bench.py [--examples N] [--image-size S]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+def _write_raw_shards(tmp: Path, n: int, image_size: int, num_shards: int):
+    """Raw float32 image shards — big payloads, measures IO not decode."""
+    from tpucfn.data import synthetic_imagenet, write_dataset_shards
+
+    d = tmp / "raw"
+    d.mkdir()
+    return write_dataset_shards(
+        synthetic_imagenet(n, image_size=image_size, classes=100),
+        d, num_shards=num_shards)
+
+
+def _write_jpeg_shards(tmp: Path, n: int, image_size: int, num_shards: int):
+    from tpucfn.data import synthetic_imagenet, write_dataset_shards
+    from tpucfn.data.images import encode_jpeg
+
+    def gen():
+        for ex in synthetic_imagenet(n, image_size=image_size, classes=100):
+            img = (np.clip(ex["image"], 0, 1) * 255).astype(np.uint8)
+            yield {"image": np.frombuffer(encode_jpeg(img), np.uint8),
+                   "label": ex["label"]}
+
+    d = tmp / "jpeg"
+    d.mkdir()
+    return write_dataset_shards(gen(), d, num_shards=num_shards)
+
+
+def bench_reader(shards, label) -> dict:
+    from tpucfn.data import native, records
+
+    total_bytes = sum(Path(p).stat().st_size for p in shards)
+
+    def scan(read):
+        t0 = time.perf_counter()
+        n = sum(len(payload) for p in shards for payload in read(p))
+        return n, time.perf_counter() - t0
+
+    # Warm the page cache once so both readers measure the same thing.
+    scan(records.read_record_shard)
+
+    _, py_s = scan(records.read_record_shard)
+    row = {
+        "phase": f"reader_{label}",
+        "total_mb": round(total_bytes / 1e6, 1),
+        "python_mb_s": round(total_bytes / 1e6 / py_s, 1),
+        "native_available": native.native_available(),
+    }
+    if native.native_available():
+        _, nat_s = scan(native.read_record_shard_native)
+        row["native_mb_s"] = round(total_bytes / 1e6 / nat_s, 1)
+        row["native_speedup"] = round(py_s / nat_s, 2)
+    return row
+
+
+def _write_small_record_shards(tmp: Path, n: int, num_shards: int):
+    """Token-sized (~4 KB) records — the shape where per-record overhead
+    dominates and the native batch path is supposed to win."""
+    from tpucfn.data import write_dataset_shards
+
+    rs = np.random.RandomState(0)
+
+    def gen():
+        for _ in range(n):
+            yield {"tokens": rs.randint(0, 32000, 1024).astype(np.int32)}
+
+    d = tmp / "small"
+    d.mkdir()
+    return write_dataset_shards(gen(), d, num_shards=num_shards)
+
+
+def bench_decode(jpeg_shards, raw_shards, batch: int, image_size: int) -> dict:
+    from tpucfn.data.images import center_crop_resize, decode_transform
+    from tpucfn.data.pipeline import ShardedDataset
+    from tpucfn.data.transforms import Compose
+
+    crop = image_size - image_size // 8
+
+    def throughput(shards, transform):
+        ds = ShardedDataset(
+            shards, batch_size_per_process=batch, seed=0,
+            cache_in_memory=False, process_index=0, process_count=1,
+            transform=transform)
+        n = 0
+        t0 = time.perf_counter()
+        for b in ds.epoch(0):
+            n += b["image"].shape[0] if hasattr(b["image"], "shape") else batch
+        return n / (time.perf_counter() - t0)
+
+    jpeg_ips = throughput(
+        jpeg_shards, Compose([decode_transform(), center_crop_resize(crop)]))
+    raw_ips = throughput(raw_shards, None)
+    return {
+        "phase": "decode",
+        "jpeg_decode_crop_images_s": round(jpeg_ips, 1),
+        "raw_passthrough_images_s": round(raw_ips, 1),
+        "batch": batch,
+        "image_size": image_size,
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--examples", type=int, default=256)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--num-shards", type=int, default=8)
+    args = p.parse_args()
+
+    tmp = Path(tempfile.mkdtemp(prefix="tpucfn-data-bench-"))
+    try:
+        raw = _write_raw_shards(tmp, args.examples, args.image_size,
+                                args.num_shards)
+        jpeg = _write_jpeg_shards(tmp, args.examples, args.image_size,
+                                  args.num_shards)
+        small = _write_small_record_shards(tmp, args.examples * 64,
+                                           args.num_shards)
+        print(json.dumps(bench_reader(raw, "600kb_records")), flush=True)
+        print(json.dumps(bench_reader(small, "4kb_records")), flush=True)
+        print(json.dumps(bench_decode(jpeg, raw, args.batch,
+                                      args.image_size)), flush=True)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
