@@ -196,3 +196,67 @@ def test_spec_cache_survives_process_restart(tmp_path):
     assert rec.spec.storage_path == "/shared/efs"
     # generation is stable across processes (crc32, not randomized hash)
     assert rec.generation == cp1.describe("drill").generation
+
+
+# ---- JSON error-envelope tier (VERDICT r2 item 8) ------------------------
+
+
+def _envelope_err(status, code, message):
+    return subprocess.CalledProcessError(
+        1, ["gcloud"], stderr=(
+            "ERROR: (gcloud.compute.tpus.queued-resources.create) "
+            + json.dumps({"error": {"code": code, "message": message,
+                                    "status": status}})))
+
+
+def test_envelope_resource_exhausted_is_quota_error(tmp_path):
+    cp = _cp({(*QR, "create"): _envelope_err(
+        "RESOURCE_EXHAUSTED", 429, "Quota limit tpus reached")}, tmp_path)
+    with pytest.raises(QuotaError, match=r"\[RESOURCE_EXHAUSTED\]"):
+        cp.create(ClusterSpec(name="drill", accelerator="v5e-8"))
+
+
+@pytest.mark.parametrize("status,code", [("UNAUTHENTICATED", 401),
+                                         ("PERMISSION_DENIED", 403)])
+def test_envelope_auth_statuses_are_auth_errors(tmp_path, status, code):
+    cp = _cp({(*QR, "create"): _envelope_err(status, code, "denied")},
+             tmp_path)
+    with pytest.raises(AuthError, match=status):
+        cp.create(ClusterSpec(name="drill", accelerator="v5e-8"))
+
+
+def test_envelope_wins_over_misleading_prose(tmp_path):
+    """Structured status is authoritative: prose mentioning 'credentials'
+    inside a RESOURCE_EXHAUSTED envelope must still be QuotaError."""
+    cp = _cp({(*QR, "create"): _envelope_err(
+        "RESOURCE_EXHAUSTED", 429,
+        "quota for credentials-scoped tpus exceeded")}, tmp_path)
+    with pytest.raises(QuotaError):
+        cp.create(ClusterSpec(name="drill", accelerator="v5e-8"))
+
+
+def test_unmapped_envelope_reraises_loudly(tmp_path):
+    cp = _cp({(*QR, "create"): _envelope_err(
+        "FAILED_PRECONDITION", 400, "zone does not support this type")},
+        tmp_path)
+    with pytest.raises(subprocess.CalledProcessError):
+        cp.create(ClusterSpec(name="drill", accelerator="v5e-8"))
+
+
+def test_code_only_envelope_and_shadowing(tmp_path):
+    """A status-less {"code": 5} warning blob must not shadow the real
+    envelope; and a code-only 429 envelope maps without a status."""
+    cp = _cp({(*QR, "create"): subprocess.CalledProcessError(
+        1, ["gcloud"], stderr=(
+            'WARNING: {"code": 5}\nERROR: {"error": {"status": '
+            '"PERMISSION_DENIED", "code": 403, "message": "nope"}}'))},
+        tmp_path)
+    with pytest.raises(AuthError, match="PERMISSION_DENIED"):
+        cp.create(ClusterSpec(name="drill", accelerator="v5e-8"))
+
+    cp2 = _cp({(*QR, "create"): subprocess.CalledProcessError(
+        1, ["gcloud"],
+        stderr='ERROR: {"error": {"code": 429, "message": "rate limit"}}')},
+        tmp_path)
+    with pytest.raises(QuotaError):
+        cp2.create(ClusterSpec(name="drill2", accelerator="v5e-8"))

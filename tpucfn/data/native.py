@@ -33,16 +33,22 @@ def _load_lib():
         lib = ctypes.CDLL(str(_LIB_PATH))
         if not hasattr(lib, "tpurec_validate"):
             # Stale .so from before the zero-copy entry points: rebuild,
-            # then load under a UNIQUE path — dlopen caches by original
-            # path and re-CDLL'ing _LIB_PATH would return the old image
-            # even after the file on disk changed.
+            # then load under a DIFFERENT path — dlopen caches by
+            # original path and re-CDLL'ing _LIB_PATH would return the
+            # old image even after the file on disk changed. The copy
+            # path is deterministic (keyed by mtime+size) so concurrent
+            # or repeated upgrades reuse one file instead of leaking a
+            # temp dir per process.
             import shutil
             import tempfile
 
             subprocess.run(["sh", str(_NATIVE_DIR / "build.sh")], check=True,
                            capture_output=True, text=True, timeout=120)
-            fresh = Path(tempfile.mkdtemp(prefix="tpurec-")) / _LIB_PATH.name
-            shutil.copy2(_LIB_PATH, fresh)
+            st = _LIB_PATH.stat()
+            fresh = (Path(tempfile.gettempdir())
+                     / f"tpurec-{st.st_mtime_ns}-{st.st_size}.so")
+            if not fresh.exists():
+                shutil.copy2(_LIB_PATH, fresh)
             lib = ctypes.CDLL(str(fresh))
         lib.tpurec_open.restype = ctypes.c_void_p
         lib.tpurec_open.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]

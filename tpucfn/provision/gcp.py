@@ -20,12 +20,32 @@ Command surface (all with ``--format json``):
     gcloud compute tpus tpu-vm describe NODE --zone Z --project P
     gcloud auth print-access-token        (auth preflight)
 
-Error mapping (stderr substrings → typed errors / states):
-quota exhaustion → :class:`QuotaError`; stockout/capacity → the record
-lands in FAILED with the service message (the Provisioner raises its
-normal ProvisioningError); missing/expired credentials →``AuthError``
-with the re-auth command.  TPU slices are atomic, so resize/heal remain
-delete + re-create exactly as with the fake (provisioner.py).
+Error mapping — two tiers, JSON envelope first, prose fallback:
+
+When gcloud's stderr carries a ``google.rpc``-style JSON error envelope
+(``{"error": {"code": N, "status": "...", "message": "..."}}``), the
+canonical status string decides the class. Only when no envelope parses
+do the prose substring markers apply. Provenance per marker:
+
+| marker | maps to | provenance |
+|---|---|---|
+| status ``UNAUTHENTICATED`` (401) | AuthError | documented google.rpc canonical code (cloud.google.com/apis/design/errors) |
+| status ``PERMISSION_DENIED`` (403) | AuthError | documented google.rpc canonical code |
+| status ``RESOURCE_EXHAUSTED`` (429) | QuotaError | documented google.rpc canonical code |
+| prose ``RESOURCE_EXHAUSTED`` / ``Quota exceeded`` | QuotaError | ASSUMED gcloud CLI prose; self-authored fixture ``test_quota_error_is_typed`` |
+| prose ``Reauthentication required`` / ``credentials`` / ``not logged in`` / ``UNAUTHENTICATED`` | AuthError | ASSUMED gcloud CLI prose; fixture ``test_auth_failure_is_typed_and_actionable`` |
+| prose ``no capacity`` / ``resources unavailable`` / ``stockout`` / ``out of capacity`` (in a FAILED record's failedData) | retryable capacity message, NOT QuotaError | ASSUMED service prose; fixture ``test_capacity_failure_maps_to_failed_and_provisioner_raises`` |
+| ``NOT_FOUND`` in describe stderr | KeyError (interface parity with the fake) | documented canonical code (404) |
+
+The ASSUMED rows are circular by construction — the fixtures were
+written by the same hand as the matcher (VERDICT r2 weak #4) and real
+gcloud stderr may not match them; the envelope tier exists so that
+whenever the real CLI emits the documented structured error, the typed
+mapping no longer depends on prose at all. An unmatched error re-raises
+the CalledProcessError unchanged (degraded, never silent).
+
+TPU slices are atomic, so resize/heal remain delete + re-create exactly
+as with the fake (provisioner.py).
 """
 
 from __future__ import annotations
@@ -75,12 +95,51 @@ _STATE_MAP = {
 
 # Deliberately narrow: a stockout message that merely *suggests*
 # requesting quota must stay a retryable capacity error, not a terminal
-# QuotaError.
+# QuotaError. Provenance for every marker: module docstring table.
 _QUOTA_MARKERS = ("RESOURCE_EXHAUSTED", "Quota exceeded")
 _AUTH_MARKERS = ("Reauthentication required", "credentials", "not logged in",
                  "UNAUTHENTICATED")
 _CAPACITY_MARKERS = ("no capacity", "resources unavailable", "stockout",
                      "out of capacity")
+
+# google.rpc canonical status strings (documented error model) — the
+# authoritative tier when gcloud stderr carries the JSON envelope.
+_AUTH_STATUS = {"UNAUTHENTICATED", "PERMISSION_DENIED"}
+_QUOTA_STATUS = {"RESOURCE_EXHAUSTED"}
+# Numeric fallbacks for status-less envelopes. REST envelopes carry HTTP
+# codes, LRO/google.rpc.Status carries gRPC codes — the two ranges are
+# disjoint (gRPC 0-16 vs HTTP 4xx), so one map serves both shapes.
+_CODE_TO_STATUS = {
+    401: "UNAUTHENTICATED", 403: "PERMISSION_DENIED",
+    429: "RESOURCE_EXHAUSTED",               # HTTP
+    16: "UNAUTHENTICATED", 7: "PERMISSION_DENIED", 8: "RESOURCE_EXHAUSTED",  # gRPC
+}
+
+
+def _error_envelope(stderr: str) -> dict:
+    """Extract a CLASSIFIABLE google.rpc error envelope from gcloud
+    stderr: ``{"error": {"code", "status", "message"}}`` or a bare
+    object with those keys. Scans past JSON blobs that carry neither a
+    status string nor a mappable code (a stray ``{"code": 5}`` warning
+    must not shadow the real envelope later in the stream). Returns {}
+    when nothing classifiable parses — prose markers then take over."""
+    dec = json.JSONDecoder()
+    start = stderr.find("{")
+    while start != -1:
+        try:
+            obj, _ = dec.raw_decode(stderr[start:])
+        except ValueError:
+            start = stderr.find("{", start + 1)
+            continue
+        if isinstance(obj, dict):
+            inner = obj.get("error", obj)
+            if isinstance(inner, dict):
+                if str(inner.get("status", "")):
+                    return inner
+                if inner.get("code") in _CODE_TO_STATUS:
+                    return inner
+        start = stderr.find("{", start + 1)
+    return {}
 
 
 class GcpQueuedResourceControlPlane(ControlPlane):
@@ -140,6 +199,22 @@ class GcpQueuedResourceControlPlane(ControlPlane):
             return self.runner(list(argv))
         except subprocess.CalledProcessError as e:
             stderr = e.stderr or ""
+            # Tier 1: the documented JSON error envelope (authoritative —
+            # canonical status strings, no prose guessing).
+            env = _error_envelope(stderr)
+            status = str(env.get("status", "")).upper() or _CODE_TO_STATUS.get(
+                env.get("code"), "")
+            if status:
+                msg = str(env.get("message", "")) or stderr.strip()[:500]
+                if status in _AUTH_STATUS:
+                    raise AuthError(
+                        "gcloud credentials unavailable — run `gcloud auth "
+                        f"login` (or set ADC); service error [{status}]: "
+                        f"{msg[:500]}") from e
+                if status in _QUOTA_STATUS:
+                    raise QuotaError(f"[{status}] {msg[:500]}") from e
+                raise  # a structured error we don't map: degraded, loud
+            # Tier 2: prose markers (ASSUMED — see module docstring table).
             low = stderr.lower()
             if any(m.lower() in low for m in _AUTH_MARKERS):
                 raise AuthError(
