@@ -102,7 +102,7 @@ def main() -> int:
         pipeline=args.pipeline, expert=args.expert,
     ))
     attention = (make_ring_attention(
-        mesh, hop_attention="flash" if args.ring_flash else "dense")
+        mesh, hop_attention="flash" if args.ring_flash else "auto")
         if args.context > 1 else None)
     model = Llama(cfg, **({"attention_fn": attention} if attention else {}))
     # init sample must divide evenly over the batch/context mesh axes
@@ -120,7 +120,7 @@ def main() -> int:
         print(f"pipeline: {args.pipeline} stages x {args.microbatches} "
               f"microbatches, bubble fraction {bubble:.3f}", flush=True)
 
-        hop = "flash" if args.ring_flash else "dense"
+        hop = "flash" if args.ring_flash else "auto"
 
         def forward(params, tokens):
             """Returns (logits, moe_aux) — aux is 0.0 for dense models."""
@@ -160,7 +160,7 @@ def main() -> int:
                     cfg, mesh, p, tokens,
                     num_microbatches=args.microbatches,
                     context_parallel=args.context > 1,
-                    hop_attention="flash" if args.ring_flash else "dense",
+                    hop_attention="flash" if args.ring_flash else "auto",
                     z_loss=args.z_loss, with_metrics=True)
                 return (loss, metrics["accuracy"]), grads
 

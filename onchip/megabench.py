@@ -188,14 +188,42 @@ def main() -> int:
     flash("flash_s8k", ["--seqs", "8192"])
     flash("flash_s32k", ["--seqs", "32768"])
 
-    # ---- phase 6: block-size sweep at S=8k (autotuner input) ----------
-    for bq in (128, 256, 512):
-        for bk in (128, 256, 512):
-            if bq == 512 and bk == 512:
-                continue  # VMEM risk not worth it blind; 512x256 covers it
-            flash(f"flash_sweep_q{bq}_k{bk}",
-                  ["--seqs", "8192", "--block-q", str(bq),
-                   "--block-k", str(bk), "--iters", "5"])
+    # ---- phase 6: block autotuner (persists ~/.tpucfn/flash_tune.json;
+    # the kernel's default block chooser reads it) ----------------------
+    def tune_phase(phase, s, iters=5):
+        if phase in state["done"]:
+            return
+        log(f"phase {phase}")
+        try:
+            import jax.numpy as jnp
+
+            from tpucfn.kernels import flash_autotune
+
+            res = flash_autotune.tune(s, 128, heads=16, kv_heads=8,
+                                      dtype=jnp.bfloat16, iters=iters)
+            record(phase, res)
+        except Exception as e:  # noqa: BLE001
+            log(f"{phase} FAILED: {e!r}")
+            record(phase, {"error": repr(e)})
+        mark_done(state, phase)
+
+    tune_phase("tune_s2k", 2048)
+    tune_phase("tune_s8k", 8192)
+    tune_phase("tune_s32k", 32768, iters=3)
+
+    # Ship the tuned table where the repo can pick it up as a default.
+    try:
+        import shutil
+
+        from tpucfn.kernels import flash_autotune
+
+        src = flash_autotune._cache_path()
+        if src.exists():
+            shutil.copy2(src, HERE / "flash_tune_v5e.json")
+        else:
+            log(f"no tuned table at {src} — nothing to ship")
+    except OSError as e:
+        log(f"tune table copy failed: {e!r}")
 
     log("megabench complete")
     wd.cancel()

@@ -1,0 +1,121 @@
+"""Auto dense↔flash dispatch + block autotuner (VERDICT r2 item 3).
+
+CPU CI note: the dispatch policy requires a TPU backend, so these tests
+monkeypatch the backend probe and run the kernel in interpret mode —
+the policy logic and the numerics equivalence are what is under test.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpucfn.kernels import auto as auto_mod
+from tpucfn.kernels import flash_autotune
+from tpucfn.kernels.flash_attention import _choose_blocks
+from tpucfn.models.llama import Llama, LlamaConfig
+from tpucfn.ops.attention import dot_product_attention
+
+
+def test_policy_is_dense_on_cpu():
+    assert not auto_mod.should_use_flash(1 << 20)
+
+
+def test_policy_threshold(monkeypatch):
+    monkeypatch.setattr(auto_mod, "_backend", lambda: "tpu")
+    monkeypatch.setenv("TPUCFN_FLASH_MIN_S", "512")
+    assert auto_mod.should_use_flash(512)
+    assert not auto_mod.should_use_flash(511)
+    assert not auto_mod.should_use_flash(4096, causal=False)
+    assert not auto_mod.should_use_flash(4096, mask=jnp.ones((1, 1, 4, 4)))
+
+
+def test_llama_auto_dispatch_matches_dense(monkeypatch):
+    """attention_fn=None + forced-TPU policy: the flash path (interpret)
+    must reproduce the dense default exactly (fwd and grads)."""
+    monkeypatch.setattr(auto_mod, "_backend", lambda: "tpu")
+    monkeypatch.setenv("TPUCFN_FLASH_MIN_S", "16")
+
+    cfg = LlamaConfig.tiny()
+    toks = jnp.asarray(np.random.RandomState(0).randint(0, 256, (2, 32)),
+                       jnp.int32)
+    auto_model = Llama(cfg)                                  # None = auto
+    dense_model = Llama(cfg, attention_fn=dot_product_attention)
+    params = dense_model.init(jax.random.key(0), toks)["params"]
+
+    out_auto = auto_model.apply({"params": params}, toks)
+    out_dense = dense_model.apply({"params": params}, toks)
+    np.testing.assert_allclose(np.asarray(out_auto), np.asarray(out_dense),
+                               atol=2e-4)
+
+    g_auto = jax.grad(lambda p: jnp.sum(
+        auto_model.apply({"params": p}, toks) ** 2))(params)
+    g_dense = jax.grad(lambda p: jnp.sum(
+        dense_model.apply({"params": p}, toks) ** 2))(params)
+    np.testing.assert_allclose(
+        np.asarray(g_auto["layers"]["attn"]["q_proj"]["kernel"]),
+        np.asarray(g_dense["layers"]["attn"]["q_proj"]["kernel"]), atol=5e-4)
+
+
+def test_llama_auto_stays_dense_below_threshold(monkeypatch):
+    """Below the threshold the resolved fn must be the dense op (no
+    kernel involvement at all) — checked via the policy function."""
+    monkeypatch.setattr(auto_mod, "_backend", lambda: "tpu")
+    monkeypatch.setenv("TPUCFN_FLASH_MIN_S", "1024")
+    assert not auto_mod.should_use_flash(32)
+    # and the static-zero dispatcher takes the dense branch
+    q = jnp.zeros((1, 32, 2, 16))
+    out = auto_mod.auto_attention_static_zero(q, q, q, causal=True)
+    ref = dot_product_attention(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+
+
+def test_ring_auto_hops(monkeypatch):
+    """hop_attention='auto' with the policy forced on: ring result still
+    equals full attention (flash hops), and with the policy off it
+    equals the dense-hop path (trivially the same numbers)."""
+    from tpucfn.kernels import make_ring_attention
+    from tpucfn.mesh import MeshSpec, build_mesh
+
+    monkeypatch.setattr(auto_mod, "_backend", lambda: "tpu")
+    monkeypatch.setenv("TPUCFN_FLASH_MIN_S", "8")
+
+    mesh = build_mesh(MeshSpec(context=4, data=2))
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(2, 64, 4, 16), jnp.float32)
+    k = jnp.asarray(rs.randn(2, 64, 2, 16), jnp.float32)
+    v = jnp.asarray(rs.randn(2, 64, 2, 16), jnp.float32)
+
+    att = make_ring_attention(mesh)  # hop_attention defaults to "auto"
+    out = att(q, k, v, causal=True)
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+
+def test_autotuner_tune_lookup_and_block_choice(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUCFN_FLASH_TUNE_CACHE", str(tmp_path / "tune.json"))
+    monkeypatch.setattr(flash_autotune, "_MEM_CACHE", None)
+
+    res = flash_autotune.tune(
+        128, 32, heads=2, kv_heads=2, dtype=jnp.float32,
+        candidates=((16, 16), (32, 32)), iters=1, include_bwd=False)
+    assert res["best"] in ((16, 16), (32, 32))
+    assert all("total_ms" in r or "error" in r for r in res["rows"])
+
+    # persisted + visible to lookup and to the kernel's block chooser
+    monkeypatch.setattr(flash_autotune, "_MEM_CACHE", None)  # force re-read
+    assert flash_autotune.lookup(128, 32, jnp.float32, True) == res["best"]
+    assert flash_autotune.lookup(100, 32, jnp.float32, True) == res["best"], \
+        "S buckets to the next power of two"
+    assert _choose_blocks(128, 32, jnp.float32, True) == res["best"]
+    assert _choose_blocks(128, 64, jnp.float32, True) == (128, 128), \
+        "different D must not hit the cached entry"
+
+    # env override beats the tuned table
+    monkeypatch.setenv("TPUCFN_FLASH_BLOCK_Q", "64")
+    assert _choose_blocks(128, 32, jnp.float32, True) == (64, 128)
+
+    raw = json.loads((tmp_path / "tune.json").read_text())
+    assert list(raw.values())[0] == list(res["best"])

@@ -10,6 +10,7 @@ same format, same errors, ~10× slower.
 from __future__ import annotations
 
 import ctypes
+import os
 import subprocess
 from pathlib import Path
 from typing import Iterator, Sequence
@@ -45,10 +46,24 @@ def _load_lib():
             subprocess.run(["sh", str(_NATIVE_DIR / "build.sh")], check=True,
                            capture_output=True, text=True, timeout=120)
             st = _LIB_PATH.stat()
-            fresh = (Path(tempfile.gettempdir())
-                     / f"tpurec-{st.st_mtime_ns}-{st.st_size}.so")
+            # Per-uid 0700 cache dir (a world-writable /tmp path could be
+            # pre-planted by another local user); unique-name + rename so
+            # a concurrent upgrader never dlopens a half-written copy.
+            cache_dir = Path(tempfile.gettempdir()) / f"tpurec-{os.getuid()}"
+            try:
+                cache_dir.mkdir(mode=0o700, exist_ok=True)
+                dstat = cache_dir.stat()
+                if dstat.st_uid != os.getuid() or (dstat.st_mode & 0o077):
+                    raise OSError("cache dir not exclusively ours")
+            except OSError:
+                cache_dir = Path(tempfile.mkdtemp(prefix="tpurec-"))
+            fresh = cache_dir / f"{st.st_mtime_ns}-{st.st_size}.so"
             if not fresh.exists():
-                shutil.copy2(_LIB_PATH, fresh)
+                tmp_fd, tmp_name = tempfile.mkstemp(dir=cache_dir,
+                                                    suffix=".so.part")
+                os.close(tmp_fd)
+                shutil.copyfile(_LIB_PATH, tmp_name)
+                os.replace(tmp_name, fresh)  # atomic publish
             lib = ctypes.CDLL(str(fresh))
         lib.tpurec_open.restype = ctypes.c_void_p
         lib.tpurec_open.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]

@@ -4,3 +4,9 @@ from tpucfn.kernels.flash_attention import (  # noqa: F401
 )
 from tpucfn.kernels.ring_attention import make_ring_attention, ring_attention  # noqa: F401
 from tpucfn.kernels.ulysses import make_ulysses_attention  # noqa: F401
+from tpucfn.kernels.auto import (  # noqa: F401
+    auto_attention,
+    auto_attention_static_zero,
+    should_use_flash,
+)
+from tpucfn.kernels import flash_autotune  # noqa: F401

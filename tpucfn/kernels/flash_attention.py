@@ -557,17 +557,22 @@ def _make_flash_with_lse(causal, q_offset, k_offset, kv_len, block_sizes,
     return run
 
 
-def _prep_inputs(q, k, v, block_q, block_k, interpret):
+def _prep_inputs(q, k, v, block_q, block_k, interpret, causal=True):
     """Shared wrapper prologue: interpret default, block selection, and
     layout/pad of (B, S, H, D) inputs into kernel (B, H, S_pad, D)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    bq0, bk0 = _default_blocks()
     sq, sk = q.shape[1], k.shape[1]
     if block_q is not None:
         block_q = _check_block(block_q, "block_q")
     if block_k is not None:
         block_k = _check_block(block_k, "block_k")
+    if block_q is None or block_k is None:
+        # Only consult env/tuned defaults when actually needed — a bad
+        # cached entry must not break calls that pinned their blocks.
+        bq0, bk0 = _choose_blocks(sq, q.shape[-1], q.dtype, causal)
+    else:
+        bq0 = bk0 = None
     blk_q, sq_pad = _block_and_pad(sq, block_q or bq0)
     blk_k, sk_pad = _block_and_pad(sk, block_k or bk0)
     qt = _pad_seq(jnp.swapaxes(q, 1, 2), sq_pad, 2)
@@ -593,7 +598,7 @@ def flash_attention_with_lse(
     ring-attention hops (rows attending to nothing give lse = NEG_INF).
     Differentiable in both outputs."""
     qt, kt, vt, blocks, (sq, sk, _, _), interpret = _prep_inputs(
-        q, k, v, block_q, block_k, interpret)
+        q, k, v, block_q, block_k, interpret, causal)
     run = _make_flash_with_lse(causal, int(q_offset), int(k_offset), sk,
                                blocks, interpret)
     o, lse = run(qt, kt, vt)
@@ -618,11 +623,22 @@ def _check_block(value: int, origin: str) -> int:
     return value
 
 
-def _default_blocks() -> tuple[int, int]:
-    return (_check_block(os.environ.get("TPUCFN_FLASH_BLOCK_Q", "128"),
-                         "TPUCFN_FLASH_BLOCK_Q"),
-            _check_block(os.environ.get("TPUCFN_FLASH_BLOCK_K", "128"),
-                         "TPUCFN_FLASH_BLOCK_K"))
+def _choose_blocks(sq: int, d: int, dtype, causal: bool) -> tuple[int, int]:
+    """Default block selection when the caller passed none: env override
+    (explicit experiment control) > autotuned table (flash_autotune) >
+    128/128 baseline."""
+    envq = os.environ.get("TPUCFN_FLASH_BLOCK_Q")
+    envk = os.environ.get("TPUCFN_FLASH_BLOCK_K")
+    if envq or envk:
+        return (_check_block(envq or 128, "TPUCFN_FLASH_BLOCK_Q"),
+                _check_block(envk or 128, "TPUCFN_FLASH_BLOCK_K"))
+    from tpucfn.kernels import flash_autotune
+
+    hit = flash_autotune.lookup(sq, d, dtype, causal)
+    if hit:
+        return (_check_block(hit[0], "tuned block_q"),
+                _check_block(hit[1], "tuned block_k"))
+    return 128, 128
 
 
 def flash_attention(
@@ -652,7 +668,7 @@ def flash_attention(
         raise NotImplementedError(
             "flash_attention supports causal/segment masking only")
     qt, kt, vt, blocks, (sq, sk, sq_pad, sk_pad), interpret = _prep_inputs(
-        q, k, v, block_q, block_k, interpret)
+        q, k, v, block_q, block_k, interpret, causal)
 
     q_seg = kv_seg = None
     if segment_ids is not None:

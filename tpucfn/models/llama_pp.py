@@ -72,9 +72,14 @@ def _check_moe_cp(with_aux: bool, context_parallel: bool) -> None:
             "normalization); run MoE pipelines without --context")
 
 
-def _attention_for(context_parallel: bool, hop_attention: str = "dense"):
+def _attention_for(context_parallel: bool, hop_attention: str = "auto"):
     if not context_parallel:
-        return dot_product_attention
+        # The non-CP stage body's q_offset is statically zero
+        # (_make_stage_fn), so the flash-eligible auto dispatcher is
+        # sound here: flash on TPU above the S threshold, dense below.
+        from tpucfn.kernels.auto import auto_attention_static_zero
+
+        return auto_attention_static_zero
 
     def att(q, k, v, *, causal=True, mask=None, q_offset=0, k_offset=0):
         if mask is not None:
@@ -155,7 +160,7 @@ def pipelined_llama_apply(
     *,
     num_microbatches: int = 4,
     context_parallel: bool = False,
-    hop_attention: str = "dense",
+    hop_attention: str = "auto",
     with_aux: bool = False,
 ):
     """tokens (B, S) → logits (B, S, vocab), numerically equal to
@@ -215,7 +220,7 @@ def pipelined_llama_value_and_grad(
     *,
     num_microbatches: int = 4,
     context_parallel: bool = False,
-    hop_attention: str = "dense",
+    hop_attention: str = "auto",
     z_loss: float = 0.0,
     with_metrics: bool = False,
 ):

@@ -127,7 +127,7 @@ def _error_envelope(stderr: str) -> dict:
     start = stderr.find("{")
     while start != -1:
         try:
-            obj, _ = dec.raw_decode(stderr[start:])
+            obj, consumed = dec.raw_decode(stderr[start:])
         except ValueError:
             start = stderr.find("{", start + 1)
             continue
@@ -138,7 +138,9 @@ def _error_envelope(stderr: str) -> dict:
                     return inner
                 if inner.get("code") in _CODE_TO_STATUS:
                     return inner
-        start = stderr.find("{", start + 1)
+        # Unclassifiable object: skip its WHOLE span — descending into it
+        # would promote a nested {"code": ...} field to envelope status.
+        start = stderr.find("{", start + consumed)
     return {}
 
 
@@ -206,6 +208,12 @@ class GcpQueuedResourceControlPlane(ControlPlane):
                 env.get("code"), "")
             if status:
                 msg = str(env.get("message", "")) or stderr.strip()[:500]
+                if status == "PERMISSION_DENIED":
+                    raise AuthError(
+                        "the authenticated principal lacks TPU permissions "
+                        "— grant the needed IAM role (e.g. roles/tpu.admin) "
+                        f"on the project; service error [{status}]: "
+                        f"{msg[:500]}") from e
                 if status in _AUTH_STATUS:
                     raise AuthError(
                         "gcloud credentials unavailable — run `gcloud auth "
