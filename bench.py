@@ -323,6 +323,8 @@ def _worker_llama(tiny: bool) -> int:
         cfg = LlamaConfig.llama3_1b()
         seq, per_chip_batch, steps, warmup = 2048, 8, 20, 3
     per_chip_batch = int(os.environ.get("TPUCFN_BENCH_BATCH", per_chip_batch))
+    steps = int(os.environ.get("TPUCFN_BENCH_STEPS", steps))
+    warmup = int(os.environ.get("TPUCFN_BENCH_WARMUP", warmup))
     global_batch = per_chip_batch * n_dev
 
     mesh = build_mesh(MeshSpec.for_devices(n_dev))
@@ -411,6 +413,8 @@ def worker() -> int:
         image_hw, per_chip_batch, classes = 224, 256, 1000
         steps, warmup = 30, 5
     per_chip_batch = int(os.environ.get("TPUCFN_BENCH_BATCH", per_chip_batch))
+    steps = int(os.environ.get("TPUCFN_BENCH_STEPS", steps))
+    warmup = int(os.environ.get("TPUCFN_BENCH_WARMUP", warmup))
 
     global_batch = per_chip_batch * n_dev
     mesh = build_mesh(MeshSpec.for_devices(n_dev))

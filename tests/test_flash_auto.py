@@ -93,6 +93,12 @@ def test_ring_auto_hops(monkeypatch):
     ref = dot_product_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
 
+    # policy off (threshold above S_loc): auto resolves to dense hops
+    monkeypatch.setenv("TPUCFN_FLASH_MIN_S", "4096")
+    out_dense = make_ring_attention(mesh)(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out_dense), np.asarray(ref),
+                               atol=2e-4)
+
 
 def test_autotuner_tune_lookup_and_block_choice(tmp_path, monkeypatch):
     monkeypatch.setenv("TPUCFN_FLASH_TUNE_CACHE", str(tmp_path / "tune.json"))
