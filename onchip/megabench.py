@@ -196,9 +196,15 @@ def main() -> int:
                 "TPUCFN_BENCH_STEPS": "8", "TPUCFN_BENCH_WARMUP": "2"},
                 critical=False):
             return 44
+    if not xla_phase("llama_b8_noremat", {
+            "TPUCFN_BENCH_MODEL": "llama", "TPUCFN_BENCH_BATCH": "8",
+            "TPUCFN_BENCH_REMAT": "0",
+            "TPUCFN_BENCH_STEPS": "8", "TPUCFN_BENCH_WARMUP": "2"},
+            critical=False):
+        return 44
     for k in ("TPUCFN_BENCH_MODEL", "TPUCFN_BENCH_BATCH",
               "TPUCFN_BENCH_STEPS", "TPUCFN_BENCH_WARMUP",
-              "TPUCFN_BENCH_OVERLAP"):
+              "TPUCFN_BENCH_OVERLAP", "TPUCFN_BENCH_REMAT"):
         os.environ.pop(k, None)
 
     # ---- phase 3+: flash attention vs XLA dense (Pallas: riskier) -----

@@ -93,8 +93,16 @@ def test_ring_auto_hops(monkeypatch):
     ref = dot_product_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
 
-    # policy off (threshold above S_loc): auto resolves to dense hops
+    # policy off (threshold above S_loc): auto must resolve to dense
+    # hops — assert the flash kernel is genuinely NOT invoked (output
+    # comparison alone can't tell, both paths agree to tolerance).
+    import tpucfn.kernels.flash_attention as fa
+
+    def boom(*a, **k):
+        raise AssertionError("flash path taken despite policy off")
+
     monkeypatch.setenv("TPUCFN_FLASH_MIN_S", "4096")
+    monkeypatch.setattr(fa, "flash_attention_with_lse", boom)
     out_dense = make_ring_attention(mesh)(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out_dense), np.asarray(ref),
                                atol=2e-4)

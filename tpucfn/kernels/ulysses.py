@@ -21,7 +21,6 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpucfn.mesh import AXIS_CONTEXT, AXIS_TENSOR, BATCH_AXES
-from tpucfn.ops.attention import dot_product_attention
 
 
 def make_ulysses_attention(
@@ -30,8 +29,16 @@ def make_ulysses_attention(
     seq_axis: str = AXIS_CONTEXT,
     heads_axis: str | None = AXIS_TENSOR,
     batch_axes: Sequence[str] = BATCH_AXES,
-    inner: Callable = dot_product_attention,
+    inner: Callable | None = None,
 ):
+    """``inner=None`` uses the shared dense↔flash auto policy
+    (tpucfn.kernels.auto) on the GATHERED sequence length — the
+    all-to-all hands each device the full sequence for its head subset,
+    which is exactly the long-S regime the flash kernel exists for."""
+    if inner is None:
+        from tpucfn.kernels.auto import auto_attention_static_zero
+
+        inner = auto_attention_static_zero
     spec = P(tuple(batch_axes), seq_axis, heads_axis)
 
     def attention_fn(q, k, v, *, causal=True, mask=None, q_offset=0, k_offset=0):

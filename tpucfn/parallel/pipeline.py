@@ -93,7 +93,7 @@ def gpipe(
     def tick(carry, xs):
         # Stage 0 injects this tick's microbatch; other stages consume
         # what arrived from their left neighbor.
-        recv, aux_acc = carry
+        recv, aux_acc, outbuf = carry
         inject, t = xs
         x = jnp.where(i == 0, inject, recv)
         if with_aux:
@@ -105,18 +105,27 @@ def gpipe(
             aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
         else:
             y = stage_fn(stage_params, x)
+        # Microbatch j exits the LAST stage at tick j + p - 1: write it
+        # into its slot of the M-sized output buffer. An M-slot carry
+        # instead of scan-stacking all M+P-1 tick outputs (the r2 form)
+        # drops the fill/drain overstash — every stage still materializes
+        # the buffer (uniform SPMD), but it is the batch's own size.
+        j = t - (p - 1)
+        wmask = ((jnp.arange(m) == j) & (j >= 0) & (j < m) & (i == p - 1))
+        outbuf = jnp.where(wmask.reshape((m,) + (1,) * y.ndim), y[None],
+                           outbuf)
         send = lax.ppermute(y, axis, perm)
-        return (send, aux_acc), y
+        return (send, aux_acc, outbuf), None
 
     zero = jnp.zeros_like(microbatches[0])
-    carry0 = (zero, jnp.zeros((), jnp.float32))
-    (_, aux_acc), ys = lax.scan(tick, carry0, (injects, jnp.arange(ticks)))
+    carry0 = (zero, jnp.zeros((), jnp.float32),
+              jnp.zeros_like(microbatches))
+    (_, aux_acc, outbuf), _ = lax.scan(
+        tick, carry0, (injects, jnp.arange(ticks)))
 
-    # Microbatch j finishes on the last stage at tick j + p - 1: a
-    # contiguous static slice of the tick outputs.
-    finished = lax.slice_in_dim(ys, p - 1, p - 1 + m, axis=0)
-    # Broadcast the last stage's results to every stage (masked psum).
-    out = lax.psum(jnp.where(i == p - 1, finished, jnp.zeros_like(finished)), axis)
+    # Non-last stages carried zeros; the psum broadcasts the last
+    # stage's finished microbatches to every stage.
+    out = lax.psum(outbuf, axis)
     if with_aux:
         return out, lax.psum(aux_acc, axis) / m
     return out
