@@ -10,7 +10,13 @@
 # cap bounds deterministic failures. Never kills a running attempt.
 cd /root/repo
 log=onchip/megabench.log
-for attempt in $(seq 1 14); do
+# Run until the session deadline (default ~11h) rather than a fixed
+# attempt count: fast client-creation failures would otherwise exhaust
+# the cap in under 2h of a 12h session.
+deadline=$(( $(date +%s) + ${SUPERVISE_BUDGET_S:-39600} ))
+attempt=0
+while [ "$(date +%s)" -lt "$deadline" ]; do
+  attempt=$((attempt + 1))
   echo "=== attempt $attempt $(date -u +%FT%TZ) ===" >> "$log"
   python onchip/megabench.py >> "$log" 2>&1
   rc=$?
@@ -18,5 +24,5 @@ for attempt in $(seq 1 14); do
   if [ "$rc" -eq 0 ]; then exit 0; fi
   sleep 420
 done
-echo "=== supervisor exhausted $(date -u +%FT%TZ) ===" >> "$log"
+echo "=== supervisor deadline reached $(date -u +%FT%TZ) ===" >> "$log"
 exit 1
