@@ -138,8 +138,9 @@ def _megabench_live() -> bool:
     must neither probe nor spawn a TPU worker — doing so would both fail
     and risk the one working client."""
     try:
-        r = subprocess.run(["pgrep", "-f", "onchip/megabench.py"],
-                           capture_output=True, text=True, timeout=10)
+        r = subprocess.run(
+            ["pgrep", "-f", r"python[^ ]* .*onchip/megabench\.py"],
+            capture_output=True, text=True, timeout=10)
         return r.returncode == 0
     except (OSError, subprocess.TimeoutExpired):
         return False
@@ -147,12 +148,15 @@ def _megabench_live() -> bool:
 
 def _recorded_onchip() -> dict | None:
     """Newest real-TPU headline result recorded by the single-client
-    megabench suite (onchip/megabench_results.jsonl), if any.  Returned
-    verbatim (the row carries its own provenance: phase, utc, detail
-    incl. platform/device_kind/mfu)."""
+    megabench suite (onchip/megabench_results.jsonl) for the CONFIGURED
+    bench (TPUCFN_BENCH_MODEL), if any.  Returned verbatim (the row
+    carries its own provenance: phase, utc, detail incl.
+    platform/device_kind/mfu)."""
     path = os.environ.get("TPUCFN_BENCH_RECORDED_PATH") or os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
         "onchip", "megabench_results.jsonl")
+    want = ("llama_1b" if os.environ.get("TPUCFN_BENCH_MODEL") == "llama"
+            else "resnet_full")
     best = None
     try:
         with open(path) as f:
@@ -161,7 +165,7 @@ def _recorded_onchip() -> dict | None:
                     row = json.loads(line)
                 except json.JSONDecodeError:
                     continue
-                if not str(row.get("phase", "")).startswith("resnet_full"):
+                if not str(row.get("phase", "")).startswith(want):
                     continue
                 res = row.get("result")
                 if not isinstance(res, dict):
@@ -197,7 +201,10 @@ def orchestrate() -> int:
                 notes.append(f"tpu {note}")
         elif probes:
             notes.append("tpu probe never succeeded")
-        if result is None:
+        if result is None and not reachable:
+            # Replay covers only the unreachable/tunnel-held cases: a live
+            # worker failure must surface as a failure, not be masked by a
+            # stale recorded number.
             rec = _recorded_onchip()
             if rec is not None:
                 result = rec["result"]
@@ -207,7 +214,7 @@ def orchestrate() -> int:
                     "age_s": round(time.time() - rec.get("ts", time.time())),
                     "source": "onchip/megabench_results.jsonl (single-client "
                               "on-chip suite; see PARITY.md round-3 status)"}
-            elif notes:
+            else:
                 notes.append("no recorded on-chip headline result either")
     else:
         notes.append("no PALLAS_AXON_POOL_IPS in env")

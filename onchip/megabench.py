@@ -187,6 +187,11 @@ def main() -> int:
             return False
         for b in batches:
             if xla_phase(f"{phase}_b{b}", {**env, "TPUCFN_BENCH_BATCH": b}):
+                # Checkpoint the base phase too: its failure is
+                # deterministic (OOM at the default batch) and must not
+                # burn a full re-run on every supervisor retry.
+                if phase not in state["done"]:
+                    mark_done(state, phase)
                 return True
             if not _client_alive():
                 return False

@@ -550,19 +550,123 @@ def test_1f1b_accuracy_under_context_parallel():
                                rtol=1e-6)
 
 
-def test_moe_context_parallel_pipelines_raise():
-    """Until per-context-shard aux normalization is defined, MoE + CP
-    pipelines must refuse loudly instead of silently dropping aux."""
+# ---- MoE × context parallelism: block-local routing -----------------------
+#
+# Under CP each context shard routes its own (mb, S/C) tokens (capacity
+# ∝ S/C).  Per-token top-k is unchanged, so in the no-drop regime the MoE
+# OUTPUT equals full-sequence routing (tests reuse the plain model as the
+# logits reference); the aux convention is the mean over context shards.
+
+
+def _blockwise_cp_loss(cfg, toks, num_micro, chunks, z_loss=0.0):
+    """Explicit reference for MoE under CP: full-sequence attention, MoE
+    aux collected per context-shard chunk and averaged over chunks.  Hand
+    -rolled from the same sublayer modules (identical param tree) so the
+    pipeline has an independent target."""
+    import flax.linen as nn
+
+    from tpucfn.models.layers import CausalSelfAttention, RMSNorm
+    from tpucfn.models.moe import MoEMLP, collect_moe_aux
+
+    embed = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype)
+    attn = CausalSelfAttention(
+        n_heads=cfg.n_heads, n_kv_heads=cfg.n_kv_heads,
+        head_dim=cfg.head_dim, rope_theta=cfg.rope_theta, max_seq=cfg.max_seq,
+        dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+    norm = RMSNorm(cfg.norm_eps, cfg.dtype)
+    moe = MoEMLP(cfg.ffn_dim, cfg.moe, cfg.dtype, cfg.param_dtype)
+    head = nn.DenseGeneral(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                           param_dtype=cfg.param_dtype)
+    mb_n = toks.shape[0] // num_micro
+
+    def loss(p):
+        total = 0.0
+        for j in range(num_micro):
+            t = toks[j * mb_n:(j + 1) * mb_n]
+            x = embed.apply({"params": p["embed_tokens"]}, t)
+            aux = 0.0
+            for layer in range(cfg.n_layers):
+                lp = jax.tree.map(lambda a: a[layer], p["layers"])
+                h = attn.apply(
+                    {"params": lp["attn"]},
+                    norm.apply({"params": lp["input_norm"]}, x),
+                    q_offset=jnp.zeros((), jnp.int32))
+                x = x + h
+                normed = norm.apply({"params": lp["post_attn_norm"]}, x)
+                s_loc = normed.shape[1] // chunks
+                outs = []
+                for c in range(chunks):
+                    out, lcl = moe.apply(
+                        {"params": lp["mlp"]},
+                        normed[:, c * s_loc:(c + 1) * s_loc],
+                        mutable=["losses"])
+                    outs.append(out)
+                    aux = aux + collect_moe_aux(lcl) / chunks
+                x = x + jnp.concatenate(outs, axis=1)
+            logits = head.apply(
+                {"params": p["lm_head"]},
+                norm.apply({"params": p["final_norm"]}, x).astype(jnp.float32))
+            ce = causal_lm_loss(logits, t, z_loss=z_loss)[0]
+            total = total + ce + aux
+        return total / num_micro
+
+    return loss
+
+
+def test_gpipe_moe_cp_matches_blockwise_reference():
+    """GPipe × MoE × CP: logits equal the plain model (no-drop regime),
+    aux and AD grads match the blockwise-routing reference."""
+    mesh = build_mesh(MeshSpec(pipeline=2, context=2, data=2))
+    cfg = _moe_cfg()
+    model = Llama(cfg)
+    toks = jnp.asarray(_tokens(b=4, s=32))
+    params = model.init(jax.random.key(0), toks)["params"]
+
+    logits, aux = jax.jit(lambda p, t: pipelined_llama_apply(
+        cfg, mesh, p, t, num_microbatches=2, context_parallel=True,
+        with_aux=True))(params, toks)
+    ref_logits = model.apply({"params": params}, toks)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits),
+                               atol=2e-4)
+
+    def loss_pp(p):
+        lg, ax = pipelined_llama_apply(
+            cfg, mesh, p, toks, num_microbatches=2, context_parallel=True,
+            with_aux=True)
+        return causal_lm_loss(lg, toks)[0] + ax
+
+    loss_ref = _blockwise_cp_loss(cfg, toks, num_micro=2, chunks=2)
+    l_pp = jax.jit(loss_pp)(params)
+    l_ref = jax.jit(loss_ref)(params)
+    np.testing.assert_allclose(float(l_pp), float(l_ref), rtol=1e-5)
+
+    g_pp = jax.jit(jax.grad(loss_pp))(params)
+    g_ref = jax.jit(jax.grad(loss_ref))(params)
+    for path in [("layers", "mlp", "router", "kernel"),
+                 ("layers", "mlp", "experts/down_proj/kernel"),
+                 ("layers", "attn", "q_proj", "kernel")]:
+        assert _grad_diff(g_pp, g_ref, path) < 2e-5, path
+
+
+def test_1f1b_moe_cp_loss_and_grads_match_blockwise_reference():
     from tpucfn.models.llama_pp import pipelined_llama_value_and_grad
 
     mesh = build_mesh(MeshSpec(pipeline=2, context=2, data=2))
     cfg = _moe_cfg()
+    model = Llama(cfg)
     toks = jnp.asarray(_tokens(b=4, s=32))
-    params = Llama(cfg).init(jax.random.key(0), toks)["params"]
-    with pytest.raises(NotImplementedError, match="context parallel"):
-        pipelined_llama_value_and_grad(cfg, mesh, params, toks,
-                                       num_microbatches=2,
-                                       context_parallel=True)
-    with pytest.raises(NotImplementedError, match="context parallel"):
-        pipelined_llama_apply(cfg, mesh, params, toks, num_microbatches=2,
-                              context_parallel=True, with_aux=True)
+    params = model.init(jax.random.key(1), toks)["params"]
+
+    loss_ref = _blockwise_cp_loss(cfg, toks, num_micro=2, chunks=2)
+    l_ref, g_ref = jax.jit(jax.value_and_grad(loss_ref))(params)
+    l_pp, g_pp = jax.jit(lambda p, t: pipelined_llama_value_and_grad(
+        cfg, mesh, p, t, num_microbatches=2, context_parallel=True))(
+        params, toks)
+
+    np.testing.assert_allclose(float(l_pp), float(l_ref), rtol=1e-5)
+    for path in [("layers", "mlp", "experts/gate_proj/kernel"),
+                 ("layers", "mlp", "router", "kernel"),
+                 ("layers", "attn", "q_proj", "kernel"),
+                 ("embed_tokens", "embedding")]:
+        assert _grad_diff(g_pp, g_ref, path) < 2e-5, path

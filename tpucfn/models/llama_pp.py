@@ -64,12 +64,15 @@ def pp_sharding_rules(cfg: LlamaConfig, *, fsdp: bool = True,
                           layer_lead_axis=AXIS_PIPELINE)
 
 
-def _check_moe_cp(with_aux: bool, context_parallel: bool) -> None:
-    if with_aux and context_parallel:
-        raise NotImplementedError(
-            "MoE aux collection under context parallelism is not defined "
-            "yet (per-context-shard routing would need its own aux "
-            "normalization); run MoE pipelines without --context")
+# MoE under context parallelism routes BLOCK-LOCALLY: each context shard
+# routes its own (mb, S/C) tokens with capacity ∝ S/C.  That is the
+# standard SP×EP trade (static shapes, no cross-shard dispatch); per-token
+# top-k is unchanged, so in the no-drop regime the layer OUTPUT equals
+# full-sequence routing and only the aux statistics are shard-local.  The
+# aux convention is the mean over context shards of each shard's aux
+# (stage_fn pre-divides by the context axis size so the schedules' psum
+# over `context` forms that mean); tests pin it against an explicit
+# blockwise-routing reference.
 
 
 def _attention_for(context_parallel: bool, hop_attention: str = "auto"):
@@ -135,7 +138,13 @@ def _make_stage_fn(cfg: LlamaConfig, att, context_parallel: bool,
 
         (h_out, _), auxs = lax.scan(body, (h, q_off), stage_params)
         if with_aux:
-            return h_out, jnp.sum(auxs)
+            aux = jnp.sum(auxs)
+            if context_parallel:
+                # Shard-local aux / C: the schedules psum over `context`
+                # (gpipe wrapper / 1f1b reduce_axes), yielding the mean
+                # over context shards per the blockwise-routing contract.
+                aux = aux / lax.axis_size(AXIS_CONTEXT)
+            return h_out, aux
         return h_out
 
     return stage_fn
@@ -175,10 +184,11 @@ def pipelined_llama_apply(
     losses summed over all layers — differentiable, so
     ``loss = ce + aux`` trains the router. Per-microbatch routing means
     aux is defined per microbatch (matching per-micro sequential
-    application, not one full-batch apply)."""
+    application, not one full-batch apply); under ``context_parallel``
+    routing is additionally block-local per context shard and aux is the
+    mean over shards (see the module-level MoE×CP note)."""
     if not cfg.scan_layers:
         raise ValueError("pipeline execution needs scan_layers=True")
-    _check_moe_cp(with_aux, context_parallel)
 
     att = _attention_for(context_parallel, hop_attention)
 
@@ -196,8 +206,17 @@ def pipelined_llama_apply(
     layer_specs = jax.tree.map(lambda _: P(AXIS_PIPELINE), params["layers"])
     mb_spec = P(None, None, AXIS_CONTEXT) if context_parallel else P()
 
+    def run_body(p, xs):
+        res = gpipe(stage_fn, p, xs, with_aux=with_aux)
+        if with_aux and context_parallel:
+            # Stage aux is shard-local/C (see _make_stage_fn): summing
+            # over context completes the mean over shards.
+            ys, aux = res
+            return ys, lax.psum(aux, AXIS_CONTEXT)
+        return res
+
     run = jax.shard_map(
-        lambda p, xs: gpipe(stage_fn, p, xs, with_aux=with_aux),
+        run_body,
         mesh=mesh,
         in_specs=(layer_specs, mb_spec),
         out_specs=(mb_spec, P()) if with_aux else mb_spec,
@@ -234,7 +253,9 @@ def pipelined_llama_value_and_grad(
     :func:`llama.causal_lm_loss`. MoE configs (``cfg.moe``) additionally
     include the per-microbatch-mean MoE aux losses in ``loss`` with
     exact gradients (threaded through the schedule's aux plumbing — the
-    ``sow`` collection cannot cross the shard_map boundary).
+    ``sow`` collection cannot cross the shard_map boundary); under
+    ``context_parallel`` routing is block-local per context shard and
+    aux is the mean over shards (module-level MoE×CP note).
 
     Unlike :func:`pipelined_llama_apply`, this is not meant to be
     differentiated through — it IS the backward pass, scheduled 1F1B so
@@ -246,7 +267,6 @@ def pipelined_llama_value_and_grad(
     if not cfg.scan_layers:
         raise ValueError("pipeline execution needs scan_layers=True")
     with_aux = cfg.moe is not None
-    _check_moe_cp(with_aux, context_parallel)
     att = _attention_for(context_parallel, hop_attention)
     b, s = tokens.shape
     mb_size = b // num_microbatches
