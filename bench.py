@@ -22,8 +22,10 @@ probe retries on that timescale instead of giving up after one attempt
 
 Env knobs: TPUCFN_BENCH_PRESET=tiny|full, TPUCFN_BENCH_BATCH (per-chip),
 TPUCFN_BENCH_STEPS / _WARMUP (timed/warm step counts), TPUCFN_BENCH_REMAT=0
-(llama: disable remat), TPUCFN_BENCH_OVERLAP=0 (skip the loader leg),
-TPUCFN_BENCH_PROBE_BUDGET_S / _PROBE_INTERVAL_S / _TPU_TIMEOUT_S.
+(llama: disable remat), TPUCFN_BENCH_OPT=adamw|adafactor and
+TPUCFN_BENCH_CE_CHUNK (llama memory levers), TPUCFN_BENCH_OVERLAP=0 (skip
+the loader leg), TPUCFN_BENCH_PROBE_BUDGET_S / _PROBE_INTERVAL_S /
+_TPU_TIMEOUT_S, TPUCFN_BENCH_RECORDED_PATH (replay-tier source).
 """
 
 from __future__ import annotations
@@ -381,7 +383,8 @@ def _worker_llama(tiny: bool) -> int:
     import optax
 
     from tpucfn.mesh import MeshSpec, build_mesh
-    from tpucfn.models.llama import Llama, LlamaConfig, causal_lm_loss, sharding_rules
+    from tpucfn.models.llama import (
+        Llama, LlamaConfig, chunked_causal_lm_loss, sharding_rules)
     from tpucfn.parallel import shard_batch
     from tpucfn.train import Trainer
 
@@ -391,7 +394,7 @@ def _worker_llama(tiny: bool) -> int:
         seq, per_chip_batch, steps, warmup = 128, 4, 6, 2
     else:
         cfg = LlamaConfig.llama3_1b()
-        seq, per_chip_batch, steps, warmup = 2048, 8, 20, 3
+        seq, per_chip_batch, steps, warmup = 2048, 4, 20, 3
     if os.environ.get("TPUCFN_BENCH_REMAT") == "0":
         # Remat trades ~1/3 extra flops for activation memory; when the
         # model fits without it, turning it off is pure MFU.
@@ -410,13 +413,31 @@ def _worker_llama(tiny: bool) -> int:
     def init_fn(rng):
         return model.init(rng, sample)["params"], {}
 
+    # Chunked CE: never materialize the (B, S, 128k) fp32 logits — the
+    # single biggest allocation of the naive step (observed 7.8G at B=8
+    # on chip, an OOM by itself).
+    ce_chunk = int(os.environ.get("TPUCFN_BENCH_CE_CHUNK", "512"))
+
     def loss_fn(params, mstate, batch, rng):
-        loss, acc = causal_lm_loss(
-            model.apply({"params": params}, batch["tokens"]), batch["tokens"])
+        h = model.apply({"params": params}, batch["tokens"],
+                        return_hidden=True)
+        loss, acc = chunked_causal_lm_loss(
+            h, params["lm_head"]["kernel"], batch["tokens"],
+            chunk_size=ce_chunk)
         return loss, ({"accuracy": acc}, mstate)
 
-    trainer = Trainer(mesh, sharding_rules(cfg), loss_fn,
-                      optax.adamw(1e-4), init_fn)
+    # Optimizer state is the other memory wall at 1B on one 16 GB chip:
+    # AdamW keeps 8 bytes/param (mu+nu fp32) on top of fp32 params and
+    # grads — ~16 GB peak before a single activation. The full preset
+    # defaults to factored Adafactor (the T5/PaLM-era TPU answer, ~0
+    # second-moment memory); the per-step compute it removes is
+    # elementwise noise, so tokens/sec and MFU are unaffected.
+    opt_name = os.environ.get("TPUCFN_BENCH_OPT",
+                              "adamw" if tiny else "adafactor")
+    tx = (optax.adafactor(1e-3) if opt_name == "adafactor"
+          else optax.adamw(1e-4))
+
+    trainer = Trainer(mesh, sharding_rules(cfg), loss_fn, tx, init_fn)
     state = trainer.init(jax.random.key(0))
     rs = np.random.RandomState(0)
     batch = shard_batch(mesh, {"tokens": rs.randint(
@@ -432,7 +453,8 @@ def _worker_llama(tiny: bool) -> int:
         "unit": "tokens/sec/chip",
         "vs_baseline": 0.0,
         "detail": {"devices": n_dev, "global_batch": global_batch,
-                   "seq_len": seq, **m},
+                   "seq_len": seq, "optimizer": opt_name,
+                   "ce_chunk": ce_chunk, **m},
     }))
     return 0
 

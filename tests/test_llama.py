@@ -135,3 +135,44 @@ def test_z_loss_penalizes_large_logits():
     l0, _ = causal_lm_loss(logits, toks)
     l1, _ = causal_lm_loss(logits, toks, z_loss=1e-2)
     assert float(l1) > float(l0)
+
+
+# ---- chunked CE: the no-materialized-logits loss path --------------------
+
+
+def test_chunked_causal_lm_loss_matches_dense():
+    """Values, accuracy, and grads (all params) equal the materialized-
+    logits path, across chunk sizes incl. non-dividing ones and z-loss."""
+    from tpucfn.models.llama import chunked_causal_lm_loss
+
+    cfg = LlamaConfig.tiny()
+    model = Llama(cfg)
+    toks = jnp.asarray(_tokens(b=2, s=33))
+    params = model.init(jax.random.key(0), toks)["params"]
+
+    def dense_loss(p, z=0.0):
+        return causal_lm_loss(model.apply({"params": p}, toks), toks, z_loss=z)
+
+    def chunked_loss(p, chunk, z=0.0):
+        h = model.apply({"params": p}, toks, return_hidden=True)
+        return chunked_causal_lm_loss(h, p["lm_head"]["kernel"], toks,
+                                      chunk_size=chunk, z_loss=z)
+
+    l_ref, acc_ref = jax.jit(dense_loss)(params)
+    for chunk in (5, 8, 32, 512):  # 32 tokens: non-dividing, dividing, > n
+        l, acc = jax.jit(lambda p: chunked_loss(p, chunk))(params)
+        np.testing.assert_allclose(float(l), float(l_ref), rtol=1e-6)
+        np.testing.assert_allclose(float(acc), float(acc_ref), rtol=1e-6)
+
+    lz_ref, _ = jax.jit(lambda p: dense_loss(p, 1e-3))(params)
+    lz, _ = jax.jit(lambda p: chunked_loss(p, 8, 1e-3))(params)
+    np.testing.assert_allclose(float(lz), float(lz_ref), rtol=1e-6)
+
+    g_ref = jax.jit(jax.grad(lambda p: dense_loss(p)[0]))(params)
+    g = jax.jit(jax.grad(lambda p: chunked_loss(p, 8)[0]))(params)
+    flat_ref = jax.tree.leaves_with_path(g_ref)
+    flat = dict(jax.tree.leaves_with_path(g))
+    for path, leaf_ref in flat_ref:
+        np.testing.assert_allclose(np.asarray(flat[path]),
+                                   np.asarray(leaf_ref),
+                                   atol=1e-6, err_msg=str(path))

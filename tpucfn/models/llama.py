@@ -110,11 +110,19 @@ class Llama(nn.Module):
     decode: bool = False  # KV-cache autoregressive mode (generation)
 
     @nn.compact
-    def __call__(self, tokens, *, q_offset=0):
+    def __call__(self, tokens, *, q_offset=0, return_hidden=False):
         """tokens: (B, S) int32 → logits (B, S, vocab) fp32.
 
         ``q_offset`` is the global position of tokens[:, 0] — nonzero when
         the sequence axis is sharded (ring attention / SP).
+
+        ``return_hidden=True`` stops after the final norm and returns the
+        (B, S, dim) hidden states instead of logits — pair it with
+        :func:`chunked_causal_lm_loss`, which applies the LM head
+        chunk-by-chunk so the fp32 (B, S, vocab) logits tensor is never
+        materialized (at B=8, S=2k, V=128k that tensor alone is ~8 GB —
+        more than half a v5e's HBM; observed OOM on chip).  Init with the
+        default ``False`` so the head params are created.
         """
         if self.decode and not (isinstance(q_offset, int) and q_offset == 0):
             raise ValueError("decode mode is incompatible with q_offset/SP sharding")
@@ -153,6 +161,8 @@ class Llama(nn.Module):
         x = carry[0]
 
         x = RMSNorm(cfg.norm_eps, cfg.dtype, name="final_norm")(x)
+        if return_hidden:
+            return x
         logits = nn.DenseGeneral(
             cfg.vocab_size, use_bias=False, dtype=jnp.float32,
             param_dtype=cfg.param_dtype, name="lm_head",
@@ -196,6 +206,67 @@ def sharding_rules(cfg: LlamaConfig, *, fsdp: bool = True, tensor: bool = True,
         (r"lm_head/kernel$", P(f, t)),
         (r".*", P()),
     ))
+
+
+def chunked_causal_lm_loss(
+    hidden: jax.Array,          # (B, S, D) — Llama(...)(…, return_hidden=True)
+    lm_head_kernel: jax.Array,  # (D, V)
+    tokens: jax.Array,          # (B, S) int32
+    *,
+    chunk_size: int = 512,
+    z_loss: float = 0.0,
+) -> tuple[jax.Array, jax.Array]:
+    """Next-token CE + accuracy WITHOUT materializing (B, S, V) logits.
+
+    Numerically equal to ``causal_lm_loss(hidden @ W, tokens)`` (tests
+    assert values and grads): a ``lax.scan`` over sequence chunks
+    computes each chunk's fp32 logits, reduces them to a CE sum and a
+    correct-count, and drops them; ``jax.checkpoint`` on the chunk body
+    makes reverse-mode recompute logits chunkwise instead of stashing
+    them.  Peak logits memory is (B, chunk, V) instead of (B, S, V) —
+    the difference between fitting and the observed on-chip OOM for
+    Llama-1B (V=128k) on one 16 GB chip, and a hard requirement at the
+    long-context end (S=32k never fits materialized).
+    """
+    import optax
+
+    b, s, _ = hidden.shape
+    n = s - 1
+    pred = hidden[:, :-1]
+    targets = tokens[:, 1:]
+    c = max(1, min(chunk_size, n))
+    pad = (-n) % c
+    if pad:
+        pred = jnp.pad(pred, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)), constant_values=-1)
+    k = (n + pad) // c
+    pred = pred.reshape(b, k, c, -1).swapaxes(0, 1)     # (k, B, c, D)
+    targets = targets.reshape(b, k, c).swapaxes(0, 1)   # (k, B, c)
+
+    @jax.checkpoint
+    def chunk_sums(w, h_c, t_c):
+        logits = h_c.astype(jnp.float32) @ w.astype(jnp.float32)
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(
+            logits, jnp.maximum(t_c, 0))
+        if z_loss:
+            per_tok = per_tok + z_loss * jax.nn.logsumexp(logits, axis=-1) ** 2
+        valid = t_c >= 0
+        ce = jnp.sum(jnp.where(valid, per_tok, 0.0))
+        correct = jnp.sum(jnp.where(valid, jnp.argmax(logits, -1) == t_c,
+                                    False).astype(jnp.float32))
+        return ce, correct
+
+    def body(carry, xs):
+        ce_acc, cor_acc = carry
+        h_c, t_c = xs
+        ce, cor = chunk_sums(lm_head_kernel, h_c, t_c)
+        return (ce_acc + ce, cor_acc + cor), None
+
+    (ce, cor), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (pred, targets))
+    denom = b * n
+    return ce / denom, cor / denom
 
 
 def causal_lm_loss(logits: jax.Array, tokens: jax.Array,
