@@ -101,3 +101,53 @@ def test_metrics_are_replicated_scalars(mesh_dp8):
     state = trainer.init(jax.random.key(0))
     state, m = trainer.step(state, shard_batch(mesh_dp8, _regression_batch()))
     assert m["loss"].shape == ()
+
+
+def test_adafactor_factored_state_shards_and_trains():
+    """Factored optimizer state (Adafactor v_row/v_col, rank n-1) mirrors
+    the param paths, so param rules' specs are over-long for it; the
+    Trainer must replicate those leaves instead of raising (observed
+    on-chip: the llama bench with adafactor died in state_shardings on
+    'opt_state/0/v_row/embed_tokens/embedding')."""
+    import optax
+
+    from tpucfn.mesh import MeshSpec, build_mesh
+    from tpucfn.models.llama import Llama, LlamaConfig, causal_lm_loss, sharding_rules
+
+    mesh = build_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
+    cfg = LlamaConfig.tiny()
+    model = Llama(cfg)
+    sample = jnp.zeros((4, 16), jnp.int32)
+
+    def init_fn(rng):
+        return model.init(rng, sample)["params"], {}
+
+    def loss_fn(params, mstate, batch, rng):
+        loss, acc = causal_lm_loss(
+            model.apply({"params": params}, batch["tokens"]), batch["tokens"])
+        return loss, ({"accuracy": acc}, mstate)
+
+    trainer = Trainer(mesh, sharding_rules(cfg), loss_fn,
+                      optax.adafactor(3e-3), init_fn)
+    state = trainer.init(jax.random.key(0))
+    # params keep their rule shardings; factored vectors are replicated
+    emb = state.params["embed_tokens"]["embedding"]
+    assert emb.sharding.spec == P("tensor", "fsdp")
+
+    def leaves_with_path(tree):
+        return jax.tree_util.tree_flatten_with_path(tree)[0]
+
+    factored = [(p, leaf) for p, leaf in leaves_with_path(state.opt_state)
+                if "v_row" in str(p) or "v_col" in str(p)]
+    assert factored, "adafactor state should contain factored vectors"
+    for p, leaf in factored:
+        assert leaf.sharding.spec == P(), (p, leaf.sharding.spec)
+
+    rs = np.random.RandomState(0)
+    batch = shard_batch(mesh, {"tokens": rs.randint(
+        0, cfg.vocab_size, (8, 16)).astype(np.int32)})
+    first = None
+    for _ in range(10):
+        state, m = trainer.step(state, batch)
+        first = first if first is not None else float(m["loss"])
+    assert float(m["loss"]) < first

@@ -54,9 +54,16 @@ class ShardingRules:
             if not isinstance(spec, P):
                 raise TypeError(f"rule {pat!r} maps to {spec!r}, want PartitionSpec")
 
-    def spec_for(self, path: str, ndim: int) -> P:
+    def spec_for(self, path: str, ndim: int,
+                 on_rank_mismatch: Any = None) -> P:
+        """First matching rule's spec, rank-checked.  ``on_rank_mismatch``
+        (path, spec, ndim) -> P handles leaves of lower rank than the
+        matched spec — e.g. factored optimizer state (Adafactor v_row/
+        v_col mirror the param path at rank n-1); default is to raise."""
         for pat, spec in self.rules:
             if re.search(pat, path):
+                if len(spec) > ndim and on_rank_mismatch is not None:
+                    return on_rank_mismatch(path, spec, ndim)
                 return _fit_spec(spec, ndim, path)
         return P()
 
@@ -76,10 +83,14 @@ def _fit_spec(spec: P, ndim: int, path: str) -> P:
     return spec
 
 
-def make_partition_spec(rules: ShardingRules, tree: Any) -> Any:
+def make_partition_spec(rules: ShardingRules, tree: Any,
+                        on_rank_mismatch: Any = None) -> Any:
     """Map a pytree of arrays/ShapeDtypeStructs to a pytree of PartitionSpec."""
     return jax.tree_util.tree_map_with_path(
-        lambda path, x: rules.spec_for(_path_str(path), getattr(x, "ndim", len(getattr(x, "shape", ())))),
+        lambda path, x: rules.spec_for(
+            _path_str(path),
+            getattr(x, "ndim", len(getattr(x, "shape", ()))),
+            on_rank_mismatch),
         tree,
     )
 
@@ -87,11 +98,12 @@ def make_partition_spec(rules: ShardingRules, tree: Any) -> Any:
 partition_spec_tree = make_partition_spec  # alias
 
 
-def named_sharding_tree(mesh: Mesh, rules: ShardingRules, tree: Any) -> Any:
+def named_sharding_tree(mesh: Mesh, rules: ShardingRules, tree: Any,
+                        on_rank_mismatch: Any = None) -> Any:
     """PartitionSpecs bound to a concrete mesh, ready for jit in_shardings."""
     return jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),
-        make_partition_spec(rules, tree),
+        make_partition_spec(rules, tree, on_rank_mismatch),
         is_leaf=lambda x: isinstance(x, P),
     )
 

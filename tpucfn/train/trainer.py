@@ -94,6 +94,18 @@ class Trainer:
         # etc., so path-regex rules written for params still match).
         return self.rules.extended([(r"(^|/)(step|rng|count)($|/)", P())])
 
+    @staticmethod
+    def _opt_rank_mismatch(path: str, spec, ndim: int):
+        # Factored optimizer state (Adafactor v_row/v_col) mirrors the
+        # param path at rank n-1, so the param rule's spec is over-long.
+        # Replicate it: the factored vectors are ~params/dim in size, so
+        # replication costs nothing next to resharding-rule surgery.
+        if path.startswith("opt_state"):
+            return P()
+        raise ValueError(
+            f"rule spec {spec} has {len(spec)} entries but {path!r} has "
+            f"rank {ndim}")
+
     def _create_state(self, rng: jax.Array) -> TrainState:
         params_rng, step_rng = jax.random.split(rng)
         params, model_state = self.init_fn(params_rng)
@@ -107,7 +119,8 @@ class Trainer:
     def state_shardings(self) -> Any:
         if self._state_shardings is None:
             self._state_shardings = named_sharding_tree(
-                self.mesh, self._state_rules(), self._abstract()
+                self.mesh, self._state_rules(), self._abstract(),
+                self._opt_rank_mismatch,
             )
         return self._state_shardings
 
@@ -217,4 +230,5 @@ class Trainer:
         return self._jit_eval(state, batch)
 
     def param_spec(self) -> Any:
-        return make_partition_spec(self._state_rules(), self._abstract())
+        return make_partition_spec(self._state_rules(), self._abstract(),
+                                   self._opt_rank_mismatch)
