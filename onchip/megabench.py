@@ -228,6 +228,19 @@ def main() -> int:
     if not llama_ok and not _client_alive():
         return 44
     if llama_ok:
+        # Full re-run recording the analytic-MFU fix (XLA cost analysis
+        # counts the scanned layer body once; bench.py now reports
+        # 6*N*tokens). Prefix keeps it replay-eligible as the headline.
+        if not xla_phase("llama_1b_v2", {
+                "TPUCFN_BENCH_MODEL": "llama", "TPUCFN_BENCH_BATCH": None},
+                critical=False):
+            return 44
+        # b8 is the fit boundary with chunked CE (b4 fits, b16 OOMs).
+        if not xla_phase("llama_b8", {
+                "TPUCFN_BENCH_MODEL": "llama", "TPUCFN_BENCH_BATCH": "8",
+                "TPUCFN_BENCH_STEPS": "8", "TPUCFN_BENCH_WARMUP": "2"},
+                critical=False):
+            return 44
         for b in (4, 16, 32):
             if not xla_phase(f"llama_b{b}", {
                     "TPUCFN_BENCH_MODEL": "llama",
@@ -269,6 +282,9 @@ def main() -> int:
     flash("flash_s2k", ["--seqs", "2048"])
     flash("flash_s8k", ["--seqs", "8192"])
     flash("flash_s32k", ["--seqs", "32768"])
+    # 4k pins the dense->flash dispatch threshold: measured 2k loses
+    # fwd+bwd, 8k wins 5x+ — the crossover is in between.
+    flash("flash_s4k", ["--seqs", "4096"])
 
     # ---- phase 6: block autotuner (persists ~/.tpucfn/flash_tune.json;
     # the kernel's default block chooser reads it) ----------------------
@@ -292,6 +308,24 @@ def main() -> int:
     tune_phase("tune_s2k", 2048)
     tune_phase("tune_s8k", 8192)
     tune_phase("tune_s32k", 32768, iters=3)
+    tune_phase("tune_s4k", 4096)
+
+    # Re-measure flash-vs-dense AFTER tuning: the kernel's default block
+    # chooser reads the freshly persisted table (in-process too), so
+    # these rows are the shipped-default numbers a user gets.
+    flash("flash_s2k_tuned", ["--seqs", "2048"])
+    flash("flash_s4k_tuned", ["--seqs", "4096"])
+    flash("flash_s8k_tuned", ["--seqs", "8192"])
+
+    # Quiet-host re-run of the loader-overlap leg: the first capture ran
+    # while two pytest suites hogged the host cores, which pollutes the
+    # host-side decode measurement (the device-bound step times do not
+    # care). Short steps; the overlap sub-measurement is the point.
+    if not xla_phase("resnet_overlap_quiet", {
+            "TPUCFN_BENCH_MODEL": None, "TPUCFN_BENCH_BATCH": None,
+            "TPUCFN_BENCH_STEPS": "12", "TPUCFN_BENCH_WARMUP": "3",
+            "TPUCFN_BENCH_OVERLAP": "1"}, critical=False):
+        return 44
 
     # Ship the tuned table where the repo can pick it up as a default.
     try:

@@ -138,3 +138,19 @@ def test_autotuner_tune_lookup_and_block_choice(tmp_path, monkeypatch):
 
     raw = json.loads((tmp_path / "tune.json").read_text())
     assert list(raw.values())[0] == list(res["best"])
+
+
+def test_builtin_tune_table_layering(tmp_path, monkeypatch):
+    """The packaged flash_tune_builtin.json seeds defaults; a user's own
+    cache overrides per key."""
+    from tpucfn.kernels import flash_autotune as fa
+
+    monkeypatch.setenv("TPUCFN_FLASH_TUNE_CACHE", str(tmp_path / "user.json"))
+    monkeypatch.setattr(fa, "_MEM_CACHE", None)
+    table = fa._load()
+    key = "TPU v5 lite|causal|8192|128|bfloat16"
+    assert table[key] == (256, 512)  # measured on chip, round 3
+
+    (tmp_path / "user.json").write_text(json.dumps({key: [128, 128]}))
+    monkeypatch.setattr(fa, "_MEM_CACHE", None)
+    assert fa._load()[key] == (128, 128)

@@ -8,12 +8,14 @@ share one rule:
 
 * ``should_use_flash(s)`` — True iff the backend is TPU and
   ``s >= flash_threshold()``.
-* ``flash_threshold()`` — ``TPUCFN_FLASH_MIN_S`` (default 2048: the r1
-  on-chip datapoint had flash ≈ parity with dense at S=2k BEFORE the
-  causal block-skip landed, so the skip's ~2× causal-flops saving makes
-  2k the conservative crossover; re-measured values from
-  ``benches/flash_bench.py`` / ``flash_autotune.tune`` should override
-  via the env var).
+* ``flash_threshold()`` — ``TPUCFN_FLASH_MIN_S`` (default 2048, now
+  MEASURED, r3 on a v5e with the shipped autotuned block table
+  (kernels/flash_tune_builtin.json): fwd+bwd vs XLA dense 1.16x at
+  S=2k, 2.19x/1.65x at 4k, 38.6x/2.9x at 8k, flash-only at 32k (dense
+  OOMs). On device kinds without a tuned table entry the 128/128
+  default blocks lose the backward at 2k (0.64x) — run
+  ``flash_autotune.tune`` once per device generation, or raise the env
+  var to 4096 where tuning isn't an option).
 
 Dispatch sites:
 * :class:`tpucfn.models.llama.Llama` with ``attention_fn=None`` (the

@@ -54,14 +54,26 @@ def _key(device_kind: str, causal: bool, s: int, d: int, dtype) -> str:
                      str(_bucket(s)), str(d), str(np.dtype(dtype))])
 
 
+def _read_table(path: Path) -> dict[str, tuple[int, int]]:
+    try:
+        raw = json.loads(path.read_text())
+        return {k: tuple(v) for k, v in raw.items()}
+    except (OSError, ValueError):
+        return {}
+
+
 def _load() -> dict[str, tuple[int, int]]:
+    """User cache layered over the packaged table: tunes shipped with the
+    repo (flash_tune_builtin.json — measured on real chips, see PARITY
+    round-3 status) seed the defaults; a user's own ``tune`` runs
+    override them per key.  The user cache file stores only the user's
+    own tunes (``_save`` never writes builtin entries into it, so a
+    package update can improve unpinned keys)."""
     global _MEM_CACHE
     if _MEM_CACHE is None:
-        try:
-            raw = json.loads(_cache_path().read_text())
-            _MEM_CACHE = {k: tuple(v) for k, v in raw.items()}
-        except (OSError, ValueError):
-            _MEM_CACHE = {}
+        table = _read_table(Path(__file__).parent / "flash_tune_builtin.json")
+        table.update(_read_table(_cache_path()))
+        _MEM_CACHE = table
     return _MEM_CACHE
 
 
@@ -155,7 +167,9 @@ def tune(
     best = min(ok, key=lambda r: r["total_ms"])["blocks"]
     key = _key(jax.devices()[0].device_kind, causal, s, d, dtype)
     if persist:
-        cache = _load()
-        cache[key] = tuple(best)
-        _save(cache)
+        global _MEM_CACHE
+        user = _read_table(_cache_path())
+        user[key] = tuple(best)
+        _save(user)
+        _MEM_CACHE = None  # re-merge (builtin + user) on next lookup
     return {"best": tuple(best), "rows": rows, "key": key}
