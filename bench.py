@@ -340,7 +340,9 @@ def _measure_input_overlap(trainer, state, mesh, *, image_hw, classes,
             shards, batch_size_per_process=global_batch, seed=0,
             cache_in_memory=False, process_index=0, process_count=1,
             transform=Compose([decode_transform(),
-                               center_crop_resize(image_hw), to_float]))
+                               center_crop_resize(image_hw), to_float]),
+            num_workers=int(os.environ.get(
+                "TPUCFN_BENCH_LOADER_WORKERS", "0")))
         it = prefetch_to_mesh(ds.batches(None), mesh)
         # Warm compile + drain the prefetch queue's head start (depth=2):
         # timing must start from STEADY state, or the first few steps

@@ -104,34 +104,40 @@ def _write_small_record_shards(tmp: Path, n: int, num_shards: int):
     return write_dataset_shards(gen(), d, num_shards=num_shards)
 
 
-def bench_decode(jpeg_shards, raw_shards, batch: int, image_size: int) -> dict:
+def bench_decode(jpeg_shards, raw_shards, batch: int, image_size: int,
+                 workers: int = 0) -> dict:
     from tpucfn.data.images import center_crop_resize, decode_transform
     from tpucfn.data.pipeline import ShardedDataset
     from tpucfn.data.transforms import Compose
 
     crop = image_size - image_size // 8
 
-    def throughput(shards, transform):
+    def throughput(shards, transform, num_workers=0):
         ds = ShardedDataset(
             shards, batch_size_per_process=batch, seed=0,
             cache_in_memory=False, process_index=0, process_count=1,
-            transform=transform)
+            transform=transform, num_workers=num_workers)
         n = 0
         t0 = time.perf_counter()
         for b in ds.epoch(0):
             n += b["image"].shape[0] if hasattr(b["image"], "shape") else batch
         return n / (time.perf_counter() - t0)
 
-    jpeg_ips = throughput(
-        jpeg_shards, Compose([decode_transform(), center_crop_resize(crop)]))
+    tf = Compose([decode_transform(), center_crop_resize(crop)])
+    jpeg_ips = throughput(jpeg_shards, tf)
     raw_ips = throughput(raw_shards, None)
-    return {
+    out = {
         "phase": "decode",
         "jpeg_decode_crop_images_s": round(jpeg_ips, 1),
         "raw_passthrough_images_s": round(raw_ips, 1),
         "batch": batch,
         "image_size": image_size,
     }
+    if workers:
+        w_ips = throughput(jpeg_shards, tf, num_workers=workers)
+        out[f"jpeg_decode_crop_images_s_w{workers}"] = round(w_ips, 1)
+        out["worker_speedup"] = round(w_ips / jpeg_ips, 2)
+    return out
 
 
 def main() -> int:
@@ -140,6 +146,9 @@ def main() -> int:
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--batch", type=int, default=32)
     p.add_argument("--num-shards", type=int, default=8)
+    p.add_argument("--workers", type=int, default=8,
+                   help="also measure the thread-pool decode path at this "
+                        "worker count (0 skips)")
     args = p.parse_args()
 
     tmp = Path(tempfile.mkdtemp(prefix="tpucfn-data-bench-"))
@@ -153,7 +162,8 @@ def main() -> int:
         print(json.dumps(bench_reader(raw, "600kb_records")), flush=True)
         print(json.dumps(bench_reader(small, "4kb_records")), flush=True)
         print(json.dumps(bench_decode(jpeg, raw, args.batch,
-                                      args.image_size)), flush=True)
+                                      args.image_size, args.workers)),
+              flush=True)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
     return 0

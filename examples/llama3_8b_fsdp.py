@@ -60,6 +60,13 @@ def main() -> int:
                    help="expert (MoE) mesh axis size")
     p.add_argument("--num-examples", type=int, default=256)
     p.add_argument("--z-loss", type=float, default=1e-4)
+    p.add_argument("--ce-chunk", type=int, default=512,
+                   help="compute the LM-head CE over sequence chunks of "
+                        "this size so the fp32 (B,S,vocab) logits are "
+                        "never materialized (measured on chip: that "
+                        "tensor alone OOMs Llama-1B at batch 8 on 16G); "
+                        "0 materializes logits (pipeline paths always "
+                        "do — the head runs inside the schedule)")
     args = p.parse_args()
 
     from tpucfn.launch import initialize_runtime
@@ -172,6 +179,25 @@ def main() -> int:
             pp_loss.defvjp(pp_loss_fwd, pp_loss_bwd)
             loss, acc = pp_loss(params)
             return loss, ({"accuracy": acc}, mstate)
+    elif args.pipeline == 1 and args.ce_chunk:
+        from tpucfn.models.llama import chunked_causal_lm_loss
+
+        def loss_fn(params, mstate, batch, rng):
+            if cfg.moe is not None:
+                from tpucfn.models.moe import collect_moe_aux
+
+                hidden, lcl = model.apply(
+                    {"params": params}, batch["tokens"],
+                    return_hidden=True, mutable=["losses"])
+                aux = collect_moe_aux(lcl)
+            else:
+                hidden = model.apply({"params": params}, batch["tokens"],
+                                     return_hidden=True)
+                aux = 0.0
+            loss, acc = chunked_causal_lm_loss(
+                hidden, params["lm_head"]["kernel"], batch["tokens"],
+                chunk_size=args.ce_chunk, z_loss=args.z_loss)
+            return loss + aux, ({"accuracy": acc}, mstate)
     else:
         def loss_fn(params, mstate, batch, rng):
             logits, aux = forward(params, batch["tokens"])

@@ -48,13 +48,24 @@ class ShardedDataset:
         transform=None,  # per-example Transform (tpucfn.data.transforms)
         cache_in_memory: bool = True,
         shuffle_buffer: int = 2048,
+        num_workers: int = 0,
     ):
         """``cache_in_memory=False`` streams shards instead of
         materializing every decoded example in host RAM — required for
         ImageNet-scale datasets (~140 GB encoded; SURVEY.md §3.2's
         DataIter streamed the same way).  Shuffling then uses shard-order
         shuffling + a ``shuffle_buffer``-sized reservoir, seeded per
-        (seed, epoch, process) so batches stay reproducible."""
+        (seed, epoch, process) so batches stay reproducible.
+
+        ``num_workers>0`` applies ``transform`` across that many threads
+        per batch (PIL decode and numpy release the GIL) — the measured
+        answer to one chip consuming ~2500 img/s while a single-threaded
+        decode delivers ~650/s.  Still deterministic: per-example
+        augmentation seeds are drawn sequentially from the epoch stream
+        and order is preserved, so batches are reproducible for a given
+        ``num_workers`` setting (0 keeps the exact legacy draw stream;
+        >0 uses the per-example-seed stream regardless of worker
+        count)."""
         if not shard_paths:
             raise ValueError("no shard paths given")
         self.all_shards = sorted(str(p) for p in shard_paths)
@@ -73,8 +84,19 @@ class ShardedDataset:
         self.transform = transform
         self.cache_in_memory = cache_in_memory
         self.shuffle_buffer = shuffle_buffer
+        self.num_workers = num_workers
+        self._pool = None
         self._cache: list[dict[str, np.ndarray]] | None = None
         self._len: int | None = None
+
+    def _executor(self):
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.num_workers,
+                thread_name_prefix="tpucfn-decode")
+        return self._pool
 
     def _load(self) -> list[dict[str, np.ndarray]]:
         if self._cache is None:
@@ -111,7 +133,17 @@ class ShardedDataset:
 
         def emit(chosen):
             if self.transform is not None:
-                chosen = [self.transform(ex, aug_rs) for ex in chosen]
+                if self.num_workers > 0:
+                    # Per-example seeds drawn sequentially from the epoch
+                    # stream keep the result independent of thread timing;
+                    # executor.map preserves order.
+                    seeds = aug_rs.randint(0, 2**31 - 1, size=len(chosen))
+                    chosen = list(self._executor().map(
+                        lambda ex_s: self.transform(
+                            ex_s[0], np.random.RandomState(ex_s[1])),
+                        zip(chosen, seeds)))
+                else:
+                    chosen = [self.transform(ex, aug_rs) for ex in chosen]
             return {k: np.stack([ex[k] for ex in chosen]) for k in chosen[0]}
 
         if not self.cache_in_memory:
