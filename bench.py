@@ -157,8 +157,9 @@ def _recorded_onchip() -> dict | None:
     path = os.environ.get("TPUCFN_BENCH_RECORDED_PATH") or os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
         "onchip", "megabench_results.jsonl")
-    want = ("llama_1b" if os.environ.get("TPUCFN_BENCH_MODEL") == "llama"
-            else "resnet_full")
+    want = {"llama": "llama_1b", "bert": "bert_full",
+            "unet": "unet_full"}.get(
+        os.environ.get("TPUCFN_BENCH_MODEL", "resnet"), "resnet_full")
     best = None
     try:
         with open(path) as f:
@@ -471,6 +472,135 @@ def _worker_llama(tiny: bool) -> int:
     return 0
 
 
+def _worker_bert(tiny: bool) -> int:
+    """BASELINE config 3 (BERT-base pretrain, the Horovod->JAX launcher
+    path): MLM training tokens/sec/chip + MFU (cost analysis is exact
+    here — layers are unrolled, no scan)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tpucfn.mesh import MeshSpec, build_mesh
+    from tpucfn.models import Bert, BertConfig, mlm_loss
+    from tpucfn.parallel import shard_batch, transformer_rules
+    from tpucfn.train import Trainer
+
+    n_dev = jax.device_count()
+    cfg = BertConfig.tiny() if tiny else BertConfig.base()
+    seq = 64 if tiny else 512
+    per_chip_batch = int(os.environ.get("TPUCFN_BENCH_BATCH",
+                                        4 if tiny else 32))
+    steps = int(os.environ.get("TPUCFN_BENCH_STEPS", 6 if tiny else 20))
+    warmup = int(os.environ.get("TPUCFN_BENCH_WARMUP", 2 if tiny else 3))
+    global_batch = per_chip_batch * n_dev
+    mesh = build_mesh(MeshSpec.for_devices(n_dev))
+    model = Bert(cfg)
+    sample = jnp.zeros((1, seq), jnp.int32)
+    MASK_ID = 3
+
+    def init_fn(rng):
+        return model.init(rng, sample)["params"], {}
+
+    def loss_fn(params, mstate, batch, rng):
+        tokens = batch["tokens"]
+        r1, r2, r3 = jax.random.split(rng, 3)
+        mask = jax.random.uniform(r1, tokens.shape) < 0.15
+        swap = jax.random.uniform(r2, tokens.shape)
+        randoms = jax.random.randint(r3, tokens.shape, 0, cfg.vocab_size)
+        masked = jnp.where(mask & (swap < 0.8), MASK_ID, tokens)
+        masked = jnp.where(mask & (swap >= 0.8) & (swap < 0.9), randoms, masked)
+        logits = model.apply({"params": params}, masked, train=True,
+                             rngs={"dropout": rng})
+        loss, acc = mlm_loss(logits, tokens, mask)
+        return loss, ({"accuracy": acc}, mstate)
+
+    trainer = Trainer(mesh, transformer_rules(tensor=False), loss_fn,
+                      optax.adamw(1e-4, weight_decay=0.01), init_fn)
+    state = trainer.init(jax.random.key(0))
+    rs = np.random.RandomState(0)
+    batch = shard_batch(mesh, {"tokens": rs.randint(
+        0, cfg.vocab_size, (global_batch, seq)).astype(np.int32)})
+    state, m = _measure_trainer(trainer, state, batch, steps=steps,
+                                warmup=warmup)
+    toks_chip = global_batch * seq / m["mean_step_s"] / n_dev
+    print(json.dumps({
+        "metric": ("bert_base_mlm_tokens_per_sec_per_chip" if not tiny
+                   else "tiny_bert_mlm_tokens_per_sec_per_chip"),
+        "value": round(toks_chip, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 0.0,
+        "detail": {"devices": n_dev, "global_batch": global_batch,
+                   "seq_len": seq, **m},
+    }))
+    return 0
+
+
+def _worker_unet(tiny: bool) -> int:
+    """BASELINE config 5 (SD-1.5 UNet finetune, the streaming config):
+    DDPM epsilon-prediction training latents/sec/chip + MFU (convs are
+    unrolled — cost analysis exact)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tpucfn.mesh import MeshSpec, build_mesh
+    from tpucfn.models.unet import UNet, UNetConfig, ddpm_loss
+    from tpucfn.parallel import shard_batch, transformer_rules
+    from tpucfn.train import Trainer
+
+    n_dev = jax.device_count()
+    cfg = UNetConfig.tiny() if tiny else UNetConfig.sd15()
+    hw = 8 if tiny else 64  # 64x64x4 latents = 512px images
+    ctx_len = 8 if tiny else 77
+    per_chip_batch = int(os.environ.get("TPUCFN_BENCH_BATCH",
+                                        4 if tiny else 8))
+    steps = int(os.environ.get("TPUCFN_BENCH_STEPS", 6 if tiny else 20))
+    warmup = int(os.environ.get("TPUCFN_BENCH_WARMUP", 2 if tiny else 3))
+    global_batch = per_chip_batch * n_dev
+    mesh = build_mesh(MeshSpec.for_devices(n_dev))
+    model = UNet(cfg)
+
+    def init_fn(rng):
+        return model.init(
+            rng, jnp.zeros((1, hw, hw, cfg.in_channels)),
+            jnp.zeros((1,), jnp.int32),
+            jnp.zeros((1, ctx_len, cfg.context_dim)),
+        )["params"], {}
+
+    def loss_fn(params, mstate, batch, rng):
+        return ddpm_loss(model, params, batch, rng), ({}, mstate)
+
+    # Finetune-scale AdamW unless memory-constrained (env override).
+    opt_name = os.environ.get("TPUCFN_BENCH_OPT", "adamw")
+    tx = (optax.adafactor(1e-5) if opt_name == "adafactor"
+          else optax.adamw(1e-5))
+    trainer = Trainer(mesh, transformer_rules(tensor=False), loss_fn,
+                      tx, init_fn)
+    state = trainer.init(jax.random.key(0))
+    rs = np.random.RandomState(0)
+    batch = shard_batch(mesh, {
+        "latents": rs.randn(global_batch, hw, hw, cfg.in_channels
+                            ).astype(np.float32),
+        "context": rs.randn(global_batch, ctx_len, cfg.context_dim
+                            ).astype(np.float32),
+    })
+    state, m = _measure_trainer(trainer, state, batch, steps=steps,
+                                warmup=warmup)
+    lat_chip = global_batch / m["mean_step_s"] / n_dev
+    print(json.dumps({
+        "metric": ("sd15_unet_train_latents_per_sec_per_chip" if not tiny
+                   else "tiny_unet_train_latents_per_sec_per_chip"),
+        "value": round(lat_chip, 2),
+        "unit": "latents/sec/chip",
+        "vs_baseline": 0.0,
+        "detail": {"devices": n_dev, "global_batch": global_batch,
+                   "latent_hw": hw, "optimizer": opt_name, **m},
+    }))
+    return 0
+
+
 def worker() -> int:
     import jax
 
@@ -499,8 +629,13 @@ def worker() -> int:
     from tpucfn.train import Trainer
 
     tiny = os.environ.get("TPUCFN_BENCH_PRESET", "full") == "tiny"
-    if os.environ.get("TPUCFN_BENCH_MODEL", "resnet") == "llama":
+    which = os.environ.get("TPUCFN_BENCH_MODEL", "resnet")
+    if which == "llama":
         return _worker_llama(tiny)
+    if which == "bert":
+        return _worker_bert(tiny)
+    if which == "unet":
+        return _worker_unet(tiny)
     n_dev = jax.device_count()
 
     # --- "create-stack" leg of time-to-first-step (BASELINE metric 2).

@@ -254,9 +254,29 @@ def main() -> int:
                 "TPUCFN_BENCH_STEPS": "8", "TPUCFN_BENCH_WARMUP": "2"},
                 critical=False):
             return 44
+    # ---- BERT-base + SD-1.5 UNet: the remaining BASELINE configs.
+    # Non-fatal like llama; batch shrinks on OOM with a live client.
+    extras_ok = True
+    for phase, env, fallbacks in (
+        ("bert_full",
+         {"TPUCFN_BENCH_MODEL": "bert", "TPUCFN_BENCH_BATCH": None,
+          "TPUCFN_BENCH_OPT": None},
+         ("16", "8")),
+        # 860M-param UNet + AdamW is ~14G of state alone on a 16G chip;
+        # factored Adafactor keeps the phase about throughput.
+        ("unet_full",
+         {"TPUCFN_BENCH_MODEL": "unet", "TPUCFN_BENCH_BATCH": None,
+          "TPUCFN_BENCH_OPT": "adafactor"},
+         ("4", "2")),
+    ):
+        if not headline_with_batch_fallback(phase, env, fallbacks):
+            if not _client_alive():
+                return 44
+            extras_ok = False
     for k in ("TPUCFN_BENCH_MODEL", "TPUCFN_BENCH_BATCH",
               "TPUCFN_BENCH_STEPS", "TPUCFN_BENCH_WARMUP",
-              "TPUCFN_BENCH_OVERLAP", "TPUCFN_BENCH_REMAT"):
+              "TPUCFN_BENCH_OVERLAP", "TPUCFN_BENCH_REMAT",
+              "TPUCFN_BENCH_OPT"):
         os.environ.pop(k, None)
 
     # ---- phase 3+: flash attention vs XLA dense (Pallas: riskier) -----
@@ -341,11 +361,11 @@ def main() -> int:
     except OSError as e:
         log(f"tune table copy failed: {e!r}")
 
-    if not llama_ok:
-        # Flash/tune results above are checkpointed; retrying costs only
-        # the llama phases. rc 45 keeps the supervisor looping so a
-        # memory fix landing in the worker mid-session gets its shot.
-        log("megabench complete EXCEPT llama (rc 45; supervisor retries)")
+    if not (llama_ok and extras_ok):
+        # Completed results above are checkpointed; retrying costs only
+        # the failed model phases. rc 45 keeps the supervisor looping so
+        # a memory fix landing in the worker mid-session gets its shot.
+        log("megabench complete EXCEPT a model phase (rc 45; retries)")
         wd.cancel()
         return 45
     log("megabench complete")

@@ -1,5 +1,9 @@
 from tpucfn.data.records import RecordShardWriter, read_record_shard, write_dataset_shards  # noqa: F401
-from tpucfn.data.pipeline import ShardedDataset, prefetch_to_mesh  # noqa: F401
+from tpucfn.data.pipeline import (  # noqa: F401
+    MultiProcessLoader,
+    ShardedDataset,
+    prefetch_to_mesh,
+)
 from tpucfn.data.store import (  # noqa: F401
     CliObjectStore,
     LocalStore,

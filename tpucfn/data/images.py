@@ -46,28 +46,39 @@ def encode_jpeg(arr: np.ndarray, quality: int = 90) -> bytes:
     return buf.getvalue()
 
 
-def decode_transform(key: str = "image"):
+class DecodeTransform:
     """Transform: decode ``ex[key]`` if it holds encoded bytes (1-D uint8);
     pass decoded (HWC) examples through untouched, so the same pipeline
-    runs on encoded and pre-decoded datasets."""
+    runs on encoded and pre-decoded datasets.  A class (not a closure) so
+    it pickles into MultiProcessLoader workers."""
 
-    def t(ex: dict, rs) -> dict:
-        img = ex[key]
+    def __init__(self, key: str = "image"):
+        self.key = key
+
+    def __call__(self, ex: dict, rs) -> dict:
+        img = ex[self.key]
         if getattr(img, "ndim", None) == 1:
-            ex = {**ex, key: decode_image(img)}
+            ex = {**ex, self.key: decode_image(img)}
         return ex
 
-    return t
+
+def decode_transform(key: str = "image"):
+    return DecodeTransform(key)
 
 
-def center_crop_resize(out_hw: int, key: str = "image"):
+class CenterCropResize:
     """Eval-path geometry (the standard ImageNet recipe): resize shorter
     side to ``1.14 * out_hw`` then center-crop ``out_hw``.  Nearest-
     neighbor indexing, matching random_resized_crop's host-side-cheap
-    stance."""
+    stance.  A class so it pickles into MultiProcessLoader workers."""
 
-    def t(ex: dict, rs) -> dict:
-        img = ex[key]
+    def __init__(self, out_hw: int, key: str = "image"):
+        self.out_hw = out_hw
+        self.key = key
+
+    def __call__(self, ex: dict, rs) -> dict:
+        img = ex[self.key]
+        out_hw = self.out_hw
         h, w = img.shape[:2]
         short = int(round(out_hw * 1.14))
         if h < w:
@@ -79,6 +90,8 @@ def center_crop_resize(out_hw: int, key: str = "image"):
         img = img[yy][:, xx]
         y0 = (nh - out_hw) // 2
         x0 = (nw - out_hw) // 2
-        return {**ex, key: img[y0:y0 + out_hw, x0:x0 + out_hw]}
+        return {**ex, self.key: img[y0:y0 + out_hw, x0:x0 + out_hw]}
 
-    return t
+
+def center_crop_resize(out_hw: int, key: str = "image"):
+    return CenterCropResize(out_hw, key)

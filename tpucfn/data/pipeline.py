@@ -218,6 +218,145 @@ class ShardedDataset:
             e += 1
 
 
+def _mp_worker_main(out_q, shard_paths, ds_kwargs, worker_index,
+                    num_workers, num_epochs):
+    """MultiProcessLoader worker entry point (module-level so spawn can
+    pickle it by reference).  Owns shard_paths[worker_index::num_workers]
+    via ShardedDataset's process-sharding logic; streams
+    ("batch", dict) items, an ("end", epoch) marker per epoch, and a
+    final ("done", None) — or ("error", traceback)."""
+    try:
+        ds = ShardedDataset(shard_paths, process_index=worker_index,
+                            process_count=num_workers, **ds_kwargs)
+        e = 0
+        while num_epochs is None or e < num_epochs:
+            for batch in ds.epoch(e):
+                out_q.put(("batch", batch))
+            out_q.put(("end", e))
+            e += 1
+        out_q.put(("done", None))
+    except Exception:  # noqa: BLE001 — surface the traceback to the parent
+        import traceback
+
+        out_q.put(("error", traceback.format_exc()))
+
+
+class MultiProcessLoader:
+    """Decode across worker PROCESSES — the answer when one Python
+    process cannot feed the chips (measured: a single PIL decode core
+    delivers ~550 img/s against a v5e consuming 2524; threads don't
+    help, the decode path is GIL/core-bound).  The process analogue of
+    the reference's MXNet DataIter decode threads (SURVEY.md §3.2), in
+    the shape of a PyTorch DataLoader:
+
+    * this host's shards are sharded again across ``num_workers`` spawn
+      processes (worker w owns ``local_shards[w::W]`` with its own
+      deterministic shuffle/augmentation stream);
+    * each worker streams finished host batches through a bounded queue
+      (so memory is ``num_workers * prefetch`` batches);
+    * the parent interleaves workers round-robin in a fixed order, so
+      the global batch sequence is deterministic for a given
+      (seed, num_workers) — like torch, the sequence differs between
+      worker counts, never between runs.
+
+    Workers never touch jax devices (pure numpy/PIL), so spawn is safe
+    next to an initialized TPU client.  User scripts need the standard
+    ``if __name__ == "__main__"`` guard (spawn re-imports __main__).
+    Pair with :func:`prefetch_to_mesh` for the host→device overlap leg.
+    """
+
+    def __init__(
+        self,
+        shard_paths: Sequence[str | Path],
+        *,
+        num_workers: int,
+        batch_size_per_process: int,
+        seed: int = 0,
+        prefetch: int = 4,
+        process_index: int | None = None,
+        process_count: int | None = None,
+        **ds_kwargs,
+    ):
+        if num_workers < 1:
+            raise ValueError(f"num_workers must be >= 1, got {num_workers}")
+        pi = jax.process_index() if process_index is None else process_index
+        pc = jax.process_count() if process_count is None else process_count
+        local = sorted(str(p) for p in shard_paths)[pi::pc]
+        if len(local) < num_workers:
+            raise ValueError(
+                f"process {pi} owns {len(local)} shards < num_workers="
+                f"{num_workers} — stage more shards or fewer workers")
+        self.local_shards = local
+        self.num_workers = num_workers
+        self.prefetch = prefetch
+        # Offset the seed per host process so worker w here and worker w
+        # on another host draw different augmentation streams.
+        self.ds_kwargs = dict(ds_kwargs, seed=seed + 100003 * pi,
+                              batch_size_per_process=batch_size_per_process)
+        self._procs: list = []
+        self._queues: list = []
+
+    def _start(self, num_epochs):
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")
+        self.close()
+        self._procs, self._queues = [], []
+        for w in range(self.num_workers):
+            q = ctx.Queue(maxsize=self.prefetch)
+            p = ctx.Process(
+                target=_mp_worker_main,
+                args=(q, self.local_shards, self.ds_kwargs, w,
+                      self.num_workers, num_epochs),
+                daemon=True, name=f"tpucfn-loader-{w}")
+            p.start()
+            self._procs.append(p)
+            self._queues.append(q)
+
+    def close(self) -> None:
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+        for p in self._procs:
+            p.join(timeout=5)
+        self._procs, self._queues = [], []
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def batches(self, num_epochs: int | None = None
+                ) -> Iterator[dict[str, np.ndarray]]:
+        """Round-robin-merged batch stream across workers; epochs stay in
+        lockstep (a worker that finished epoch e is skipped until every
+        worker has)."""
+        self._start(num_epochs)
+        w_count = self.num_workers
+        done = [False] * w_count
+        epoch_ended = [False] * w_count
+        try:
+            while not all(done):
+                for w in range(w_count):
+                    if done[w] or epoch_ended[w]:
+                        continue
+                    tag, payload = self._queues[w].get()
+                    if tag == "batch":
+                        yield payload
+                    elif tag == "end":
+                        epoch_ended[w] = True
+                    elif tag == "done":
+                        done[w] = True
+                    else:
+                        raise RuntimeError(
+                            f"loader worker {w} failed:\n{payload}")
+                if all(e or d for e, d in zip(epoch_ended, done)):
+                    epoch_ended = [False] * w_count
+        finally:
+            self.close()
+
+
 def prefetch_to_mesh(
     it: Iterator[dict[str, np.ndarray]],
     mesh,

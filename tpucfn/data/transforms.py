@@ -12,11 +12,16 @@ All transforms take and return example dicts; compose with ``Compose``.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Callable, Sequence
 
 import numpy as np
 
 Transform = Callable[[dict, np.random.RandomState], dict]
+
+# Transforms are module-level classes (factory functions below keep the
+# call-site API) so they PICKLE — the spawn-based MultiProcessLoader
+# ships them to worker processes.
 
 
 class Compose:
@@ -29,42 +34,59 @@ class Compose:
         return ex
 
 
-def random_flip(key: str = "image") -> Transform:
-    def t(ex, rs):
+@dataclasses.dataclass
+class RandomFlip:
+    key: str = "image"
+
+    def __call__(self, ex, rs):
         if rs.rand() < 0.5:
-            ex = {**ex, key: ex[key][:, ::-1]}
+            ex = {**ex, self.key: ex[self.key][:, ::-1]}
         return ex
 
-    return t
+
+def random_flip(key: str = "image") -> Transform:
+    return RandomFlip(key)
 
 
-def random_crop(padding: int = 4, key: str = "image") -> Transform:
+@dataclasses.dataclass
+class RandomCrop:
     """Pad-and-crop (the CIFAR recipe): reflect-pad then take a random
     window of the original size."""
 
-    def t(ex, rs):
-        img = ex[key]
+    padding: int = 4
+    key: str = "image"
+
+    def __call__(self, ex, rs):
+        img = ex[self.key]
+        pad = self.padding
         h, w = img.shape[:2]
-        padded = np.pad(img, ((padding, padding), (padding, padding), (0, 0)),
+        padded = np.pad(img, ((pad, pad), (pad, pad), (0, 0)),
                         mode="reflect")
-        y = rs.randint(0, 2 * padding + 1)
-        x = rs.randint(0, 2 * padding + 1)
-        return {**ex, key: padded[y:y + h, x:x + w]}
-
-    return t
+        y = rs.randint(0, 2 * pad + 1)
+        x = rs.randint(0, 2 * pad + 1)
+        return {**ex, self.key: padded[y:y + h, x:x + w]}
 
 
-def random_resized_crop(out_hw: int, *, min_area: float = 0.08,
-                        key: str = "image") -> Transform:
+def random_crop(padding: int = 4, key: str = "image") -> Transform:
+    return RandomCrop(padding, key)
+
+
+@dataclasses.dataclass
+class RandomResizedCrop:
     """Inception-style crop (the ImageNet ResNet-50 recipe): random area/
     aspect window, resized to ``out_hw`` (nearest-neighbor — host-side
     cheap; bilinear differences wash out under training noise)."""
 
-    def t(ex, rs):
-        img = ex[key]
+    out_hw: int
+    min_area: float = 0.08
+    key: str = "image"
+
+    def __call__(self, ex, rs):
+        img = ex[self.key]
+        out_hw = self.out_hw
         h, w = img.shape[:2]
         for _ in range(10):
-            area = rs.uniform(min_area, 1.0) * h * w
+            area = rs.uniform(self.min_area, 1.0) * h * w
             aspect = np.exp(rs.uniform(np.log(3 / 4), np.log(4 / 3)))
             ch = int(round(np.sqrt(area / aspect)))
             cw = int(round(np.sqrt(area * aspect)))
@@ -79,20 +101,29 @@ def random_resized_crop(out_hw: int, *, min_area: float = 0.08,
                        (w - side) // 2:(w + side) // 2]
         yy = (np.arange(out_hw) * crop.shape[0] / out_hw).astype(np.int64)
         xx = (np.arange(out_hw) * crop.shape[1] / out_hw).astype(np.int64)
-        return {**ex, key: crop[yy][:, xx]}
+        return {**ex, self.key: crop[yy][:, xx]}
 
-    return t
+
+def random_resized_crop(out_hw: int, *, min_area: float = 0.08,
+                        key: str = "image") -> Transform:
+    return RandomResizedCrop(out_hw, min_area, key)
+
+
+@dataclasses.dataclass
+class Normalize:
+    mean: tuple
+    std: tuple
+    key: str = "image"
+
+    def __call__(self, ex, rs):
+        m = np.asarray(self.mean, np.float32)
+        s = np.asarray(self.std, np.float32)
+        return {**ex, self.key: (ex[self.key].astype(np.float32) - m) / s}
 
 
 def normalize(mean: Sequence[float], std: Sequence[float],
               key: str = "image") -> Transform:
-    m = np.asarray(mean, np.float32)
-    s = np.asarray(std, np.float32)
-
-    def t(ex, rs):
-        return {**ex, key: (ex[key].astype(np.float32) - m) / s}
-
-    return t
+    return Normalize(tuple(mean), tuple(std), key)
 
 
 CIFAR_TRAIN = Compose([random_crop(4), random_flip()])
