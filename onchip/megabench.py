@@ -337,6 +337,94 @@ def main() -> int:
     flash("flash_s4k_tuned", ["--seqs", "4096"])
     flash("flash_s8k_tuned", ["--seqs", "8192"])
 
+    # Non-causal flash at UNet shapes (D=40, S=4096): correctness vs
+    # dense ON CHIP (interpret-mode passed; Mosaic lowering at a
+    # non-lane-multiple head dim is the open question) + timing. Gates
+    # the UNet full_attention_auto dispatch.
+    def flash_full_phase(phase):
+        if phase in state["done"]:
+            return
+        log(f"phase {phase}")
+        try:
+            import time as _t
+
+            import jax
+            import jax.numpy as jnp
+
+            from tpucfn.kernels.flash_attention import flash_attention
+            from tpucfn.ops.attention import dot_product_attention
+
+            kq, kk, kv2 = jax.random.split(jax.random.key(0), 3)
+            q = jax.random.normal(kq, (4, 4096, 8, 40), jnp.bfloat16)
+            k = jax.random.normal(kk, (4, 4096, 8, 40), jnp.bfloat16)
+            v = jax.random.normal(kv2, (4, 4096, 8, 40), jnp.bfloat16)
+
+            def timed(fn):
+                jax.block_until_ready(fn(q, k, v))
+                t0 = _t.perf_counter()
+                for _ in range(5):
+                    o = fn(q, k, v)
+                jax.block_until_ready(o)
+                return round((_t.perf_counter() - t0) / 5 * 1e3, 3)
+
+            f = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=False))
+            d = jax.jit(lambda q, k, v: dot_product_attention(
+                q, k, v, causal=False))
+            err = float(jnp.max(jnp.abs(
+                f(q, k, v).astype(jnp.float32) -
+                d(q, k, v).astype(jnp.float32))))
+            record(phase, {"flash_ms": timed(f), "dense_ms": timed(d),
+                           "max_abs_diff": err,
+                           "shape": "B4 S4096 H8 D40 bf16 full"})
+        except Exception as e:  # noqa: BLE001
+            log(f"{phase} FAILED: {e!r}")
+            record(phase, {"error": repr(e)})
+        mark_done(state, phase)
+
+    flash_full_phase("flash_full_unet_shape")
+
+    # UNet re-runs with the flash spatial-attention dispatch (new code
+    # names => fresh phases): b4 comparable to unet_full_b4's dense
+    # 14.09 lat/s; b8 previously OOMed dense.
+    for phase, b in (("unet_b4_flash", "4"), ("unet_b8_flash", "8")):
+        if not xla_phase(phase, {
+                "TPUCFN_BENCH_MODEL": "unet", "TPUCFN_BENCH_BATCH": b,
+                "TPUCFN_BENCH_OPT": "adafactor"}, critical=False):
+            return 44
+    for k in ("TPUCFN_BENCH_MODEL", "TPUCFN_BENCH_BATCH",
+              "TPUCFN_BENCH_OPT"):
+        os.environ.pop(k, None)
+
+    # Tune the non-causal D=40 family (the UNet dispatch measured
+    # SLOWER than dense at default 128/128 blocks: 10.47 vs 14.09
+    # lat/s at b4 — the backward is untuned), then re-measure.
+    def tune_full_phase(phase, s, d, iters=5):
+        if phase in state["done"]:
+            return
+        log(f"phase {phase}")
+        try:
+            import jax.numpy as jnp
+
+            from tpucfn.kernels import flash_autotune
+
+            res = flash_autotune.tune(s, d, heads=8, kv_heads=8, batch=4,
+                                      dtype=jnp.bfloat16, causal=False,
+                                      iters=iters)
+            record(phase, res)
+        except Exception as e:  # noqa: BLE001
+            log(f"{phase} FAILED: {e!r}")
+            record(phase, {"error": repr(e)})
+        mark_done(state, phase)
+
+    tune_full_phase("tune_full_s4k_d40", 4096, 40)
+    if not xla_phase("unet_b4_flash_tuned", {
+            "TPUCFN_BENCH_MODEL": "unet", "TPUCFN_BENCH_BATCH": "4",
+            "TPUCFN_BENCH_OPT": "adafactor"}, critical=False):
+        return 44
+    for k in ("TPUCFN_BENCH_MODEL", "TPUCFN_BENCH_BATCH",
+              "TPUCFN_BENCH_OPT"):
+        os.environ.pop(k, None)
+
     # Quiet-host re-run of the loader-overlap leg: the first capture ran
     # while two pytest suites hogged the host cores, which pollutes the
     # host-side decode measurement (the device-bound step times do not

@@ -271,3 +271,18 @@ def test_gqa_with_segments_combined_gradients():
     for a, bb, name in zip(gf, gd, "qkv"):
         np.testing.assert_allclose(np.asarray(a), np.asarray(bb), atol=3e-4,
                                    err_msg=f"d{name} mismatch")
+
+
+def test_flash_noncausal_unet_shapes():
+    """The UNet dispatch shapes: non-causal, D=40/160 (non-lane-multiple
+    head dims), and 77-key cross attention — all must match dense."""
+    rs = np.random.RandomState(0)
+    for d, s_kv in [(40, None), (40, 77), (160, 77)]:
+        q = jnp.asarray(rs.randn(2, 256, 8, d), jnp.float32)
+        kv_s = 256 if s_kv is None else s_kv
+        k = jnp.asarray(rs.randn(2, kv_s, 8, d), jnp.float32)
+        v = jnp.asarray(rs.randn(2, kv_s, 8, d), jnp.float32)
+        o = flash_attention(q, k, v, causal=False)
+        ref = dot_product_attention(q, k, v, causal=False)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                                   atol=2e-6, err_msg=f"d={d} s_kv={s_kv}")

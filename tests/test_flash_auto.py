@@ -154,3 +154,41 @@ def test_builtin_tune_table_layering(tmp_path, monkeypatch):
     (tmp_path / "user.json").write_text(json.dumps({key: [128, 128]}))
     monkeypatch.setattr(fa, "_MEM_CACHE", None)
     assert fa._load()[key] == (128, 128)
+
+
+def test_full_attention_auto_dispatch_policy(monkeypatch):
+    """Non-causal dispatch: flash only when BOTH sides clear the
+    threshold (spatial self-attention yes, 77-key cross attention no)."""
+    import jax.numpy as jnp
+
+    calls = []
+    monkeypatch.setattr(auto_mod, "_backend", lambda: "tpu")
+    monkeypatch.setenv("TPUCFN_FLASH_MIN_S", "2048")
+
+    import importlib
+
+    # the package re-exports flash_attention (the function) over the
+    # submodule attribute — resolve the module through importlib
+    fa = importlib.import_module("tpucfn.kernels.flash_attention")
+
+    def spy_flash(q, k, v, **kw):
+        calls.append(("flash", q.shape[1], k.shape[1]))
+        return jnp.zeros(q.shape, q.dtype)
+
+    dense_mod = importlib.import_module("tpucfn.ops.attention")
+
+    def spy_dense(q, k, v, **kw):
+        calls.append(("dense", q.shape[1], k.shape[1]))
+        return jnp.zeros(q.shape, q.dtype)
+
+    monkeypatch.setattr(fa, "flash_attention", spy_flash)
+    monkeypatch.setattr(dense_mod, "dot_product_attention", spy_dense)
+
+    q4k = jnp.zeros((1, 4096, 8, 40))
+    ctx = jnp.zeros((1, 77, 8, 40))
+    q1k = jnp.zeros((1, 1024, 8, 40))
+    auto_mod.full_attention_auto(q4k, q4k, q4k)       # long self -> flash
+    auto_mod.full_attention_auto(q4k, ctx, ctx)       # 77-key cross -> dense
+    auto_mod.full_attention_auto(q1k, q1k, q1k)       # short self -> dense
+    assert calls == [("flash", 4096, 4096), ("dense", 4096, 77),
+                     ("dense", 1024, 1024)]

@@ -53,6 +53,30 @@ def should_use_flash(s: int, *, causal: bool = True, mask=None) -> bool:
     return _backend() == "tpu" and int(s) >= flash_threshold()
 
 
+def should_use_flash_full(s_q: int, s_kv: int, *, mask=None) -> bool:
+    """Non-causal (full) attention policy: the dense path materializes a
+    (B, H, s_q, s_kv) score tensor, so flash pays when BOTH sides are
+    long (a 77-key cross-attention's scores are tiny — dense wins).
+    Observed on chip: SD-UNet's 64x64 spatial self-attention (s=4096)
+    OOMs dense at batch 8 via 4G fp32 score temps."""
+    if mask is not None:
+        return False
+    t = flash_threshold()
+    return _backend() == "tpu" and int(s_q) >= t and int(s_kv) >= t
+
+
+def full_attention_auto(q, k, v, *, mask=None):
+    """Dense↔flash dispatch for non-causal attention call sites (UNet
+    spatial/cross attention). Layout (B, S, H, D) like every AttentionFn."""
+    if should_use_flash_full(q.shape[1], k.shape[1], mask=mask):
+        from tpucfn.kernels.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=False)
+    from tpucfn.ops.attention import dot_product_attention
+
+    return dot_product_attention(q, k, v, causal=False, mask=mask)
+
+
 def auto_attention_static_zero(q, k, v, *, causal=True, mask=None,
                                q_offset=0, k_offset=0):
     """AttentionFn for call sites whose offsets are STATICALLY zero but

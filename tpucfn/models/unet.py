@@ -24,7 +24,6 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from tpucfn.ops.attention import dot_product_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -115,10 +114,17 @@ class SpatialTransformer(nn.Module):
         )
 
         def attn(q_in, kv_in, name):
+            from tpucfn.kernels.auto import full_attention_auto
+
             q = dense(c, f"{name}/q_proj")(q_in).reshape(b, -1, cfg.n_heads, head_dim)
             k = dense(c, f"{name}/k_proj")(kv_in).reshape(b, -1, cfg.n_heads, head_dim)
             v = dense(c, f"{name}/v_proj")(kv_in).reshape(b, -1, cfg.n_heads, head_dim)
-            o = dot_product_attention(q, k, v, causal=False)
+            # Spatial self-attention at 64x64 is S=4096 both sides — the
+            # auto dispatcher routes it through the flash kernel on TPU
+            # (dense materializes 4G fp32 score temps per layer, the
+            # measured batch-8 OOM); the 77-key cross-attention and the
+            # short inner stages stay dense.
+            o = full_attention_auto(q, k, v)
             return dense(c, f"{name}/o_proj")(o.reshape(b, -1, c))
 
         ln = lambda name: nn.LayerNorm(  # noqa: E731
