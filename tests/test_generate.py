@@ -134,3 +134,49 @@ def test_generate_temperature_sampling_runs():
                    temperature=1.0, rng=jax.random.key(7))
     assert out.shape == (1, 8)
     assert int(out.max()) < cfg.vocab_size
+
+
+def test_filter_logits_top_k_and_top_p():
+    import pytest
+
+    from tpucfn.models.generate import _filter_logits
+
+    logits = jnp.asarray([[3.0, 2.0, 1.0, 0.0, -1.0]])
+    neg = jnp.finfo(jnp.float32).min
+
+    k2 = _filter_logits(logits, 2, None)
+    assert (np.asarray(k2[0, :2]) == np.asarray(logits[0, :2])).all()
+    assert (np.asarray(k2[0, 2:]) == neg).all()
+
+    # probs ~ [0.64, 0.23, 0.086, 0.032, 0.012]: top_p=0.7 keeps the
+    # smallest prefix reaching 0.7 -> first two tokens
+    p = _filter_logits(logits, None, 0.7)
+    assert (np.asarray(p[0, :2]) == np.asarray(logits[0, :2])).all()
+    assert (np.asarray(p[0, 2:]) == neg).all()
+
+    # top_p=1.0 keeps everything
+    all_kept = _filter_logits(logits, None, 1.0)
+    np.testing.assert_array_equal(np.asarray(all_kept), np.asarray(logits))
+
+    # composed: top_k=3 then top_p over the survivors
+    both = _filter_logits(logits, 3, 0.95)
+    assert float(both[0, 4]) == neg
+
+    with pytest.raises(ValueError, match="top_k"):
+        _filter_logits(logits, 0, None)
+    with pytest.raises(ValueError, match="top_p"):
+        _filter_logits(logits, None, 0.0)
+
+
+def test_generate_top_k_one_is_greedy():
+    """top_k=1 sampling at any temperature must equal greedy decoding."""
+    from tpucfn.models.generate import generate
+
+    cfg = LlamaConfig.tiny()
+    prompt = jnp.asarray(np.random.RandomState(0).randint(0, cfg.vocab_size,
+                                                          (2, 5)), jnp.int32)
+    params = Llama(cfg).init(jax.random.key(0), prompt)["params"]
+    greedy = generate(cfg, params, prompt, max_new_tokens=6, temperature=0.0)
+    k1 = generate(cfg, params, prompt, max_new_tokens=6, temperature=1.3,
+                  top_k=1, rng=jax.random.key(7))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))

@@ -21,6 +21,31 @@ import jax.numpy as jnp
 from tpucfn.models.llama import Llama, LlamaConfig
 
 
+def _filter_logits(logits: jax.Array, top_k: int | None,
+                   top_p: float | None) -> jax.Array:
+    """Mask logits outside the top-k set and/or the top-p (nucleus)
+    mass to -inf. (B, V) -> (B, V)."""
+    neg = jnp.finfo(logits.dtype).min
+    if top_k is not None:
+        if top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, neg, logits)
+    if top_p is not None:
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep the smallest prefix with mass >= top_p (the first token
+        # is always kept: cum - probs < top_p holds at position 0).
+        keep_sorted = (cum - probs) < top_p
+        cutoff = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
+                         axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, neg, logits)
+    return logits
+
+
 def generate(
     cfg: LlamaConfig,
     params,
@@ -28,10 +53,16 @@ def generate(
     *,
     max_new_tokens: int,
     temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
     rng: jax.Array | None = None,
     cache_len: int | None = None,
 ) -> jax.Array:
-    """Returns (B, T + max_new_tokens) tokens (prompt included)."""
+    """Returns (B, T + max_new_tokens) tokens (prompt included).
+
+    ``temperature=0`` is greedy; otherwise categorical sampling over
+    logits/temperature, optionally restricted to the ``top_k`` highest
+    logits and/or the ``top_p`` nucleus mass (both composable)."""
     b, t = prompt.shape
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
@@ -62,7 +93,8 @@ def generate(
     def sample(logits_last, key):
         if temperature <= 0.0:
             return jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(key, logits_last / temperature, axis=-1).astype(
+        filtered = _filter_logits(logits_last, top_k, top_p)
+        return jax.random.categorical(key, filtered / temperature, axis=-1).astype(
             jnp.int32
         )
 
