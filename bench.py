@@ -472,6 +472,62 @@ def _worker_llama(tiny: bool) -> int:
     return 0
 
 
+def _worker_llama_decode(tiny: bool) -> int:
+    """Serving-side number (net-new vs the training-only reference):
+    KV-cache autoregressive decode tokens/sec/chip for the Llama-1B
+    proxy.  Times the jitted end-to-end generate() (prefill + N decode
+    steps); the per-token decode rate dominates at N >> prompt."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpucfn.models.generate import generate
+    from tpucfn.models.llama import LlamaConfig
+
+    cfg = LlamaConfig.tiny() if tiny else LlamaConfig.llama3_1b()
+    prompt_len = 16 if tiny else 128
+    max_new = 16 if tiny else 128
+    batch = int(os.environ.get("TPUCFN_BENCH_BATCH", 2 if tiny else 8))
+
+    from tpucfn.models.llama import Llama
+
+    rs = np.random.RandomState(0)
+    prompt = jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, prompt_len)),
+                         jnp.int32)
+    params = Llama(cfg).init(jax.random.key(0), prompt)["params"]
+
+    gen = jax.jit(lambda p, t: generate(
+        cfg, p, t, max_new_tokens=max_new, temperature=0.0))
+    t0 = _time.perf_counter()
+    out = gen(params, prompt)
+    jax.block_until_ready(out)
+    compile_s = _time.perf_counter() - t0
+
+    iters = 2 if tiny else 3
+    t0 = _time.perf_counter()
+    for _ in range(iters):
+        out = gen(params, prompt)
+    jax.block_until_ready(out)
+    elapsed = (_time.perf_counter() - t0) / iters
+
+    dev = jax.devices()[0]
+    toks_s = batch * max_new / elapsed
+    print(json.dumps({
+        "metric": ("llama3_1b_decode_tokens_per_sec_per_chip" if not tiny
+                   else "tiny_llama_decode_tokens_per_sec_per_chip"),
+        "value": round(toks_s, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 0.0,
+        "detail": {"batch": batch, "prompt_len": prompt_len,
+                   "max_new_tokens": max_new, "compile_s": round(compile_s, 2),
+                   "gen_s": round(elapsed, 3),
+                   "platform": dev.platform, "device_kind": dev.device_kind},
+    }))
+    return 0
+
+
 def _worker_bert(tiny: bool) -> int:
     """BASELINE config 3 (BERT-base pretrain, the Horovod->JAX launcher
     path): MLM training tokens/sec/chip + MFU (cost analysis is exact
@@ -632,6 +688,8 @@ def worker() -> int:
     which = os.environ.get("TPUCFN_BENCH_MODEL", "resnet")
     if which == "llama":
         return _worker_llama(tiny)
+    if which == "llama-decode":
+        return _worker_llama_decode(tiny)
     if which == "bert":
         return _worker_bert(tiny)
     if which == "unet":

@@ -421,6 +421,12 @@ def main() -> int:
             "TPUCFN_BENCH_MODEL": "unet", "TPUCFN_BENCH_BATCH": "4",
             "TPUCFN_BENCH_OPT": "adafactor"}, critical=False):
         return 44
+    # Serving-side: KV-cache decode tokens/sec (net-new vs the
+    # training-only reference).
+    if not xla_phase("llama_decode", {
+            "TPUCFN_BENCH_MODEL": "llama-decode",
+            "TPUCFN_BENCH_BATCH": None}, critical=False):
+        return 44
     for k in ("TPUCFN_BENCH_MODEL", "TPUCFN_BENCH_BATCH",
               "TPUCFN_BENCH_OPT"):
         os.environ.pop(k, None)
