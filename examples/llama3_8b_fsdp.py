@@ -60,6 +60,11 @@ def main() -> int:
                    help="expert (MoE) mesh axis size")
     p.add_argument("--num-examples", type=int, default=256)
     p.add_argument("--z-loss", type=float, default=1e-4)
+    p.add_argument("--lora-rank", type=int, default=0,
+                   help="finetune rank-r LoRA adapters on the attention/"
+                        "MLP kernels instead of full weights (base stays "
+                        "frozen; optimizer state shrinks to the adapters). "
+                        "0 = full finetune")
     p.add_argument("--ce-chunk", type=int, default=512,
                    help="compute the LM-head CE over sequence chunks of "
                         "this size so the fp32 (B,S,vocab) logits are "
@@ -203,6 +208,28 @@ def main() -> int:
             logits, aux = forward(params, batch["tokens"])
             loss, acc = causal_lm_loss(logits, batch["tokens"], z_loss=args.z_loss)
             return loss + aux, ({"accuracy": acc}, mstate)
+
+    if args.lora_rank:
+        # Orthogonal wrapper over whichever loss branch was picked: the
+        # trainable tree becomes the adapters, the frozen base rides in
+        # model_state (where the llama sharding rules still path-match
+        # it, so FSDP/TP shard the base exactly as in full finetuning).
+        if args.pipeline > 1:
+            raise SystemExit("--lora-rank does not compose with "
+                             "--pipeline yet; run LoRA without PP")
+        from tpucfn.train import lora_init, lora_materialize
+
+        plain_init, plain_loss = init_fn, loss_fn
+
+        def init_fn(rng):
+            k1, k2 = jax.random.split(rng)
+            base, _ = plain_init(k1)
+            return lora_init(base, k2, rank=args.lora_rank), {"base": base}
+
+        def loss_fn(ad, mstate, batch, rng):
+            merged = lora_materialize(mstate["base"], ad)
+            loss, (aux, _) = plain_loss(merged, {}, batch, rng)
+            return loss, (aux, mstate)
 
     total = args.steps or 1000
     tx = optax.chain(

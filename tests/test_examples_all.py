@@ -103,3 +103,10 @@ def test_llama_moe_dense_path_example(tmp_path):
     _ok(_run("llama3_8b_fsdp.py", tmp_path, "--model", "tiny", "--seq-len",
              "32", "--batch-size", "16", "--num-examples", "64",
              "--moe-experts", "4", "--expert", "2"))
+
+
+def test_llama_lora_example(tmp_path):
+    """--lora-rank trains adapters over a frozen FSDP-sharded base."""
+    _ok(_run("llama3_8b_fsdp.py", tmp_path, "--model", "tiny",
+             "--seq-len", "32", "--batch-size", "8", "--fsdp", "2",
+             "--lora-rank", "4"))

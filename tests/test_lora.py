@@ -1,0 +1,110 @@
+"""LoRA adapters: functional delta-param finetuning over any model."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tpucfn.mesh import MeshSpec, build_mesh
+from tpucfn.models.llama import Llama, LlamaConfig, causal_lm_loss
+from tpucfn.parallel import shard_batch
+from tpucfn.train import Trainer, lora_init, lora_materialize, lora_sharding_rules
+
+
+def _setup():
+    cfg = LlamaConfig.tiny()
+    toks = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (4, 16)), jnp.int32)
+    params = Llama(cfg).init(jax.random.key(0), toks)["params"]
+    return cfg, toks, params
+
+
+def test_lora_init_shapes_and_identity_start():
+    cfg, toks, params = _setup()
+    adapters = lora_init(params, jax.random.key(1), rank=4)
+    # scanned llama kernels carry a leading layer dim -> per-layer factors
+    qk = adapters["layers/attn/q_proj/kernel"]
+    assert qk["a"].shape == (cfg.n_layers, cfg.dim, 4)
+    assert qk["b"].shape == (cfg.n_layers, 4, cfg.dim)
+    # B starts at zero: the adapted model IS the base model
+    merged = lora_materialize(params, adapters)
+    ref = Llama(cfg).apply({"params": params}, toks)
+    out = Llama(cfg).apply({"params": merged}, toks)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_lora_materialize_applies_delta():
+    _, _, params = _setup()
+    adapters = lora_init(params, jax.random.key(1), rank=2,
+                         pattern=r"q_proj/kernel$")
+    adapters["layers/attn/q_proj/kernel"]["b"] = jnp.ones_like(
+        adapters["layers/attn/q_proj/kernel"]["b"])
+    merged = lora_materialize(params, adapters, scale=0.5)
+    a = adapters["layers/attn/q_proj/kernel"]["a"]
+    b = adapters["layers/attn/q_proj/kernel"]["b"]
+    want = params["layers"]["attn"]["q_proj"]["kernel"] + 0.5 * jnp.einsum(
+        "lir,lro->lio", a, b)
+    np.testing.assert_allclose(
+        np.asarray(merged["layers"]["attn"]["q_proj"]["kernel"]),
+        np.asarray(want), rtol=1e-6)
+    # untargeted leaves pass through untouched
+    np.testing.assert_array_equal(
+        np.asarray(merged["embed_tokens"]["embedding"]),
+        np.asarray(params["embed_tokens"]["embedding"]))
+
+
+def test_lora_grads_flow_only_to_adapters():
+    cfg, toks, params = _setup()
+    adapters = lora_init(params, jax.random.key(1), rank=4)
+
+    def loss_fn(ad):
+        merged = lora_materialize(params, ad)
+        return causal_lm_loss(Llama(cfg).apply({"params": merged}, toks),
+                              toks)[0]
+
+    grads = jax.jit(jax.grad(loss_fn))(adapters)
+    # At init B=0, so dL/dA (∝ B) is zero — B is where gradient lands.
+    gb = np.asarray(grads["layers/attn/q_proj/kernel"]["b"])
+    assert np.abs(gb).max() > 0  # adapters get gradient
+    # and the base stays untouched by construction (stop_gradient) —
+    # differentiating w.r.t. base through the merged tree yields zeros
+    gbase = jax.jit(jax.grad(lambda p: causal_lm_loss(
+        Llama(cfg).apply({"params": lora_materialize(p, adapters)}, toks),
+        toks)[0]))(params)
+    assert float(np.abs(np.asarray(
+        gbase["layers"]["attn"]["q_proj"]["kernel"])).max()) == 0.0
+
+
+def test_lora_training_learns_under_trainer():
+    cfg, toks, params = _setup()
+    mesh = build_mesh(MeshSpec(data=8))
+
+    def init_fn(rng):
+        return lora_init(params, rng, rank=8), {}
+
+    def loss_fn(ad, mstate, batch, rng):
+        merged = lora_materialize(params, ad)
+        loss, acc = causal_lm_loss(
+            Llama(cfg).apply({"params": merged}, batch["tokens"]),
+            batch["tokens"])
+        return loss, ({"accuracy": acc}, mstate)
+
+    trainer = Trainer(mesh, lora_sharding_rules(), loss_fn,
+                      optax.adamw(5e-3), init_fn)
+    state = trainer.init(jax.random.key(0))
+    batch = shard_batch(mesh, {"tokens": np.asarray(
+        jnp.tile(toks, (2, 1)))})
+    first = None
+    for _ in range(20):
+        state, m = trainer.step(state, batch)
+        first = first if first is not None else float(m["loss"])
+    assert float(m["loss"]) < first * 0.9
+
+
+def test_lora_rejects_bad_inputs():
+    _, _, params = _setup()
+    with pytest.raises(ValueError, match="rank"):
+        lora_init(params, jax.random.key(0), rank=0)
+    with pytest.raises(ValueError, match="pattern"):
+        lora_init(params, jax.random.key(0), pattern=r"nonexistent_xyz$")

@@ -1,2 +1,7 @@
 from tpucfn.train.state import TrainState  # noqa: F401
 from tpucfn.train.trainer import Trainer, TrainerConfig  # noqa: F401
+from tpucfn.train.lora import (  # noqa: F401
+    lora_init,
+    lora_materialize,
+    lora_sharding_rules,
+)
