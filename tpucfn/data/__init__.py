@@ -29,3 +29,8 @@ from tpucfn.data.synthetic import (  # noqa: F401
     synthetic_latents,
     synthetic_tokens,
 )
+from tpucfn.data.packing import (  # noqa: F401
+    pack_sequences,
+    packed_attention_fn,
+    packed_causal_lm_loss,
+)

@@ -1,0 +1,130 @@
+"""Packed-sequence training: many short documents per (B, S) row.
+
+Short-document corpora waste most of a fixed-shape (B, S) batch on
+padding; packing concatenates documents into full rows and uses
+segment ids to keep attention and the LM loss from crossing document
+boundaries.  TPU-first reasoning: XLA wants static shapes, so variable-
+length batching is out — packing is THE static-shape answer (same
+trade the reference's RecordIO batching made, minus the correctness
+bugs of naive concatenation).
+
+Three pieces, composable with everything else in the stack:
+
+* :func:`pack_sequences` — greedy first-fit packing of variable-length
+  token lists into (N, S) ``tokens`` + 1-based ``segments`` (0 = pad).
+* :func:`packed_attention_fn` — AttentionFn that masks cross-segment
+  attention: the Pallas flash kernel's native ``segment_ids`` path on
+  TPU (hardware-layout masking, no (S, S) materialization), an explicit
+  mask on the dense path elsewhere.
+* :func:`packed_causal_lm_loss` — next-token CE only where target and
+  input share a segment (no cross-document prediction, no loss on pad).
+
+Parity is tested against running each document through the model alone.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def pack_sequences(
+    sequences: Sequence[np.ndarray],
+    seq_len: int,
+    *,
+    pad_id: int = 0,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Greedy first-fit packing. Returns (tokens (N, S) int32,
+    segments (N, S) int32 — 1-based per-row document ids, 0 on padding).
+
+    Documents longer than ``seq_len`` raise (chunk upstream — silently
+    truncating data is how eval numbers lie)."""
+    rows: list[list[int]] = []
+    segs: list[list[int]] = []
+    counts: list[int] = []
+    for seq in sequences:
+        seq = np.asarray(seq)
+        if seq.ndim != 1:
+            raise ValueError(f"sequences must be rank-1, got shape {seq.shape}")
+        if len(seq) > seq_len:
+            raise ValueError(
+                f"document of length {len(seq)} exceeds seq_len {seq_len}; "
+                "chunk it upstream")
+        if len(seq) == 0:
+            continue
+        placed = False
+        for i, row in enumerate(rows):
+            if len(row) + len(seq) <= seq_len:
+                counts[i] += 1
+                row.extend(int(t) for t in seq)
+                segs[i].extend([counts[i]] * len(seq))
+                placed = True
+                break
+        if not placed:
+            rows.append([int(t) for t in seq])
+            segs.append([1] * len(seq))
+            counts.append(1)
+    if not rows:
+        raise ValueError("no non-empty sequences to pack")
+    n = len(rows)
+    tokens = np.full((n, seq_len), pad_id, np.int32)
+    segments = np.zeros((n, seq_len), np.int32)
+    for i, (row, seg) in enumerate(zip(rows, segs)):
+        tokens[i, :len(row)] = row
+        segments[i, :len(seg)] = seg
+    return tokens, segments
+
+
+def packed_attention_fn(segments: jax.Array):
+    """AttentionFn masking attention across segment boundaries (and off
+    padding, segment 0).  Flash kernel on TPU above the dispatch
+    threshold — its segment path masks in hardware layout; explicit
+    dense mask elsewhere."""
+    from tpucfn.kernels.auto import should_use_flash
+
+    def att(q, k, v, *, causal=True, mask=None, q_offset=0, k_offset=0):
+        if mask is not None:
+            raise NotImplementedError(
+                "packed attention owns the mask; combine masks upstream")
+        static_offsets = isinstance(q_offset, int) and isinstance(k_offset, int)
+        if (static_offsets and q_offset == 0 and k_offset == 0
+                and should_use_flash(q.shape[1], causal=causal)):
+            from tpucfn.kernels.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, causal=causal,
+                                   segment_ids=segments)
+        from tpucfn.ops.attention import dot_product_attention
+
+        same = (segments[:, None, :, None] == segments[:, None, None, :])
+        valid = (segments > 0)[:, None, :, None]  # pad queries attend nothing
+        return dot_product_attention(q, k, v, causal=causal,
+                                     mask=same & valid,
+                                     q_offset=q_offset, k_offset=k_offset)
+
+    return att
+
+
+def packed_causal_lm_loss(
+    logits: jax.Array,    # (B, S, V)
+    tokens: jax.Array,    # (B, S)
+    segments: jax.Array,  # (B, S)
+    *,
+    z_loss: float = 0.0,
+) -> tuple[jax.Array, jax.Array]:
+    """Next-token CE averaged over positions whose TARGET shares the
+    input's segment (and is not padding). Returns (loss, accuracy)."""
+    import optax
+
+    targets = tokens[:, 1:]
+    pred = logits[:, :-1]
+    valid = (segments[:, 1:] == segments[:, :-1]) & (segments[:, 1:] > 0)
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(pred, targets)
+    if z_loss:
+        per_tok = per_tok + z_loss * jax.nn.logsumexp(pred, axis=-1) ** 2
+    denom = jnp.maximum(valid.sum(), 1)
+    loss = jnp.where(valid, per_tok, 0.0).sum() / denom
+    correct = jnp.where(valid, jnp.argmax(pred, -1) == targets, False)
+    return loss, correct.sum() / denom
