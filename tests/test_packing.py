@@ -86,3 +86,45 @@ def test_packed_causal_lm_loss_masks_boundaries():
     want = (per[0, 0] + per[0, 1] + per[0, 3] + per[0, 4]) / 4
     np.testing.assert_allclose(float(loss), float(want), rtol=1e-6)
     assert 0.0 <= float(acc) <= 1.0
+
+
+def test_convert_token_jsonl_cli_roundtrip(tmp_path):
+    """jsonl corpus -> packed shards via the CLI -> ShardedDataset rows
+    carry aligned tokens/segments."""
+    import json
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    REPO = Path(__file__).resolve().parent.parent
+    rs = np.random.RandomState(0)
+    src = tmp_path / "corpus.jsonl"
+    with src.open("w") as f:
+        for n in (5, 9, 3, 12, 7):
+            f.write(json.dumps({"tokens": rs.randint(1, 100, n).tolist()})
+                    + "\n")
+    out = tmp_path / "shards"
+    import os
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "tpucfn.cli", "convert-dataset",
+         "--kind", "token-jsonl", "--src", str(src), "--out", str(out),
+         "--seq-len", "16", "--num-shards", "2"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+
+    from tpucfn.data.pipeline import ShardedDataset
+
+    ds = ShardedDataset(sorted(out.glob("*.tpurec")),
+                        batch_size_per_process=1, shuffle=False,
+                        process_index=0, process_count=1)
+    rows = list(ds.epoch(0))
+    assert rows and set(rows[0]) == {"tokens", "segments"}
+    for b in rows:
+        toks, segs = b["tokens"][0], b["segments"][0]
+        assert toks.shape == (16,) and segs.shape == (16,)
+        # padding aligns: segment 0 exactly where tokens are pad
+        assert ((segs == 0) == (toks == 0)).all() or (segs > 0).all()

@@ -165,10 +165,18 @@ def cmd_heal(args) -> int:
 def cmd_convert_dataset(args) -> int:
     """Pack a real dataset into tpurecord shards (≈ MXNet's im2rec step
     the reference assumed had already happened off-cluster)."""
-    from tpucfn.data.convert import convert_cifar_binary, convert_image_tree
+    from tpucfn.data.convert import (
+        convert_cifar_binary,
+        convert_image_tree,
+        convert_token_jsonl,
+    )
 
     if args.kind == "image-tree":
         paths = convert_image_tree(args.src, args.out, num_shards=args.num_shards)
+    elif args.kind == "token-jsonl":
+        paths = convert_token_jsonl(args.src, args.out,
+                                    seq_len=args.seq_len,
+                                    num_shards=args.num_shards)
     else:
         paths = convert_cifar_binary(args.src, args.out,
                                      num_shards=args.num_shards,
@@ -255,14 +263,21 @@ def build_parser() -> argparse.ArgumentParser:
     h.add_argument("--name", required=True)
     h.set_defaults(fn=cmd_heal)
 
-    cv = sub.add_parser("convert-dataset",
-                        help="pack an image tree / CIFAR binary into tpurecord shards")
-    cv.add_argument("--kind", choices=["image-tree", "cifar10"], required=True)
-    cv.add_argument("--src", required=True, help="dataset root directory")
+    cv = sub.add_parser(
+        "convert-dataset",
+        help="pack an image tree / CIFAR binary / tokenized jsonl corpus "
+             "into tpurecord shards")
+    cv.add_argument("--kind", choices=["image-tree", "cifar10", "token-jsonl"],
+                    required=True)
+    cv.add_argument("--src", required=True,
+                    help="dataset root directory (or .jsonl file for "
+                         "token-jsonl)")
     cv.add_argument("--out", required=True, help="output shard directory")
     cv.add_argument("--num-shards", type=int, default=16)
     cv.add_argument("--test-split", action="store_true",
                     help="cifar10: convert test_batch.bin instead of train")
+    cv.add_argument("--seq-len", type=int, default=2048,
+                    help="token-jsonl: packed row length")
     cv.add_argument("--publish", metavar="URL",
                     help="also upload shards to gs://, s3://, or file:// URL")
     cv.set_defaults(fn=cmd_convert_dataset)
