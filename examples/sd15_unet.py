@@ -35,6 +35,10 @@ def main() -> int:
     p.add_argument("--latent-size", type=int, default=0,
                    help="latent H=W (default 64 full / 16 tiny)")
     p.add_argument("--num-examples", type=int, default=256)
+    p.add_argument("--ema-decay", type=float, default=0.9999,
+                   help="EMA of the UNet params (the diffusion-finetune "
+                        "standard; tracked in model_state, checkpointed); "
+                        "0 disables")
     args = p.parse_args()
 
     from tpucfn.launch import initialize_runtime
@@ -75,8 +79,11 @@ def main() -> int:
         return loss, ({}, mstate)
 
     tx = optax.adamw(args.lr if args.lr != 0.1 else 1e-5)  # finetune-scale default
+    from tpucfn.train import TrainerConfig
+
     trainer = Trainer(
-        mesh, transformer_rules(tensor=args.tensor > 1), loss_fn, tx, init_fn
+        mesh, transformer_rules(tensor=args.tensor > 1), loss_fn, tx, init_fn,
+        config=TrainerConfig(ema_decay=args.ema_decay),
     )
     ds = ShardedDataset(shards, batch_size_per_process=per_process_batch(args),
                         seed=args.seed)

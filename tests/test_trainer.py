@@ -151,3 +151,44 @@ def test_adafactor_factored_state_shards_and_trains():
         state, m = trainer.step(state, batch)
         first = first if first is not None else float(m["loss"])
     assert float(m["loss"]) < first
+
+
+def test_ema_tracks_params():
+    """ema_decay keeps a post-update moving average under
+    model_state['ema'], sharded/checkpointed with the state."""
+    from tpucfn.mesh import MeshSpec, build_mesh
+    from tpucfn.train import TrainerConfig
+
+    mesh = build_mesh(MeshSpec(data=8))
+    trainer = Trainer(mesh, ShardingRules(((r".*", P()),)), _mlp_loss,
+                      optax.sgd(0.05), _mlp_init,
+                      config=TrainerConfig(ema_decay=0.9))
+    state = trainer.init(jax.random.key(0))
+    np.testing.assert_array_equal(
+        np.asarray(state.model_state["ema"]["fc1"]["kernel"]),
+        np.asarray(state.params["fc1"]["kernel"]))
+
+    rs = np.random.RandomState(0)
+    batch = {"x": rs.randn(16, 4).astype(np.float32),
+             "y": rs.randn(16, 1).astype(np.float32)}
+    from tpucfn.parallel import shard_batch as sb
+
+    b = sb(mesh, batch)
+    # the step donates the previous state: snapshot to host numpy first
+    snap = lambda t: jax.tree.map(np.asarray, t)  # noqa: E731
+    ema_prev = snap(state.model_state["ema"])
+    for _ in range(3):
+        p_prev = snap(state.params)
+        state, _ = trainer.step(state, b)
+        want = jax.tree.map(lambda e, p: e * 0.9 + np.asarray(p) * 0.1,
+                            ema_prev, state.params)
+        np.testing.assert_allclose(
+            np.asarray(state.model_state["ema"]["fc1"]["kernel"]),
+            want["fc1"]["kernel"], rtol=1e-6)
+        ema_prev = snap(state.model_state["ema"])
+        # params moved, ema lags
+        assert not np.allclose(np.asarray(state.params["fc1"]["kernel"]),
+                               p_prev["fc1"]["kernel"])
+    assert not np.allclose(
+        np.asarray(state.model_state["ema"]["fc1"]["kernel"]),
+        np.asarray(state.params["fc1"]["kernel"]))

@@ -49,6 +49,11 @@ class TrainerConfig:
     # apply once. Raises the effective batch without raising peak
     # activation memory — the non-pipeline sibling of GPipe microbatching.
     grad_accum: int = 1
+    # Exponential moving average of params (the diffusion-finetune
+    # standard): tracked under model_state["ema"] post-update, so it
+    # shards like the params, checkpoints with the state, and is ready
+    # for eval/export. 0.0 disables.
+    ema_decay: float = 0.0
 
 
 class Trainer:
@@ -109,6 +114,13 @@ class Trainer:
     def _create_state(self, rng: jax.Array) -> TrainState:
         params_rng, step_rng = jax.random.split(rng)
         params, model_state = self.init_fn(params_rng)
+        if self.config.ema_decay:
+            if "ema" in (model_state or {}):
+                raise ValueError(
+                    "model_state already has an 'ema' entry; ema_decay "
+                    "owns that key")
+            model_state = {**(model_state or {}),
+                           "ema": jax.tree.map(jnp.asarray, params)}
         return TrainState.create(params, self.tx, step_rng, model_state)
 
     def _abstract(self) -> Any:
@@ -189,6 +201,13 @@ class Trainer:
         loss, aux, new_model_state, grads = self._grads(state, batch, step_rng)
         updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        if self.config.ema_decay:
+            # Post-update EMA; owns model_state["ema"] (re-attached even
+            # when a loss_fn rebuilds its model_state from scratch).
+            d = self.config.ema_decay
+            new_model_state = {**new_model_state, "ema": jax.tree.map(
+                lambda e, p: e * d + p.astype(e.dtype) * (1.0 - d),
+                state.model_state["ema"], new_params)}
         new_state = TrainState(
             step=state.step + 1,
             params=new_params,
