@@ -60,6 +60,13 @@ def main() -> int:
                    help="expert (MoE) mesh axis size")
     p.add_argument("--num-examples", type=int, default=256)
     p.add_argument("--z-loss", type=float, default=1e-4)
+    p.add_argument("--packed", action="store_true",
+                   help="packed-sequence training: variable-length "
+                        "documents packed into full (S,) rows with "
+                        "segment-masked attention and boundary-safe "
+                        "loss (tpucfn convert-dataset --kind token-jsonl "
+                        "builds such shards; this example synthesizes a "
+                        "corpus). DP/FSDP/TP only")
     p.add_argument("--lora-rank", type=int, default=0,
                    help="finetune rank-r LoRA adapters on the attention/"
                         "MLP kernels instead of full weights (base stays "
@@ -102,11 +109,37 @@ def main() -> int:
         cfg = dataclasses.replace(cfg, moe=MoEConfig(n_experts=args.moe_experts))
 
     run_dir = Path(args.run_dir)
-    shards = stage_synthetic(
-        "tokens", run_dir / "data", n=args.num_examples,
-        num_shards=max(8, jax.process_count()), seed=args.seed,
-        seq_len=args.seq_len, vocab=cfg.vocab_size,
-    )
+    if args.packed:
+        if args.pipeline > 1 or args.context > 1:
+            raise SystemExit("--packed composes with DP/FSDP/TP only "
+                             "(segment ids don't thread through PP/SP yet)")
+        import json as _json
+
+        import numpy as np
+
+        data_dir = run_dir / "data"
+        data_dir.mkdir(parents=True, exist_ok=True)
+        shards = sorted(data_dir.glob("*.tpurec"))
+        if not shards:
+            rs = np.random.RandomState(args.seed)
+            src = data_dir / "corpus.jsonl"
+            with src.open("w") as f:
+                for _ in range(args.num_examples):
+                    n = int(rs.randint(max(2, args.seq_len // 8),
+                                       args.seq_len // 2 + 1))
+                    f.write(_json.dumps(
+                        rs.randint(1, cfg.vocab_size, n).tolist()) + "\n")
+            from tpucfn.data.convert import convert_token_jsonl
+
+            shards = convert_token_jsonl(
+                src, data_dir, seq_len=args.seq_len,
+                num_shards=max(8, jax.process_count()))
+    else:
+        shards = stage_synthetic(
+            "tokens", run_dir / "data", n=args.num_examples,
+            num_shards=max(8, jax.process_count()), seed=args.seed,
+            seq_len=args.seq_len, vocab=cfg.vocab_size,
+        )
 
     n = jax.device_count()
     mesh = build_mesh(MeshSpec.for_devices(
@@ -152,7 +185,26 @@ def main() -> int:
                 return logits, collect_moe_aux(lcl)
             return model.apply({"params": params}, tokens), 0.0
 
-    if args.pipeline > 1 and args.pp_schedule == "1f1b":
+    if args.packed:
+        from tpucfn.data.packing import packed_causal_lm_loss
+
+        def loss_fn(params, mstate, batch, rng):
+            aux = 0.0
+            if cfg.moe is not None:
+                from tpucfn.models.moe import collect_moe_aux
+
+                logits, lcl = model.apply(
+                    {"params": params}, batch["tokens"],
+                    segment_ids=batch["segments"], mutable=["losses"])
+                aux = collect_moe_aux(lcl)
+            else:
+                logits = model.apply({"params": params}, batch["tokens"],
+                                     segment_ids=batch["segments"])
+            loss, acc = packed_causal_lm_loss(
+                logits, batch["tokens"], batch["segments"],
+                z_loss=args.z_loss)
+            return loss + aux, ({"accuracy": acc}, mstate)
+    elif args.pipeline > 1 and args.pp_schedule == "1f1b":
         from tpucfn.models.llama_pp import pipelined_llama_value_and_grad
 
         def loss_fn(params, mstate, batch, rng):

@@ -110,3 +110,11 @@ def test_llama_lora_example(tmp_path):
     _ok(_run("llama3_8b_fsdp.py", tmp_path, "--model", "tiny",
              "--seq-len", "32", "--batch-size", "8", "--fsdp", "2",
              "--lora-rank", "4"))
+
+
+def test_llama_packed_example(tmp_path):
+    """--packed: jsonl corpus -> packed shards -> segment-masked
+    training with boundary-safe loss."""
+    _ok(_run("llama3_8b_fsdp.py", tmp_path, "--model", "tiny",
+             "--seq-len", "32", "--batch-size", "8", "--fsdp", "2",
+             "--packed", "--num-examples", "64"))

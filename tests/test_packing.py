@@ -128,3 +128,23 @@ def test_convert_token_jsonl_cli_roundtrip(tmp_path):
         assert toks.shape == (16,) and segs.shape == (16,)
         # padding aligns: segment 0 exactly where tokens are pad
         assert ((segs == 0) == (toks == 0)).all() or (segs > 0).all()
+
+
+def test_llama_segment_ids_kwarg_isolates_documents():
+    """Model-level packed API: Llama(...).apply(..., segment_ids=segs)."""
+    cfg = LlamaConfig.tiny()
+    rs = np.random.RandomState(2)
+    tokens, segments = pack_sequences(
+        [rs.randint(1, cfg.vocab_size, 5), rs.randint(1, cfg.vocab_size, 6)],
+        seq_len=12)
+    toks, segs = jnp.asarray(tokens), jnp.asarray(segments)
+    model = Llama(cfg)
+    params = model.init(jax.random.key(0), toks)["params"]
+    base = model.apply({"params": params}, toks, segment_ids=segs)
+    toks2 = toks.at[0, 1].set((int(toks[0, 1]) + 1) % cfg.vocab_size)
+    out2 = model.apply({"params": params}, toks2, segment_ids=segs)
+    np.testing.assert_allclose(np.asarray(out2[0, 5:11]),
+                               np.asarray(base[0, 5:11]), atol=1e-6)
+    with pytest.raises(ValueError, match="decode"):
+        Llama(cfg, decode=True).apply({"params": params}, toks,
+                                      segment_ids=segs)

@@ -110,7 +110,8 @@ class Llama(nn.Module):
     decode: bool = False  # KV-cache autoregressive mode (generation)
 
     @nn.compact
-    def __call__(self, tokens, *, q_offset=0, return_hidden=False):
+    def __call__(self, tokens, *, q_offset=0, return_hidden=False,
+                 segment_ids=None):
         """tokens: (B, S) int32 → logits (B, S, vocab) fp32.
 
         ``q_offset`` is the global position of tokens[:, 0] — nonzero when
@@ -123,10 +124,26 @@ class Llama(nn.Module):
         materialized (at B=8, S=2k, V=128k that tensor alone is ~8 GB —
         more than half a v5e's HBM; observed OOM on chip).  Init with the
         default ``False`` so the head params are created.
+
+        ``segment_ids`` (B, S) enables packed-sequence training:
+        attention is masked across document boundaries (the flash
+        kernel's native segment path on TPU, an explicit mask on dense)
+        — pair with ``packed_causal_lm_loss``.  Overrides
+        ``attention_fn``; incompatible with decode/SP.
         """
         if self.decode and not (isinstance(q_offset, int) and q_offset == 0):
             raise ValueError("decode mode is incompatible with q_offset/SP sharding")
-        attention_fn = self.attention_fn
+        if segment_ids is not None:
+            if self.decode:
+                raise ValueError("segment_ids is incompatible with decode mode")
+            if not (isinstance(q_offset, int) and q_offset == 0):
+                raise ValueError(
+                    "segment_ids is incompatible with q_offset/SP sharding")
+            from tpucfn.data.packing import packed_attention_fn
+
+            attention_fn = packed_attention_fn(segment_ids)
+        else:
+            attention_fn = self.attention_fn
         if attention_fn is None:
             from tpucfn.kernels.auto import auto_attention_static_zero
 
