@@ -1,0 +1,53 @@
+"""HF Llama import: our model must reproduce the canonical torch
+implementation's logits from converted weights."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+from tpucfn.models.hf_convert import from_hf_llama  # noqa: E402
+from tpucfn.models.llama import Llama  # noqa: E402
+
+
+def _tiny_hf_model(tie=False):
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=128, max_position_embeddings=512,
+        rope_theta=500000.0, rms_norm_eps=1e-5,
+        attention_bias=False, mlp_bias=False, tie_word_embeddings=tie)
+    torch.manual_seed(0)
+    return transformers.LlamaForCausalLM(hf_cfg).eval()
+
+
+def test_hf_llama_logits_parity():
+    hf = _tiny_hf_model()
+    cfg, params = from_hf_llama(hf, dtype=jnp.float32, remat=False)
+    assert cfg.n_kv_heads == 2 and cfg.head_dim == 16
+
+    rs = np.random.RandomState(0)
+    toks = rs.randint(0, cfg.vocab_size, (2, 24)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(toks).long()).logits.numpy()
+    out = Llama(cfg).apply({"params": jax.tree.map(jnp.asarray, params)},
+                           jnp.asarray(toks))
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-4, rtol=2e-4)
+
+
+def test_hf_llama_tied_embeddings():
+    hf = _tiny_hf_model(tie=True)
+    cfg, params = from_hf_llama(hf, dtype=jnp.float32, remat=False)
+    np.testing.assert_array_equal(
+        params["lm_head"]["kernel"],
+        params["embed_tokens"]["embedding"].T)
+    rs = np.random.RandomState(1)
+    toks = rs.randint(0, cfg.vocab_size, (1, 8)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(toks).long()).logits.numpy()
+    out = Llama(cfg).apply({"params": jax.tree.map(jnp.asarray, params)},
+                           jnp.asarray(toks))
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-4, rtol=2e-4)

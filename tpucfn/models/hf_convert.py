@@ -1,0 +1,101 @@
+"""HuggingFace Llama checkpoint import.
+
+The adoption path for users arriving with standard weights: map a HF
+``LlamaForCausalLM`` state dict onto the tpucfn param tree (same
+rotate-half RoPE convention, so the mapping is transpose/stack only —
+no head permutation) and derive :class:`LlamaConfig` from the HF config.
+The parity test pins our Llama's logits against the canonical HF torch
+implementation on a tiny random model — a cross-implementation
+correctness check of attention/RoPE/RMSNorm/SwiGLU, not just plumbing.
+
+Torch is only needed at conversion time (CPU is fine); nothing else in
+tpucfn imports it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from tpucfn.models.llama import LlamaConfig
+
+
+def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
+    """LlamaConfig from a transformers ``LlamaConfig``-like object."""
+    import dataclasses
+
+    cfg = LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        dim=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(hf_config, "num_key_value_heads",
+                           hf_config.num_attention_heads),
+        ffn_dim=hf_config.intermediate_size,
+        max_seq=hf_config.max_position_embeddings,
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        norm_eps=float(hf_config.rms_norm_eps),
+    )
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+def _np(x) -> np.ndarray:
+    if hasattr(x, "detach"):
+        x = x.detach().cpu().numpy()
+    return np.asarray(x, np.float32)
+
+
+def params_from_hf_state_dict(state_dict: Mapping[str, Any],
+                              cfg: LlamaConfig) -> dict:
+    """HF ``model.state_dict()`` → the tpucfn Llama param tree
+    (scan-stacked when ``cfg.scan_layers``).  Torch Linear stores
+    (out, in); flax DenseGeneral kernels are (in, out) — transposed
+    here.  Tied embeddings (no ``lm_head.weight``) reuse the embedding
+    transposed."""
+    sd = state_dict
+    L = cfg.n_layers
+
+    def lstack(fmt, transpose=True):
+        mats = [_np(sd[fmt.format(i=i)]) for i in range(L)]
+        if transpose:
+            mats = [m.T for m in mats]
+        out = np.stack(mats)
+        if not cfg.scan_layers:
+            return out  # caller splits
+        return out
+
+    embed = _np(sd["model.embed_tokens.weight"])
+    lm_head = (_np(sd["lm_head.weight"]).T if "lm_head.weight" in sd
+               else embed.T.copy())
+
+    layers = {
+        "attn": {p: {"kernel": lstack(
+            "model.layers.{i}.self_attn.%s.weight" % p)}
+            for p in ("q_proj", "k_proj", "v_proj", "o_proj")},
+        "mlp": {p: {"kernel": lstack("model.layers.{i}.mlp.%s.weight" % p)}
+                for p in ("gate_proj", "up_proj", "down_proj")},
+        "input_norm": {"scale": lstack(
+            "model.layers.{i}.input_layernorm.weight", transpose=False)},
+        "post_attn_norm": {"scale": lstack(
+            "model.layers.{i}.post_attention_layernorm.weight",
+            transpose=False)},
+    }
+    params = {
+        "embed_tokens": {"embedding": embed},
+        "layers": layers,
+        "final_norm": {"scale": _np(sd["model.norm.weight"])},
+        "lm_head": {"kernel": lm_head},
+    }
+    if not cfg.scan_layers:
+        raise NotImplementedError(
+            "HF import targets the scanned layout (cfg.scan_layers=True) — "
+            "the unrolled layout is a test-only configuration")
+    return params
+
+
+def from_hf_llama(hf_model: Any, **config_overrides
+                  ) -> tuple[LlamaConfig, dict]:
+    """(cfg, params) from a live ``transformers.LlamaForCausalLM``."""
+    cfg = config_from_hf(hf_model.config, **config_overrides)
+    return cfg, params_from_hf_state_dict(hf_model.state_dict(), cfg)
