@@ -51,3 +51,23 @@ def test_hf_llama_tied_embeddings():
     out = Llama(cfg).apply({"params": jax.tree.map(jnp.asarray, params)},
                            jnp.asarray(toks))
     np.testing.assert_allclose(np.asarray(out), ref, atol=2e-4, rtol=2e-4)
+
+
+def test_hf_convert_refuses_unsupported_features():
+    from tpucfn.models.hf_convert import config_from_hf, from_hf_llama
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, num_key_value_heads=2, intermediate_size=64,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 8192})
+    with pytest.raises(NotImplementedError, match="rope_scaling"):
+        config_from_hf(hf_cfg)
+
+    biased = transformers.LlamaForCausalLM(transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, num_key_value_heads=2, intermediate_size=64,
+        attention_bias=True)).eval()
+    with pytest.raises(NotImplementedError, match="unmapped"):
+        from_hf_llama(biased)

@@ -22,9 +22,25 @@ from tpucfn.models.llama import LlamaConfig
 
 
 def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
-    """LlamaConfig from a transformers ``LlamaConfig``-like object."""
+    """LlamaConfig from a transformers ``LlamaConfig``-like object.
+
+    Raises on HF features tpucfn's Llama does not implement rather than
+    converting to silently-wrong numerics."""
     import dataclasses
 
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling not in (None, {}):
+        raise NotImplementedError(
+            f"rope_scaling={scaling!r} is not implemented in tpucfn's RoPE "
+            "(plain theta frequencies); converting would produce silently "
+            "wrong positions (Llama-3.1+ checkpoints use this)")
+    explicit_hd = getattr(hf_config, "head_dim", None)
+    derived_hd = hf_config.hidden_size // hf_config.num_attention_heads
+    if explicit_hd not in (None, derived_hd):
+        raise NotImplementedError(
+            f"head_dim={explicit_hd} != hidden_size//num_heads={derived_hd}: "
+            "tpucfn's LlamaConfig derives head_dim, so this checkpoint's "
+            "projection shapes cannot be represented")
     cfg = LlamaConfig(
         vocab_size=hf_config.vocab_size,
         dim=hf_config.hidden_size,
@@ -53,21 +69,29 @@ def params_from_hf_state_dict(state_dict: Mapping[str, Any],
     (out, in); flax DenseGeneral kernels are (in, out) — transposed
     here.  Tied embeddings (no ``lm_head.weight``) reuse the embedding
     transposed."""
+    if not cfg.scan_layers:
+        raise NotImplementedError(
+            "HF import targets the scanned layout (cfg.scan_layers=True) — "
+            "the unrolled layout is a test-only configuration")
     sd = state_dict
     L = cfg.n_layers
+    consumed: set[str] = set()
+
+    def take(name):
+        consumed.add(name)
+        return _np(sd[name])
 
     def lstack(fmt, transpose=True):
-        mats = [_np(sd[fmt.format(i=i)]) for i in range(L)]
+        mats = [take(fmt.format(i=i)) for i in range(L)]
         if transpose:
             mats = [m.T for m in mats]
-        out = np.stack(mats)
-        if not cfg.scan_layers:
-            return out  # caller splits
-        return out
+        return np.stack(mats)
 
-    embed = _np(sd["model.embed_tokens.weight"])
-    lm_head = (_np(sd["lm_head.weight"]).T if "lm_head.weight" in sd
-               else embed.T.copy())
+    embed = take("model.embed_tokens.weight")
+    if "lm_head.weight" in sd:
+        lm_head = take("lm_head.weight").T
+    else:
+        lm_head = embed.T.copy()
 
     layers = {
         "attn": {p: {"kernel": lstack(
@@ -84,13 +108,19 @@ def params_from_hf_state_dict(state_dict: Mapping[str, Any],
     params = {
         "embed_tokens": {"embedding": embed},
         "layers": layers,
-        "final_norm": {"scale": _np(sd["model.norm.weight"])},
+        "final_norm": {"scale": take("model.norm.weight")},
         "lm_head": {"kernel": lm_head},
     }
-    if not cfg.scan_layers:
+    # A dropped tensor is silently-wrong logits (e.g. attention biases
+    # from attention_bias=True checkpoints) — refuse instead.
+    ignorable = {k for k in sd
+                 if k.endswith("rotary_emb.inv_freq")}  # legacy buffer
+    leftover = sorted(set(sd) - consumed - ignorable)
+    if leftover:
         raise NotImplementedError(
-            "HF import targets the scanned layout (cfg.scan_layers=True) — "
-            "the unrolled layout is a test-only configuration")
+            f"unmapped tensors in the HF state dict (first 5: "
+            f"{leftover[:5]}) — this checkpoint uses features tpucfn's "
+            "Llama does not implement (e.g. attention biases)")
     return params
 
 
