@@ -43,6 +43,12 @@ def main() -> int:
                    help="label cardinality (set to the real dataset's "
                         "class count with --data-url)")
     p.add_argument("--label-smoothing", type=float, default=0.1)
+    p.add_argument("--loader-workers", type=int, default=0,
+                   help="decode/augment parallelism: N>0 threads "
+                        "(in-process; per-example seeds stay "
+                        "deterministic) or N<0 spawn processes (|N| "
+                        "workers via MultiProcessLoader — the answer "
+                        "when one decode core cannot feed the chips)")
     p.add_argument("--augment", action="store_true",
                    help="inception-style random-resized-crop + mirror")
     args = p.parse_args()
@@ -138,9 +144,20 @@ def main() -> int:
         transform = Compose([random_resized_crop(args.image_size), random_flip()])
     # Real datasets stream (constant host RAM); synthetic smoke data is
     # small enough to cache decoded.
-    ds = ShardedDataset(shards, batch_size_per_process=per_process_batch(args),
-                        seed=args.seed, transform=transform,
-                        cache_in_memory=not args.data_url)
+    if args.loader_workers < 0:
+        from tpucfn.data import MultiProcessLoader
+
+        ds = MultiProcessLoader(
+            shards, num_workers=-args.loader_workers,
+            batch_size_per_process=per_process_batch(args),
+            seed=args.seed, transform=transform,
+            cache_in_memory=not args.data_url)
+    else:
+        ds = ShardedDataset(shards,
+                            batch_size_per_process=per_process_batch(args),
+                            seed=args.seed, transform=transform,
+                            cache_in_memory=not args.data_url,
+                            num_workers=args.loader_workers)
     run_train_loop(trainer, ds, mesh, args, items_per_step=args.batch_size)
     return 0
 

@@ -118,3 +118,10 @@ def test_llama_packed_example(tmp_path):
     _ok(_run("llama3_8b_fsdp.py", tmp_path, "--model", "tiny",
              "--seq-len", "32", "--batch-size", "8", "--fsdp", "2",
              "--packed", "--num-examples", "64"))
+
+
+def test_imagenet_multiprocess_loader_example(tmp_path):
+    """--loader-workers -2: spawn decode workers feed the train loop."""
+    _ok(_run("imagenet_resnet50.py", tmp_path, "--network", "resnet18",
+             "--image-size", "64", "--batch-size", "8", "--augment",
+             "--loader-workers", "-2", "--num-examples", "64"))
