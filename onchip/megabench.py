@@ -417,6 +417,33 @@ def main() -> int:
         mark_done(state, phase)
 
     tune_full_phase("tune_full_s4k_d40", 4096, 40)
+
+    # Llama-1B's head_dim is 64 (2048/32) — the causal table only has
+    # D=128 entries, so its flash path ran untuned 128/128 blocks.
+    def tune_causal_phase(phase, s, d, heads, kv_heads):
+        if phase in state["done"]:
+            return
+        log(f"phase {phase}")
+        try:
+            import jax.numpy as jnp
+
+            from tpucfn.kernels import flash_autotune
+
+            res = flash_autotune.tune(s, d, heads=heads, kv_heads=kv_heads,
+                                      batch=4, dtype=jnp.bfloat16,
+                                      causal=True, iters=5)
+            record(phase, res)
+        except Exception as e:  # noqa: BLE001
+            log(f"{phase} FAILED: {e!r}")
+            record(phase, {"error": repr(e)})
+        mark_done(state, phase)
+
+    tune_causal_phase("tune_s2k_d64", 2048, 64, 32, 8)
+    if not xla_phase("llama_1b_v3_tuned_d64", {
+            "TPUCFN_BENCH_MODEL": "llama", "TPUCFN_BENCH_BATCH": None},
+            critical=False):
+        return 44
+    os.environ.pop("TPUCFN_BENCH_MODEL", None)
     if not xla_phase("unet_b4_flash_tuned", {
             "TPUCFN_BENCH_MODEL": "unet", "TPUCFN_BENCH_BATCH": "4",
             "TPUCFN_BENCH_OPT": "adafactor"}, critical=False):
