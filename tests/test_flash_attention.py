@@ -286,3 +286,38 @@ def test_flash_noncausal_unet_shapes():
         ref = dot_product_attention(q, k, v, causal=False)
         np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
                                    atol=2e-6, err_msg=f"d={d} s_kv={s_kv}")
+
+
+def test_flash_property_sweep_random_shapes_vs_dense():
+    """Property sweep: random (S, Skv, H, Hkv, D, causal, segments,
+    offsets) configurations must all match dense numerics — the kernel's
+    masking/padding corners beyond the hand-picked cases."""
+    rs = np.random.RandomState(42)
+    for trial in range(12):
+        d = int(rs.choice([32, 40, 64, 128]))
+        hkv = int(rs.choice([1, 2, 4]))
+        h = hkv * int(rs.choice([1, 2, 4]))
+        causal = bool(rs.rand() < 0.5)
+        sq = int(rs.randint(3, 70))
+        skv = sq if causal else int(rs.randint(3, 70))
+        q = jnp.asarray(rs.randn(2, sq, h, d), jnp.float32)
+        k = jnp.asarray(rs.randn(2, skv, hkv, d), jnp.float32)
+        v = jnp.asarray(rs.randn(2, skv, hkv, d), jnp.float32)
+
+        seg = None
+        kw = {}
+        if causal and rs.rand() < 0.5 and sq == skv:
+            # random packed segments: sorted ids incl. some padding (-1)
+            ids = np.sort(rs.randint(0, 3, (2, sq))).astype(np.int32)
+            seg = jnp.asarray(ids)
+            kw["segment_ids"] = seg
+        out = flash_attention(q, k, v, causal=causal, **kw)
+        if seg is not None:
+            mask = (seg[:, None, :, None] == seg[:, None, None, :])
+            ref = dot_product_attention(q, k, v, causal=causal, mask=mask)
+        else:
+            ref = dot_product_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=3e-6,
+            err_msg=f"trial={trial} sq={sq} skv={skv} h={h}/{hkv} d={d} "
+                    f"causal={causal} seg={seg is not None}")
