@@ -21,11 +21,16 @@ probe retries on that timescale instead of giving up after one attempt
 (VERDICT r1 weak #3); every probe outcome is recorded in ``detail.probes``.
 
 Env knobs: TPUCFN_BENCH_PRESET=tiny|full, TPUCFN_BENCH_BATCH (per-chip),
-TPUCFN_BENCH_STEPS / _WARMUP (timed/warm step counts), TPUCFN_BENCH_REMAT=0
-(llama: disable remat), TPUCFN_BENCH_OPT=adamw|adafactor and
-TPUCFN_BENCH_CE_CHUNK (llama memory levers), TPUCFN_BENCH_OVERLAP=0 (skip
-the loader leg), TPUCFN_BENCH_PROBE_BUDGET_S / _PROBE_INTERVAL_S /
-_TPU_TIMEOUT_S, TPUCFN_BENCH_RECORDED_PATH (replay-tier source).
+TPUCFN_BENCH_STEPS / _WARMUP (timed/warm step counts), TPUCFN_BENCH_SEQ
+(llama sequence length), TPUCFN_BENCH_REMAT=0 (llama: disable remat),
+TPUCFN_BENCH_OPT=adamw|adafactor and TPUCFN_BENCH_CE_CHUNK (llama memory
+levers), TPUCFN_BENCH_OVERLAP=0 (skip the loader leg),
+TPUCFN_BENCH_LOADER_WORKERS (overlap leg: N>0 decode threads, N<0 spawn
+processes), TPUCFN_BENCH_WARM_TTFS=1 (re-compile against the persistent
+cache and report warm time-to-first-step), TPUCFN_BENCH_PROFILE=<dir>
+(XProf-trace the timed steps), TPUCFN_BENCH_PROBE_BUDGET_S /
+_PROBE_INTERVAL_S / _TPU_TIMEOUT_S, TPUCFN_BENCH_RECORDED_PATH
+(replay-tier source).
 """
 
 from __future__ import annotations
@@ -56,6 +61,42 @@ def _peak_tflops(device_kind: str) -> float | None:
         if key in kind:
             return tflops
     return None
+
+
+# Peak HBM bandwidth GB/s per chip by device_kind substring (public specs).
+# Paired with XLA cost analysis "bytes accessed", this turns every bench row
+# into a roofline point: mfu ≈ MXU-side utilization, hbm_util ≈ memory-side —
+# whichever is near 1.0 names the bound (VERDICT r3 weak #2 asked for exactly
+# this evidence for the ~30% MFU plateau).
+_PEAK_HBM_GBS = (
+    ("v6", 1640.0), ("trillium", 1640.0),
+    ("v5p", 2765.0),
+    ("v5 lite", 819.0), ("v5e", 819.0), ("v5litepod", 819.0),
+    ("v4", 1228.0),
+    ("v3", 900.0),
+    ("v2", 700.0),
+)
+
+
+def _peak_hbm_gbs(device_kind: str) -> float | None:
+    kind = device_kind.lower()
+    for key, gbs in _PEAK_HBM_GBS:
+        if key in kind:
+            return gbs
+    return None
+
+
+def _git_commit() -> str | None:
+    """Current repo commit (short) — stamped into recorded rows so the
+    replay tier can flag results from older code (ADVICE r3)."""
+    try:
+        r = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10)
+        return r.stdout.strip() or None
+    except (OSError, subprocess.TimeoutExpired):
+        return None
 
 
 # --------------------------------------------------------------------------
@@ -212,9 +253,20 @@ def orchestrate() -> int:
             if rec is not None:
                 result = rec["result"]
                 mode = "tpu-recorded"
+                # Staleness provenance (ADVICE r3): a replay must say how
+                # old it is and whether the code has moved since capture,
+                # so an aged recording cannot silently pose as current.
+                age_s = round(time.time() - rec.get("ts", time.time()))
+                now_commit = _git_commit()
+                rec_commit = rec.get("git_commit")
                 result.setdefault("detail", {})["recorded"] = {
                     "phase": rec.get("phase"), "utc": rec.get("utc"),
-                    "age_s": round(time.time() - rec.get("ts", time.time())),
+                    "age_s": age_s,
+                    "git_commit": rec_commit,
+                    "current_commit": now_commit,
+                    "stale": bool(age_s > 86400 or (
+                        rec_commit and now_commit
+                        and rec_commit != now_commit)),
                     "source": "onchip/megabench_results.jsonl (single-client "
                               "on-chip suite; see PARITY.md round-3 status)"}
             else:
@@ -259,11 +311,14 @@ def _measure_trainer(trainer, state, batch, *, steps, warmup):
     compile_s = _time.perf_counter() - t0
 
     flops_per_dev_step = None
+    bytes_per_dev_step = None
     try:
         cost = (trainer._jit_step.lower(trainer.abstract_state(), batch)
                 .compile().cost_analysis())
         if cost and cost.get("flops"):
             flops_per_dev_step = float(cost["flops"])
+        if cost and cost.get("bytes accessed"):
+            bytes_per_dev_step = float(cost["bytes accessed"])
     except Exception:  # noqa: BLE001 — cost analysis is best-effort
         pass
 
@@ -274,28 +329,71 @@ def _measure_trainer(trainer, state, batch, *, steps, warmup):
     # Timed region: enqueue steps and sync once at the end — the state
     # dependency chain forces serial device execution; one final fetch
     # avoids per-step host round-trips (dominant on the tunneled chip).
-    t0 = _time.perf_counter()
-    for _ in range(steps):
-        state, metrics = trainer.step(state, batch)
-    final_loss = float(metrics["loss"])
-    mean_step = (_time.perf_counter() - t0) / steps
+    # TPUCFN_BENCH_PROFILE=<dir>: capture an XProf trace of exactly this
+    # steady-state range (the §5 profiler row pointed at the MFU gap).
+    prof_dir = os.environ.get("TPUCFN_BENCH_PROFILE")
+    import contextlib as _ctx
+
+    from tpucfn.obs import profile_steps
+
+    if prof_dir:
+        # Fresh capture dir: a retried/previous session's trace must not
+        # be counted (or sized) as this run's artifact.
+        import shutil as _sh
+
+        _sh.rmtree(prof_dir, ignore_errors=True)
+    with (profile_steps(prof_dir) if prof_dir else _ctx.nullcontext()):
+        t0 = _time.perf_counter()
+        for _ in range(steps):
+            state, metrics = trainer.step(state, batch)
+        final_loss = float(metrics["loss"])
+        mean_step = (_time.perf_counter() - t0) / steps
 
     device = jax.devices()[0]
     peak = _peak_tflops(device.device_kind)
+    peak_hbm = _peak_hbm_gbs(device.device_kind)
     mfu = None
+    hbm_util = None
     if flops_per_dev_step and peak and device.platform == "tpu":
         mfu = round(flops_per_dev_step / mean_step / (peak * 1e12), 4)
-    return state, {
+    if bytes_per_dev_step and peak_hbm and device.platform == "tpu":
+        hbm_util = round(bytes_per_dev_step / mean_step / (peak_hbm * 1e9), 4)
+    out = {
         "mean_step_s": round(mean_step, 5),
         "compile_s": round(compile_s, 2),
         "final_loss": round(final_loss, 4),
         "flops_per_dev_step_g": (round(flops_per_dev_step / 1e9, 1)
                                  if flops_per_dev_step else None),
+        "bytes_per_dev_step_g": (round(bytes_per_dev_step / 1e9, 2)
+                                 if bytes_per_dev_step else None),
         "peak_bf16_tflops": peak,
+        "peak_hbm_gbs": peak_hbm,
         "mfu": mfu,
+        "hbm_util": hbm_util,
         "platform": device.platform,
         "device_kind": device.device_kind,
     }
+    if prof_dir and os.path.isdir(prof_dir):
+        traces = []
+        for root, _dirs, files in os.walk(prof_dir):
+            for f in files:
+                p = os.path.join(root, f)
+                traces.append({"file": os.path.relpath(p, prof_dir),
+                               "bytes": os.path.getsize(p)})
+        out["trace_files"] = sorted(traces, key=lambda t: -t["bytes"])[:8]
+        out["trace_total_bytes"] = sum(t["bytes"] for t in traces)
+    return state, out
+
+
+class _ToFloat:
+    """Module-level (picklable) so it can cross into MultiProcessLoader
+    spawn workers; a closure cannot."""
+
+    def __call__(self, ex, _rs):
+        import numpy as np
+
+        return {"image": ex["image"].astype(np.float32) / 255.0,
+                "label": ex["label"]}
 
 
 def _measure_input_overlap(trainer, state, mesh, *, image_hw, classes,
@@ -320,6 +418,7 @@ def _measure_input_overlap(trainer, state, mesh, *, image_hw, classes,
     import tempfile
 
     tmp = pathlib.Path(tempfile.mkdtemp(prefix="tpucfn-bench-overlap-"))
+    loader = None
     try:
         rs = np.random.RandomState(0)
         n_examples = max(global_batch * 2, 64)
@@ -333,18 +432,27 @@ def _measure_input_overlap(trainer, state, mesh, *, image_hw, classes,
 
         shards = write_dataset_shards(gen(), tmp, num_shards=8)
 
-        def to_float(ex, _rs):
-            return {"image": ex["image"].astype(np.float32) / 255.0,
-                    "label": ex["label"]}
+        transform = Compose([decode_transform(),
+                             center_crop_resize(image_hw), _ToFloat()])
+        # Mirrors the examples' convention: N>0 decode threads in-process,
+        # N<0 spawn |N| worker PROCESSES (MultiProcessLoader — the answer
+        # when one decode core cannot feed the chip).
+        nw = int(os.environ.get("TPUCFN_BENCH_LOADER_WORKERS", "0"))
+        if nw < 0:
+            from tpucfn.data import MultiProcessLoader
 
-        ds = ShardedDataset(
-            shards, batch_size_per_process=global_batch, seed=0,
-            cache_in_memory=False, process_index=0, process_count=1,
-            transform=Compose([decode_transform(),
-                               center_crop_resize(image_hw), to_float]),
-            num_workers=int(os.environ.get(
-                "TPUCFN_BENCH_LOADER_WORKERS", "0")))
-        it = prefetch_to_mesh(ds.batches(None), mesh)
+            loader = MultiProcessLoader(
+                shards, num_workers=-nw,
+                batch_size_per_process=global_batch, seed=0,
+                cache_in_memory=False, process_index=0, process_count=1,
+                transform=transform)
+            it = prefetch_to_mesh(loader.batches(None), mesh)
+        else:
+            ds = ShardedDataset(
+                shards, batch_size_per_process=global_batch, seed=0,
+                cache_in_memory=False, process_index=0, process_count=1,
+                transform=transform, num_workers=nw)
+            it = prefetch_to_mesh(ds.batches(None), mesh)
         # Warm compile + drain the prefetch queue's head start (depth=2):
         # timing must start from STEADY state, or the first few steps
         # consume pre-staged batches and understate loader latency.
@@ -360,6 +468,8 @@ def _measure_input_overlap(trainer, state, mesh, *, image_hw, classes,
         return {
             "loader_step_s": round(loader_step_s, 5),
             "prestaged_step_s": round(prestaged_step_s, 5),
+            "loader_workers": nw,
+            "host_cores": os.cpu_count(),
             # ε = 15% + 2ms: scheduling jitter, not a second input budget
             "input_bound": bool(
                 loader_step_s > prestaged_step_s * 1.15 + 0.002),
@@ -367,6 +477,8 @@ def _measure_input_overlap(trainer, state, mesh, *, image_hw, classes,
     except Exception as e:  # noqa: BLE001 — the bench must still emit JSON
         return {"error": repr(e)}
     finally:
+        if loader is not None:
+            loader.close()
         # The prefetch daemon may hold open fds into tmp; on Linux the
         # unlink is safe (open fds stay readable) and a failed later
         # shard open just ends the producer thread.
@@ -405,6 +517,7 @@ def _worker_llama(tiny: bool) -> int:
 
         cfg = dataclasses.replace(cfg, remat=False)
     per_chip_batch = int(os.environ.get("TPUCFN_BENCH_BATCH", per_chip_batch))
+    seq = int(os.environ.get("TPUCFN_BENCH_SEQ", seq))
     steps = int(os.environ.get("TPUCFN_BENCH_STEPS", steps))
     warmup = int(os.environ.get("TPUCFN_BENCH_WARMUP", warmup))
     global_batch = per_chip_batch * n_dev
@@ -756,6 +869,19 @@ def worker() -> int:
 
     state, m = _measure_trainer(trainer, state, batch, steps=steps,
                                 warmup=warmup)
+    if os.environ.get("TPUCFN_BENCH_WARM_TTFS") == "1":
+        # Warm-start time-to-first-step (BASELINE metric 2): drop the jit
+        # executable cache so the next step re-lowers and re-compiles —
+        # against the persistent XLA compile cache populated above. The
+        # delta vs compile_s is what a relaunch on the same pod pays.
+        jax.clear_caches()
+        t0 = time.perf_counter()
+        state, metrics = trainer.step(state, batch)
+        float(metrics["loss"])
+        warm_s = time.perf_counter() - t0
+        m["compile_warm_s"] = round(warm_s, 2)
+        m["time_to_first_step_warm_s"] = round(
+            provision_s + init_s + warm_s, 2)
     if os.environ.get("TPUCFN_BENCH_OVERLAP", "1") == "1":
         m["overlap"] = _measure_input_overlap(
             trainer, state, mesh, image_hw=image_hw, classes=classes,
