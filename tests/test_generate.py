@@ -180,3 +180,31 @@ def test_generate_top_k_one_is_greedy():
     k1 = generate(cfg, params, prompt, max_new_tokens=6, temperature=1.3,
                   top_k=1, rng=jax.random.key(7))
     np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+
+
+def test_top_p_applies_temperature_before_nucleus():
+    # ADVICE r3: the nucleus set must be computed on logits/temperature
+    # (the HF/vLLM convention) — at high temperature the distribution
+    # flattens, so MORE tokens enter the top-p set than at T=1.
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpucfn.models.generate import _scaled_filtered_logits
+
+    logits = jnp.asarray([[4.0, 2.0, 1.0, 0.0]])
+    neg = jnp.finfo(jnp.float32).min
+
+    def kept(temperature):
+        out = np.asarray(
+            _scaled_filtered_logits(logits, temperature, None, 0.8))
+        return (out[0] > neg / 2).sum()
+
+    # T=1: p = softmax([4,2,1,0]) ~ [.83,.11,.04,.02]; nucleus(.8) = 1.
+    assert kept(1.0) == 1
+    # T=4: p ~ [.41,.25,.19,.15] — flattened; nucleus(.8) needs 3 tokens.
+    # The pre-fix order (filter on raw logits, then divide) would still
+    # keep only 1 here.
+    assert kept(4.0) == 3
+    # Scaling must be applied to the RETURNED logits too (sampled as-is).
+    out = np.asarray(_scaled_filtered_logits(logits, 4.0, None, None))
+    np.testing.assert_allclose(out, np.asarray(logits) / 4.0)

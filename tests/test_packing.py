@@ -148,3 +148,41 @@ def test_llama_segment_ids_kwarg_isolates_documents():
     with pytest.raises(ValueError, match="decode"):
         Llama(cfg, decode=True).apply({"params": params}, toks,
                                       segment_ids=segs)
+
+
+def test_pack_sequences_open_row_pruning_preserves_first_fit():
+    # ADVICE r3: packing went O(docs x rows). The fix prunes rows whose
+    # remaining capacity is below the corpus-wide min doc length; the
+    # result must stay bit-identical to naive first-fit.
+    import numpy as np
+
+    from tpucfn.data.packing import pack_sequences
+
+    rs = np.random.RandomState(0)
+    seqs = [np.arange(rs.randint(3, 60), dtype=np.int32) + i
+            for i in range(400)]
+    tokens, segments = pack_sequences(seqs, 64)
+
+    def naive(sequences, seq_len):
+        rows, segs, counts = [], [], []
+        for seq in sequences:
+            for i, row in enumerate(rows):
+                if len(row) + len(seq) <= seq_len:
+                    counts[i] += 1
+                    row.extend(int(t) for t in seq)
+                    segs[i].extend([counts[i]] * len(seq))
+                    break
+            else:
+                rows.append([int(t) for t in seq])
+                segs.append([1] * len(seq))
+                counts.append(1)
+        tok = np.zeros((len(rows), seq_len), np.int32)
+        sg = np.zeros((len(rows), seq_len), np.int32)
+        for i, (row, seg) in enumerate(zip(rows, segs)):
+            tok[i, :len(row)] = row
+            sg[i, :len(seg)] = seg
+        return tok, sg
+
+    ref_tok, ref_seg = naive(seqs, 64)
+    np.testing.assert_array_equal(tokens, ref_tok)
+    np.testing.assert_array_equal(segments, ref_seg)

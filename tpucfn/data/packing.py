@@ -45,6 +45,15 @@ def pack_sequences(
     rows: list[list[int]] = []
     segs: list[list[int]] = []
     counts: list[int] = []
+    # Shortest document in the corpus: any row whose remaining capacity
+    # drops below it can never accept another document, so it leaves the
+    # open list for good. First-fit results are bit-identical (a dropped
+    # row would never have been chosen), but the per-document scan is
+    # over OPEN rows only — on real corpora that is what keeps packing
+    # from going quadratic in document count (ADVICE r3).
+    lens = [len(np.asarray(s)) for s in sequences]
+    min_len = min((n for n in lens if n > 0), default=0)
+    open_rows: list[int] = []  # indices into rows, in creation order
     for seq in sequences:
         seq = np.asarray(seq)
         if seq.ndim != 1:
@@ -55,18 +64,24 @@ def pack_sequences(
                 "chunk it upstream")
         if len(seq) == 0:
             continue
-        placed = False
-        for i, row in enumerate(rows):
-            if len(row) + len(seq) <= seq_len:
+        placed_at = None
+        for pos, i in enumerate(open_rows):
+            if len(rows[i]) + len(seq) <= seq_len:
                 counts[i] += 1
-                row.extend(int(t) for t in seq)
+                rows[i].extend(int(t) for t in seq)
                 segs[i].extend([counts[i]] * len(seq))
-                placed = True
+                placed_at = pos
                 break
-        if not placed:
+        if placed_at is not None:
+            i = open_rows[placed_at]
+            if seq_len - len(rows[i]) < min_len:
+                open_rows.pop(placed_at)
+        else:
             rows.append([int(t) for t in seq])
             segs.append([1] * len(seq))
             counts.append(1)
+            if seq_len - len(rows[-1]) >= min_len:
+                open_rows.append(len(rows) - 1)
     if not rows:
         raise ValueError("no non-empty sequences to pack")
     n = len(rows)

@@ -289,6 +289,7 @@ class MultiProcessLoader:
         self.local_shards = local
         self.num_workers = num_workers
         self.prefetch = prefetch
+        self._len: int | None = None
         # Offset the seed per host process so worker w here and worker w
         # on another host draw different augmentation streams.
         self.ds_kwargs = dict(ds_kwargs, seed=seed + 100003 * pi,
@@ -327,6 +328,35 @@ class MultiProcessLoader:
     def __exit__(self, *exc):
         self.close()
 
+    def __len__(self) -> int:
+        """Host batches per epoch: the sum of each worker's per-epoch
+        batch count (each worker rounds its own remainder, exactly as
+        its in-worker ShardedDataset will). Lets epoch-driven training
+        loops compute total steps without consuming the stream
+        (ADVICE r3: ``len(ds) * num_epochs`` crashed here)."""
+        if self._len is None:
+            self._len = sum(
+                len(ShardedDataset(self.local_shards, process_index=w,
+                                   process_count=self.num_workers,
+                                   **self.ds_kwargs))
+                for w in range(self.num_workers))
+        return self._len
+
+    def _get(self, w: int, timeout_s: float = 10.0):
+        """Queue read that notices a dead worker: a spawn process killed
+        without posting (OOM SIGKILL) would otherwise block the parent
+        forever on Queue.get (ADVICE r3)."""
+        while True:
+            try:
+                return self._queues[w].get(timeout=timeout_s)
+            except queue.Empty:
+                p = self._procs[w]
+                if not p.is_alive():
+                    raise RuntimeError(
+                        f"loader worker {w} died (exitcode {p.exitcode}) "
+                        "without posting a batch or an error — likely "
+                        "killed by the OS (OOM?)") from None
+
     def batches(self, num_epochs: int | None = None
                 ) -> Iterator[dict[str, np.ndarray]]:
         """Round-robin-merged batch stream across workers; epochs stay in
@@ -341,7 +371,7 @@ class MultiProcessLoader:
                 for w in range(w_count):
                     if done[w] or epoch_ended[w]:
                         continue
-                    tag, payload = self._queues[w].get()
+                    tag, payload = self._get(w)
                     if tag == "batch":
                         yield payload
                     elif tag == "end":

@@ -21,6 +21,18 @@ import jax.numpy as jnp
 from tpucfn.models.llama import Llama, LlamaConfig
 
 
+def _scaled_filtered_logits(logits: jax.Array, temperature: float,
+                            top_k: int | None,
+                            top_p: float | None) -> jax.Array:
+    """Temperature FIRST, then top-k/top-p filtering — the convention
+    shared with HF/vLLM, so the nucleus token set matches other
+    implementations when ``temperature != 1`` (top_k is invariant to the
+    order; top_p is not, since softmax mass shifts with temperature —
+    ADVICE r3). The returned logits are already scaled: sample from them
+    directly."""
+    return _filter_logits(logits / temperature, top_k, top_p)
+
+
 def _filter_logits(logits: jax.Array, top_k: int | None,
                    top_p: float | None) -> jax.Array:
     """Mask logits outside the top-k set and/or the top-p (nucleus)
@@ -93,10 +105,9 @@ def generate(
     def sample(logits_last, key):
         if temperature <= 0.0:
             return jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
-        filtered = _filter_logits(logits_last, top_k, top_p)
-        return jax.random.categorical(key, filtered / temperature, axis=-1).astype(
-            jnp.int32
-        )
+        filtered = _scaled_filtered_logits(logits_last, temperature,
+                                           top_k, top_p)
+        return jax.random.categorical(key, filtered, axis=-1).astype(jnp.int32)
 
     first = sample(logits[:, -1], rng)
 
