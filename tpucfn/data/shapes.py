@@ -1,0 +1,172 @@
+"""Procedurally generated shape-classification dataset ("procgen-shapes").
+
+The reference's de-facto integration test was "the stack comes up and
+CIFAR-10 converges" (SURVEY.md §4); its README staged the real dataset
+from S3. This build environment has zero egress, so no public dataset can
+be downloaded — this module is the documented substitution: a procedural
+10-class image-classification task that is **honestly hard**, unlike the
+class-conditional-mean streams in ``synthetic.py``:
+
+* the class signal is GEOMETRY ONLY — ten shape families rendered with
+  random position, scale, rotation, foreground/background colors, a
+  random background gradient, and pixel noise;
+* a linear probe on raw pixels sits near chance (no fixed template, no
+  color shortcut — verified in ``tests/test_shapes.py``), while a small
+  CNN (ResNet-20) can reach high-90s accuracy;
+* generation is deterministic in (seed, n) and runs anywhere (numpy +
+  PIL), so the end-to-end accuracy run is reproducible in CI.
+
+Two surfaces:
+
+* :func:`synthetic_shapes` — decoded ``{"image": uint8 HWC, "label"}``
+  stream for direct staging via ``write_dataset_shards``.
+* :func:`write_shapes_image_tree` — a ``root/class_name/img.png`` tree,
+  the torchvision/ImageNet layout, so the END-TO-END path exercises the
+  real ``tpucfn convert-dataset --kind image-tree`` → encoded shards →
+  host-side decode pipeline, exactly as a user's real dataset would
+  (SURVEY.md §2.1 S3-staging row).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterator
+
+import numpy as np
+
+SHAPE_CLASSES = (
+    "disk", "ring", "triangle", "square", "pentagon",
+    "star5", "star6", "cross", "crescent", "twodisks",
+)
+
+
+def _poly_points(cx: float, cy: float, r: float, n: int, rot: float):
+    ang = rot + np.arange(n) * 2.0 * np.pi / n
+    return [(cx + r * np.cos(a), cy + r * np.sin(a)) for a in ang]
+
+
+def _star_points(cx: float, cy: float, r: float, points: int, rot: float,
+                 inner: float):
+    ang = rot + np.arange(2 * points) * np.pi / points
+    rad = np.where(np.arange(2 * points) % 2 == 0, r, r * inner)
+    return [(cx + rr * np.cos(a), cy + rr * np.sin(a))
+            for rr, a in zip(rad, ang)]
+
+
+def _shape_mask(label: int, rs: np.random.RandomState, size: int,
+                ss: int) -> np.ndarray:
+    """Anti-aliased occupancy mask in [0, 1]: rendered at ``ss``×
+    supersampling, box-downscaled. Geometry is the ONLY class signal."""
+    from PIL import Image, ImageDraw
+
+    big = size * ss
+    # Scale and position jitter: the shape always fits, never centered.
+    r = rs.uniform(0.26, 0.42) * big  # radius in supersampled px
+    pad = r + 2 * ss
+    cx = rs.uniform(pad, big - pad)
+    cy = rs.uniform(pad, big - pad)
+    rot = rs.uniform(0, 2 * np.pi)
+
+    img = Image.new("L", (big, big), 0)
+    d = ImageDraw.Draw(img)
+    name = SHAPE_CLASSES[label]
+    if name == "disk":
+        d.ellipse([cx - r, cy - r, cx + r, cy + r], fill=255)
+    elif name == "ring":
+        d.ellipse([cx - r, cy - r, cx + r, cy + r], fill=255)
+        ri = r * rs.uniform(0.45, 0.6)
+        d.ellipse([cx - ri, cy - ri, cx + ri, cy + ri], fill=0)
+    elif name == "triangle":
+        d.polygon(_poly_points(cx, cy, r, 3, rot), fill=255)
+    elif name == "square":
+        d.polygon(_poly_points(cx, cy, r, 4, rot), fill=255)
+    elif name == "pentagon":
+        d.polygon(_poly_points(cx, cy, r, 5, rot), fill=255)
+    elif name == "star5":
+        d.polygon(_star_points(cx, cy, r, 5, rot, 0.42), fill=255)
+    elif name == "star6":
+        d.polygon(_star_points(cx, cy, r, 6, rot, 0.5), fill=255)
+    elif name == "cross":
+        w = r * rs.uniform(0.28, 0.38)
+        c, s = np.cos(rot), np.sin(rot)
+
+        def bar(hx, hy):
+            pts = [(-hx, -hy), (hx, -hy), (hx, hy), (-hx, hy)]
+            return [(cx + x * c - y * s, cy + x * s + y * c) for x, y in pts]
+
+        d.polygon(bar(r, w), fill=255)
+        d.polygon(bar(w, r), fill=255)
+    elif name == "crescent":
+        d.ellipse([cx - r, cy - r, cx + r, cy + r], fill=255)
+        off = r * rs.uniform(0.35, 0.55)
+        ox = cx + off * np.cos(rot)
+        oy = cy + off * np.sin(rot)
+        rc = r * rs.uniform(0.75, 0.95)
+        d.ellipse([ox - rc, oy - rc, ox + rc, oy + rc], fill=0)
+    elif name == "twodisks":
+        rd = r * rs.uniform(0.38, 0.5)
+        off = r - rd
+        for sign in (1.0, -1.0):
+            ox = cx + sign * off * np.cos(rot)
+            oy = cy + sign * off * np.sin(rot)
+            d.ellipse([ox - rd, oy - rd, ox + rd, oy + rd], fill=255)
+    else:  # pragma: no cover — SHAPE_CLASSES is the closed set
+        raise ValueError(f"unknown shape label {label}")
+    small = img.resize((size, size), Image.BOX)
+    return np.asarray(small, np.float32) / 255.0
+
+
+def render_shape(label: int, rs: np.random.RandomState,
+                 size: int = 32, ss: int = 4) -> np.ndarray:
+    """One uint8 HWC image: random-gradient background + random-color
+    shape + noise. Colors/brightness carry NO class information."""
+    mask = _shape_mask(label, rs, size, ss)[..., None]
+    bg_a = rs.randint(0, 256, 3).astype(np.float32)
+    bg_b = rs.randint(0, 256, 3).astype(np.float32)
+    while True:
+        fg = rs.randint(0, 256, 3).astype(np.float32)
+        # Contrast floor against BOTH gradient ends, or the shape can
+        # vanish into one side of the background.
+        if (np.abs(fg - bg_a).sum() >= 200
+                and np.abs(fg - bg_b).sum() >= 200):
+            break
+    # Linear gradient along a random direction.
+    theta = rs.uniform(0, 2 * np.pi)
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32) / (size - 1)
+    t = (xx * np.cos(theta) + yy * np.sin(theta) + 1.0) / 2.0  # ~[0,1]
+    bg = bg_a[None, None, :] * (1 - t[..., None]) + bg_b[None, None, :] * t[..., None]
+    img = bg * (1 - mask) + fg[None, None, :] * mask
+    img = img + rs.randn(size, size, 3).astype(np.float32) * rs.uniform(2, 10)
+    return np.clip(img, 0, 255).astype(np.uint8)
+
+
+def synthetic_shapes(
+    n: int = 1024, seed: int = 0, size: int = 32,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Decoded stream of ``{"image": uint8 (size,size,3), "label"}`` with
+    a balanced round-robin label sequence (shuffling is the loader's
+    job)."""
+    rs = np.random.RandomState(seed)
+    for i in range(n):
+        y = i % len(SHAPE_CLASSES)
+        yield {"image": render_shape(y, rs, size), "label": np.int32(y)}
+
+
+def write_shapes_image_tree(
+    root: str | Path, n: int, *, seed: int = 0, size: int = 32,
+) -> Path:
+    """Materialize the dataset as a ``root/<class>/NNNNN.png`` tree — the
+    input format of ``tpucfn convert-dataset --kind image-tree``, so the
+    accuracy run's data path starts where a real user's would: image
+    files on disk."""
+    from PIL import Image
+
+    root = Path(root)
+    for cls in SHAPE_CLASSES:
+        (root / cls).mkdir(parents=True, exist_ok=True)
+    rs = np.random.RandomState(seed)
+    for i in range(n):
+        y = i % len(SHAPE_CLASSES)
+        img = render_shape(y, rs, size)
+        Image.fromarray(img).save(root / SHAPE_CLASSES[y] / f"{i:06d}.png")
+    return root
