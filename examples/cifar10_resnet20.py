@@ -38,9 +38,26 @@ def main() -> int:
     add_cluster_args(p)
     p.add_argument("--network", default="resnet20", choices=["resnet20", "resnet32"])
     p.add_argument("--num-examples", type=int, default=2048,
-                   help="synthetic dataset size to stage")
+                   help="synthetic dataset size to stage (ignored with "
+                        "--data-url)")
     p.add_argument("--augment", action="store_true",
                    help="pad-crop + mirror augmentation (the CIFAR recipe)")
+    p.add_argument("--data-url", default="",
+                   help="real dataset: tpurecord shards of ENCODED images "
+                        "(tpucfn convert-dataset --kind image-tree) at a "
+                        "gs://, s3://, file:// URL or local dir — decoded "
+                        "on the host input path, 10-class 32x32 expected")
+    p.add_argument("--eval-url", default="",
+                   help="held-out split shards (encoded images) for "
+                        "--eval-every; with neither, eval uses a "
+                        "synthetic split")
+    p.add_argument("--loader-workers", type=int, default=0,
+                   help="decode/augment parallelism: N>0 threads, N<0 "
+                        "spawn processes (|N| MultiProcessLoader workers)")
+    p.add_argument("--cosine", action="store_true",
+                   help="warmup-cosine LR over the step budget (the "
+                        "train-to-accuracy recipe; default is constant "
+                        "--lr)")
     args = p.parse_args()
 
     from tpucfn.launch import initialize_runtime
@@ -57,10 +74,19 @@ def main() -> int:
     from tpucfn.train import Trainer
 
     run_dir = Path(args.run_dir)
-    shards = stage_synthetic(
-        "cifar10", run_dir / "data", n=args.num_examples,
-        num_shards=max(8, jax.process_count()), seed=args.seed,
-    )
+    if args.data_url:
+        # The reference's "aws s3 sync" staging step (SURVEY.md §2.1 S3
+        # row): sync encoded shards down once, decode on the host.
+        from tpucfn.data import stage_url
+
+        shards = stage_url(args.data_url, run_dir / "data-cache",
+                           owner_slice=(jax.process_index(),
+                                        jax.process_count()))
+    else:
+        shards = stage_synthetic(
+            "cifar10", run_dir / "data", n=args.num_examples,
+            num_shards=max(8, jax.process_count()), seed=args.seed,
+        )
 
     mesh = build_example_mesh(args)
     cfg = {
@@ -93,25 +119,75 @@ def main() -> int:
         acc = jnp.mean(jnp.argmax(logits, -1) == batch["label"])
         return loss, ({"accuracy": acc}, mstate)
 
-    tx = optax.sgd(args.lr, momentum=0.9, nesterov=True)
+    from tpucfn.data.transforms import CIFAR_TRAIN, Compose, normalize
+
+    if args.data_url:
+        # Encoded shards: decode, optional CIFAR pad-crop/mirror, then
+        # map 0-255 pixels to [-1, 1] (shape/color stats are not
+        # CIFAR's, so channel-neutral normalization).
+        from tpucfn.data import decode_transform
+
+        steps_t = [decode_transform()]
+        if args.augment:
+            steps_t.append(CIFAR_TRAIN)
+        steps_t.append(normalize((127.5,) * 3, (127.5,) * 3))
+        transform = Compose(steps_t)
+    elif args.augment:
+        transform = CIFAR_TRAIN
+    else:
+        transform = None
+    loader_kw = dict(batch_size_per_process=per_process_batch(args),
+                     seed=args.seed, transform=transform,
+                     cache_in_memory=not args.data_url)
+    if args.loader_workers < 0:
+        from tpucfn.data import MultiProcessLoader
+
+        ds = MultiProcessLoader(shards, num_workers=-args.loader_workers,
+                                **loader_kw)
+    else:
+        ds = ShardedDataset(shards, num_workers=args.loader_workers,
+                            **loader_kw)
+
+    eval_ds = None
+    if args.eval_every:
+        if args.eval_url:
+            from tpucfn.data import decode_transform, stage_url
+
+            eval_shards = stage_url(args.eval_url, run_dir / "eval-cache",
+                                    owner_slice=(jax.process_index(),
+                                                 jax.process_count()))
+            eval_ds = ShardedDataset(
+                eval_shards, shuffle=False, cache_in_memory=False,
+                batch_size_per_process=per_process_batch(args),
+                transform=Compose([decode_transform(),
+                                   normalize((127.5,) * 3, (127.5,) * 3)]))
+        else:
+            eval_shards = stage_synthetic(
+                "cifar10", run_dir / "eval", n=max(64, args.num_examples // 4),
+                num_shards=max(8, jax.process_count()), seed=args.seed + 1,
+            )
+            eval_ds = ShardedDataset(
+                eval_shards, shuffle=False,
+                batch_size_per_process=per_process_batch(args))
+
+    if args.cosine:
+        # The train-to-accuracy recipe (mirrors the ImageNet example):
+        # linear warmup into cosine decay over the full step budget.
+        steps_total = args.steps or len(ds) * args.num_epochs
+        tx = optax.chain(
+            optax.add_decayed_weights(1e-4),
+            optax.sgd(
+                optax.warmup_cosine_decay_schedule(
+                    0.0, args.lr, min(200, max(1, steps_total // 10)),
+                    steps_total),
+                momentum=0.9, nesterov=True,
+            ),
+        )
+    else:
+        tx = optax.sgd(args.lr, momentum=0.9, nesterov=True)
     trainer = Trainer(mesh, dense_rules(fsdp=args.fsdp > 1), loss_fn, tx, init_fn,
                       eval_loss_fn=eval_loss_fn)
 
-    transform = None
-    if args.augment:
-        from tpucfn.data.transforms import CIFAR_TRAIN
-
-        transform = CIFAR_TRAIN
-    ds = ShardedDataset(shards, batch_size_per_process=per_process_batch(args),
-                        seed=args.seed, transform=transform)
-    eval_ds = None
-    if args.eval_every:
-        eval_shards = stage_synthetic(
-            "cifar10", run_dir / "eval", n=max(64, args.num_examples // 4),
-            num_shards=max(8, jax.process_count()), seed=args.seed + 1,
-        )
-        eval_ds = ShardedDataset(eval_shards, shuffle=False,
-                                 batch_size_per_process=per_process_batch(args))
     run_train_loop(trainer, ds, mesh, args, items_per_step=args.batch_size,
                    eval_ds=eval_ds)
     return 0
