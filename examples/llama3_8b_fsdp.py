@@ -34,6 +34,9 @@ def main() -> int:
     p = argparse.ArgumentParser(description=__doc__)
     add_cluster_args(p)
     p.add_argument("--model", default="tiny", choices=["8b", "1b", "tiny"])
+    p.add_argument("--layers", type=int, default=0,
+                   help="override n_layers (0 = the model preset's depth; "
+                        "useful to match pipeline*virtual chunk counts)")
     p.add_argument("--seq-len", type=int, default=128)
     p.add_argument("--context", type=int, default=1,
                    help="context (sequence-parallel) axis size; >1 enables "
@@ -51,6 +54,11 @@ def main() -> int:
                    help="gpipe: AD through the forward schedule (O(M) "
                         "activation stash); 1f1b: interleaved fwd/bwd with "
                         "an O(P) stash")
+    p.add_argument("--pp-virtual", type=int, default=1,
+                   help="virtual stages per device (interleaved 1F1B): "
+                        "splits the stack into pipeline*V chunks, chunk c "
+                        "on device c mod P, shrinking the bubble for small "
+                        "microbatch counts; requires --pp-schedule 1f1b")
     p.add_argument("--moe-experts", type=int, default=0,
                    help="replace the dense MLP with a MoE of N experts "
                         "sharded over the expert axis (0 = dense); aux "
@@ -80,6 +88,8 @@ def main() -> int:
                         "0 materializes logits (pipeline paths always "
                         "do — the head runs inside the schedule)")
     args = p.parse_args()
+    if args.pp_virtual > 1 and args.pp_schedule != "1f1b":
+        p.error("--pp-virtual > 1 requires --pp-schedule 1f1b")
 
     from tpucfn.launch import initialize_runtime
 
@@ -101,9 +111,11 @@ def main() -> int:
         "1b": LlamaConfig.llama3_1b,
         "tiny": LlamaConfig.tiny,
     }[args.model]()
-    if args.moe_experts:
-        import dataclasses
+    import dataclasses
 
+    if args.layers:
+        cfg = dataclasses.replace(cfg, n_layers=args.layers)
+    if args.moe_experts:
         from tpucfn.models.moe import MoEConfig
 
         cfg = dataclasses.replace(cfg, moe=MoEConfig(n_experts=args.moe_experts))
@@ -161,9 +173,13 @@ def main() -> int:
         from tpucfn.models.llama_pp import pipelined_llama_apply
         from tpucfn.parallel import bubble_fraction
 
-        bubble = bubble_fraction(args.microbatches, args.pipeline)
+        bubble = bubble_fraction(args.microbatches, args.pipeline,
+                                 args.pp_schedule,
+                                 num_virtual=args.pp_virtual)
         print(f"pipeline: {args.pipeline} stages x {args.microbatches} "
-              f"microbatches, bubble fraction {bubble:.3f}", flush=True)
+              f"microbatches ({args.pp_schedule}"
+              + (f", V={args.pp_virtual}" if args.pp_virtual > 1 else "")
+              + f"), bubble fraction {bubble:.3f}", flush=True)
 
         hop = "flash" if args.ring_flash else "auto"
 
@@ -225,7 +241,8 @@ def main() -> int:
                     num_microbatches=args.microbatches,
                     context_parallel=args.context > 1,
                     hop_attention="flash" if args.ring_flash else "auto",
-                    z_loss=args.z_loss, with_metrics=True)
+                    z_loss=args.z_loss, with_metrics=True,
+                    num_virtual=args.pp_virtual)
                 return (loss, metrics["accuracy"]), grads
 
             def pp_loss_bwd(grads, cts):

@@ -89,6 +89,15 @@ def test_llama_pipeline_1f1b_example(tmp_path):
              "--microbatches", "4", "--pp-schedule", "1f1b")
     _ok(r)
 
+
+def test_llama_pipeline_interleaved_example(tmp_path):
+    """Interleaved 1F1B through the example surface: P=2 x V=2 chunks."""
+    r = _run("llama3_8b_fsdp.py", tmp_path, "--model", "tiny", "--seq-len", "32",
+             "--batch-size", "16", "--num-examples", "64", "--pipeline", "2",
+             "--microbatches", "4", "--pp-schedule", "1f1b", "--pp-virtual", "2",
+             "--layers", "4")
+    _ok(r)
+
 def test_llama_moe_1f1b_example(tmp_path):
     """MoE + expert axis + 1F1B: aux losses collected, accuracy logged."""
     r = _run("llama3_8b_fsdp.py", tmp_path, "--model", "tiny", "--seq-len", "32",

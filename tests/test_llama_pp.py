@@ -275,6 +275,33 @@ def test_1f1b_loss_and_grads_match_sequential():
         assert _grad_diff(g_pp, g_ref, path) < 1e-5, path
 
 
+def test_1f1b_interleaved_matches_sequential():
+    """Virtual-stage (interleaved) 1F1B on the real model: P=2 devices x
+    V=2 chunks of 1 layer each, loss+grads == sequential (VERDICT r3 #8).
+    The params tree is untouched — chunking happens inside the call."""
+    from tpucfn.models.llama_pp import pipelined_llama_value_and_grad
+
+    mesh = build_mesh(MeshSpec(pipeline=2, data=4))
+    cfg = _cfg(n_layers=4)
+    model = Llama(cfg)
+    toks = jnp.asarray(_tokens())
+    params = model.init(jax.random.key(1), toks)["params"]
+
+    def loss_ref(p):
+        return causal_lm_loss(model.apply({"params": p}, toks), toks)[0]
+
+    l_ref, g_ref = jax.jit(jax.value_and_grad(loss_ref))(params)
+    l_pp, g_pp = jax.jit(lambda p, t: pipelined_llama_value_and_grad(
+        cfg, mesh, p, t, num_microbatches=4, num_virtual=2))(params, toks)
+
+    np.testing.assert_allclose(float(l_pp), float(l_ref), rtol=1e-5)
+    for path in [("layers", "attn", "q_proj", "kernel"),
+                 ("layers", "mlp", "down_proj", "kernel"),
+                 ("embed_tokens", "embedding"),
+                 ("lm_head", "kernel"), ("final_norm", "scale")]:
+        assert _grad_diff(g_pp, g_ref, path) < 1e-5, path
+
+
 def test_1f1b_composes_with_fsdp_tp_and_context():
     from tpucfn.models.llama_pp import pipelined_llama_value_and_grad
     from tpucfn.parallel.sharding import named_sharding_tree

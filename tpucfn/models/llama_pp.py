@@ -44,7 +44,9 @@ from tpucfn.models.llama import LlamaBlock, LlamaConfig, sharding_rules
 from tpucfn.models.moe import collect_moe_aux
 from tpucfn.ops.attention import dot_product_attention
 from tpucfn.parallel.pipeline import (
+    deinterleave_chunks,
     gpipe,
+    interleave_chunks,
     microbatch,
     pipeline_1f1b,
     unmicrobatch,
@@ -242,8 +244,17 @@ def pipelined_llama_value_and_grad(
     hop_attention: str = "auto",
     z_loss: float = 0.0,
     with_metrics: bool = False,
+    num_virtual: int = 1,
 ):
     """1F1B-scheduled causal-LM loss and gradients.
+
+    ``num_virtual=V > 1`` selects the interleaved schedule: the layer
+    stack splits into P·V chunks of L/(P·V) layers, chunk c on device
+    c mod P, shrinking the pipeline bubble for small microbatch counts
+    (see :func:`tpucfn.parallel.pipeline._pipeline_1f1b_interleaved`).
+    The params tree is unchanged — the chunk reshape/permutation happens
+    here (and is inverted on the grads), so checkpoints stay
+    interchangeable with the plain model.
 
     Returns ``(loss, grads)`` — or ``(loss, metrics, grads)`` with
     ``with_metrics=True``, where ``metrics["accuracy"]`` is next-token
@@ -308,8 +319,24 @@ def pipelined_llama_value_and_grad(
     mb = microbatch(x, num_microbatches)
     lbl_mb = microbatch(labels, num_microbatches)
 
+    layers_in = params["layers"]
+    if num_virtual > 1:
+        # (L, ...) -> (P·V, L/(P·V), ...) execution-order chunks, then
+        # device-major so P(pipeline) hands device i its V chunks local.
+        n_stages = mesh.shape[AXIS_PIPELINE]
+        n_chunks = n_stages * num_virtual
+        if cfg.n_layers % n_chunks:
+            raise ValueError(
+                f"n_layers={cfg.n_layers} not divisible by "
+                f"pipeline×virtual={n_chunks}")
+        lc = cfg.n_layers // n_chunks
+        layers_in = interleave_chunks(
+            jax.tree.map(lambda l: l.reshape((n_chunks, lc) + l.shape[1:]),
+                         layers_in),
+            n_stages, num_virtual)
+
     manual = {AXIS_PIPELINE} | ({AXIS_CONTEXT} if context_parallel else set())
-    layer_specs = jax.tree.map(lambda _: P(AXIS_PIPELINE), params["layers"])
+    layer_specs = jax.tree.map(lambda _: P(AXIS_PIPELINE), layers_in)
     head_specs = jax.tree.map(lambda _: P(), head_params)
     mb_spec = P(None, None, AXIS_CONTEXT) if context_parallel else P()
 
@@ -319,6 +346,7 @@ def pipelined_llama_value_and_grad(
             reduce_axes=(AXIS_CONTEXT,) if context_parallel else (),
             stage_aux=with_aux,
             head_metrics=True,
+            num_virtual=num_virtual,
         ),
         mesh=mesh,
         in_specs=(layer_specs, head_specs, mb_spec, mb_spec),
@@ -327,7 +355,12 @@ def pipelined_llama_value_and_grad(
         check_vma=False,
     )
     loss, dlayers, dhead, dmicro, metrics = run(
-        params["layers"], head_params, mb, lbl_mb)
+        layers_in, head_params, mb, lbl_mb)
+    if num_virtual > 1:
+        dlayers = jax.tree.map(
+            lambda l: l.reshape((cfg.n_layers,) + l.shape[2:]),
+            deinterleave_chunks(dlayers, mesh.shape[AXIS_PIPELINE],
+                                num_virtual))
     (d_embed,) = embed_vjp(unmicrobatch(dmicro).astype(x.dtype))
     grads = dict(params)
     grads["layers"] = dlayers

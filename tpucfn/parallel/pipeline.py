@@ -145,13 +145,50 @@ def unmicrobatch(x: jax.Array) -> jax.Array:
 
 
 def bubble_fraction(num_microbatches: int, num_stages: int,
-                    schedule: str = "gpipe") -> float:
-    """Fraction of stage-ticks wasted in pipeline fill/drain. Same fill/
-    drain count for GPipe and 1F1B — 1F1B's win is activation memory
-    (O(P) stashed microbatches instead of O(M)), not bubble size."""
+                    schedule: str = "gpipe", num_virtual: int = 1) -> float:
+    """Fraction of stage-ticks wasted in pipeline fill/drain.
+
+    * ``gpipe``: forward-tick accounting, (P-1)/(M+P-1) — same fill/drain
+      count as vanilla 1F1B (1F1B's classic win is activation memory,
+      O(P) stashed microbatches instead of O(M), not bubble size).
+    * ``1f1b``: per-slot accounting over the schedule's actual tick count
+      (each tick holds one forward and one backward slot per device).
+      Vanilla (V=1): ticks = M + 2(P-1), busy = M per slot →
+      2(P-1)/(M+2(P-1)). Interleaved (V>1, the circular flight schedule
+      of :func:`pipeline_1f1b` ``num_virtual``): ticks = MV + PV + P - 2,
+      busy = MV per slot → (PV+P-2)/(MV+PV+P-2), strictly below the
+      vanilla fraction for the same per-device work (V-times-deeper
+      stages): V·(M + 2(P-1)) chunk-ticks vs MV + PV + P - 2."""
     if num_stages <= 1:
         return 0.0
-    return (num_stages - 1) / (num_microbatches + num_stages - 1)
+    m, p, v = num_microbatches, num_stages, num_virtual
+    if schedule == "1f1b":
+        ticks = m * v + p * v + p - 2
+        return (ticks - m * v) / ticks
+    return (p - 1) / (m + p - 1)
+
+
+def interleave_chunks(chunked: Any, num_stages: int, num_virtual: int) -> Any:
+    """Execution-order → device-major chunk layout for interleaved 1F1B.
+
+    ``chunked`` leaves have leading dim P·V in EXECUTION order (chunk c
+    applies c-th). Chunk c runs on device c mod P, so device i needs the
+    non-contiguous set {v·P + i}; reordering to position i·V + v makes
+    each device's V chunks contiguous, letting a plain ``P('pipeline')``
+    leading-dim sharding hand every device exactly its chunks (local
+    leading dim V). :func:`deinterleave_chunks` is the inverse (use it on
+    the returned ``dstage_params``)."""
+    p, v = num_stages, num_virtual
+    idx = jnp.asarray([vv * p + i for i in range(p) for vv in range(v)])
+    return jax.tree.map(lambda l: jnp.take(l, idx, axis=0), chunked)
+
+
+def deinterleave_chunks(stacked: Any, num_stages: int, num_virtual: int) -> Any:
+    """Inverse of :func:`interleave_chunks` (device-major → execution
+    order)."""
+    p, v = num_stages, num_virtual
+    idx = jnp.asarray([c % p * v + c // p for c in range(p * v)])
+    return jax.tree.map(lambda l: jnp.take(l, idx, axis=0), stacked)
 
 
 # head_fn(head_params, y, labels) -> scalar loss CONTRIBUTION for one
@@ -176,9 +213,17 @@ def pipeline_1f1b(
     reduce_axes: tuple[str, ...] = (),
     stage_aux: bool = False,
     head_metrics: bool = False,
+    num_virtual: int = 1,
 ):
     """One-forward-one-backward pipelined loss+grads; call inside
     shard_map (manual over ``axis`` and every ``reduce_axes`` entry).
+
+    ``num_virtual=V > 1`` switches to the INTERLEAVED (virtual-stage /
+    circular) schedule: the model is split into P·V chunks, chunk c on
+    device c mod P, and each device round-robins its V chunks — the
+    Megatron-style bubble lever for small M (see
+    :func:`_pipeline_1f1b_interleaved` for the schedule math and the
+    changed ``stage_params`` layout contract).
 
     Returns ``(loss, dstage_params, dhead_params, dmicrobatches)`` where
     the grads are exact for
@@ -219,6 +264,12 @@ def pipeline_1f1b(
     into the manual region): param/head grads and the loss are psum'd
     over them; activation cotangents stay sharded.
     """
+    if num_virtual > 1:
+        return _pipeline_1f1b_interleaved(
+            stage_fn, head_fn, stage_params, head_params, microbatches,
+            labels, axis=axis, reduce_axes=reduce_axes, stage_aux=stage_aux,
+            head_metrics=head_metrics, num_virtual=num_virtual)
+
     p = lax.axis_size(axis)
     i = lax.axis_index(axis)
     m = microbatches.shape[0]
@@ -341,6 +392,213 @@ def pipeline_1f1b(
 
     # Loss and head grads live on the last stage; param grads are
     # per-stage (stay sharded over `axis`).
+    loss = lax.psum(loss_acc, axis)
+    dhead = jax.tree.map(lambda g: lax.psum(g, axis), dhead)
+    metrics = jax.tree.map(lambda g: lax.psum(g, axis), metrics_acc)
+    for r in reduce_axes:
+        loss = lax.psum(loss, r)
+        dstage = jax.tree.map(lambda g: lax.psum(g, r), dstage)
+        dhead = jax.tree.map(lambda g: lax.psum(g, r), dhead)
+        metrics = jax.tree.map(lambda g: lax.psum(g, r), metrics)
+    if head_metrics:
+        return loss, dstage, dhead, dmicro, metrics
+    return loss, dstage, dhead, dmicro
+
+
+def _pipeline_1f1b_interleaved(
+    stage_fn: StageFn,
+    head_fn: HeadFn,
+    stage_params: Any,
+    head_params: Any,
+    microbatches: jax.Array,
+    labels: jax.Array,
+    *,
+    axis: str,
+    reduce_axes: tuple[str, ...],
+    stage_aux: bool,
+    head_metrics: bool,
+    num_virtual: int,
+):
+    """Interleaved (virtual-stage) 1F1B — the circular flight schedule.
+
+    The model is P·V chunks; chunk c = v·P + i lives on device i, so
+    consecutive chunks sit on consecutive devices and every hop is the
+    same uniform ring ``ppermute`` as vanilla 1F1B.  Microbatches go out
+    in FLIGHTS of P: micro m = f·P + q is injected at tick f·V·P + q.
+    Within a flight, q + v·P covers [0, V·P) bijectively, so each flight
+    occupies every device for exactly V·P consecutive ticks with no
+    collisions; flights spaced V·P apart make the forward slots DENSE.
+    Timing (per micro m = f·P+q, logical stage s = v·P + i):
+
+      forward  of (m, s) on device i at tick  f·VP + q + s
+      backward of (m, s) on device i at tick  f·VP + q + 2(VP-1) - s
+
+    Both slot schedules are dense and collision-free (the backward map
+    (f,q,v) → f·VP + q - v·P + const is injective for q<P, v<V), giving
+    total ticks M·V + P·V + P - 2 for 2·M·V applications per device —
+    bubble (PV+P-2)/(MV+PV+P-2), vs vanilla 1F1B's V·(M + 2(P-1))
+    chunk-ticks for the same per-device work (:func:`bubble_fraction`).
+
+    Contract changes vs vanilla:
+
+    * ``stage_params`` leaves carry a leading LOCAL dim V — this device's
+      chunks v = 0..V-1 (= logical stages v·P + i).  Callers shard a
+      global (P·V, ...)-leading stack with ``P(axis)`` after reordering
+      it device-major with :func:`interleave_chunks`; the returned
+      ``dstage_params`` has the same layout (undo with
+      :func:`deinterleave_chunks`).
+    * ``M % P == 0`` (whole flights).
+
+    Everything else (HeadFn contract, stage_aux, head_metrics,
+    reduce_axes, exact-grad semantics) matches :func:`pipeline_1f1b`.
+    """
+    p = lax.axis_size(axis)
+    i = lax.axis_index(axis)
+    v_n = num_virtual
+    m = microbatches.shape[0]
+    if m % p:
+        raise ValueError(
+            f"interleaved 1F1B needs M % P == 0, got M={m}, P={p}")
+    flights = m // p
+    vp = v_n * p
+    ticks = m * v_n + vp + p - 2
+    depth = 2 * vp - 1
+    perm_fwd = [(j, (j + 1) % p) for j in range(p)]
+    perm_bwd = [(j, (j - 1) % p) for j in range(p)]
+    scale = 1.0 / m
+
+    def run_stage(params, x):
+        if stage_aux:
+            return stage_fn(params, x)
+        return stage_fn(params, x), jnp.zeros((), jnp.float32)
+
+    def chunk_of(params, v):
+        return jax.tree.map(
+            lambda l: lax.dynamic_index_in_dim(l, v, 0, keepdims=False),
+            params)
+
+    if head_metrics:
+        def scaled_head(hp, y, lbl):
+            loss, metrics = head_fn(hp, y, lbl)
+            return loss * scale, metrics
+
+        grad_head = jax.value_and_grad(scaled_head, argnums=(0, 1),
+                                       has_aux=True)
+        metrics0 = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype),
+            jax.eval_shape(lambda hp, y, lbl: head_fn(hp, y, lbl)[1],
+                           head_params, microbatches[0], labels[0]))
+    else:
+        def scaled_head(hp, y, lbl):
+            return head_fn(hp, y, lbl) * scale
+
+        grad_head = jax.value_and_grad(scaled_head, argnums=(0, 1))
+        metrics0 = ()
+
+    # Injection / head-label tick tables, built by scatter (ticks are
+    # non-contiguous across flights — static padding alone can't express
+    # the flight gaps).  Garbage rows stay zero; every read is masked.
+    m_idx = jnp.arange(m)
+    inj_ticks = (m_idx // p) * vp + (m_idx % p)
+    head_ticks = inj_ticks + vp - 1
+    injects = jnp.zeros((ticks,) + microbatches.shape[1:],
+                        microbatches.dtype).at[inj_ticks].set(microbatches)
+    lbls = jnp.zeros((ticks,) + labels.shape[1:],
+                     labels.dtype).at[head_ticks].set(labels)
+
+    zero_act = jnp.zeros_like(microbatches[0])
+    stash0 = jnp.zeros((depth,) + microbatches.shape[1:], microbatches.dtype)
+    dstage0 = jax.tree.map(jnp.zeros_like, stage_params)
+    dhead0 = jax.tree.map(jnp.zeros_like, head_params)
+    dmicro0 = jnp.zeros_like(microbatches)
+
+    def slot_mask(slot):
+        return (jnp.arange(depth) == slot % depth)
+
+    def tick(carry, xs):
+        (fwd_recv, bwd_recv, stash, dstage, dhead, dmicro, loss_acc,
+         metrics_acc, t) = carry
+        inject, lbl = xs
+
+        # ---- forward slot: device i runs chunk v_f of micro m_f --------
+        w_f = t - i
+        fwd_valid = (w_f >= 0) & (w_f < m * v_n)
+        o_f = jnp.remainder(w_f, vp)
+        v_f = jnp.clip(o_f // p, 0, v_n - 1)
+        x_in = jnp.where((i == 0) & (v_f == 0), inject, fwd_recv)
+        y, aux = run_stage(chunk_of(stage_params, v_f), x_in)
+        loss_acc = loss_acc + jnp.where(fwd_valid, aux * scale, 0.0)
+        wmask = slot_mask(t)
+        stash = jnp.where(
+            wmask.reshape((depth,) + (1,) * x_in.ndim) & fwd_valid,
+            x_in[None], stash)
+
+        # Head fires when the LAST chunk (v = V-1 on device P-1) emerges.
+        at_head = (i == p - 1) & fwd_valid & (v_f == v_n - 1)
+        if head_metrics:
+            (loss_t, metrics_t), (dhead_t, dy_t) = grad_head(
+                head_params, y, lbl)
+            metrics_acc = jax.tree.map(
+                lambda a, g: a + jnp.where(at_head, g * scale,
+                                           jnp.zeros_like(g)),
+                metrics_acc, metrics_t)
+        else:
+            loss_t, (dhead_t, dy_t) = grad_head(head_params, y, lbl)
+        loss_acc = loss_acc + jnp.where(at_head, loss_t, 0.0)
+        dhead = jax.tree.map(
+            lambda a, g: a + jnp.where(at_head, g, jnp.zeros_like(g)),
+            dhead, dhead_t)
+
+        # ---- backward slot: invert t = f·VP + q + 2(VP-1) - (v·P + i) --
+        w_b = t + i - 2 * (vp - 1)
+        z_b = jnp.floor_divide(w_b, p)
+        q_b = jnp.remainder(w_b, p)
+        v_b = jnp.remainder(-z_b, v_n)
+        f_b = jnp.floor_divide(z_b + v_b, v_n)
+        bwd_valid = (f_b >= 0) & (f_b < flights)
+        v_bc = jnp.clip(v_b, 0, v_n - 1)
+        micro_b = f_b * p + q_b
+        # Stashed at its forward tick f·VP + q + v·P + i.
+        rmask = slot_mask(f_b * vp + q_b + v_b * p + i)
+        x_b = jnp.sum(
+            jnp.where(rmask.reshape((depth,) + (1,) * x_in.ndim), stash, 0.0),
+            axis=0).astype(stash.dtype)
+        seed_here = (i == p - 1) & (v_b == v_n - 1)
+        ct_in = jnp.where(seed_here, dy_t.astype(bwd_recv.dtype), bwd_recv)
+        (_, aux_b), vjp = jax.vjp(
+            lambda cp, xx: run_stage(cp, xx),
+            chunk_of(stage_params, v_bc), x_b)
+        dchunk, dx = vjp((ct_in.astype(y.dtype),
+                          jnp.full_like(aux_b, scale)))
+        dstage = jax.tree.map(
+            lambda acc, g: lax.dynamic_update_index_in_dim(
+                acc,
+                lax.dynamic_index_in_dim(acc, v_bc, 0, keepdims=False)
+                + jnp.where(bwd_valid, g, jnp.zeros_like(g)),
+                v_bc, 0),
+            dstage, dchunk)
+        # Chunk 0's input cotangent on device 0 is d loss / d micro m_b.
+        at_entry = (i == 0) & (v_b == 0) & bwd_valid
+        mmask = (m_idx == micro_b)
+        dmicro = jnp.where(
+            (mmask.reshape((m,) + (1,) * dx.ndim) & at_entry),
+            dx[None].astype(dmicro.dtype), dmicro)
+
+        fwd_send = lax.ppermute(y, axis, perm_fwd)
+        bwd_send = lax.ppermute(
+            jnp.where(bwd_valid, dx, jnp.zeros_like(dx)), axis, perm_bwd)
+        new_carry = (fwd_send, bwd_send, stash, dstage, dhead, dmicro,
+                     loss_acc, metrics_acc, t + 1)
+        return new_carry, None
+
+    carry0 = (zero_act, jnp.zeros_like(zero_act), stash0, dstage0, dhead0,
+              dmicro0, jnp.zeros((), jnp.float32), metrics0,
+              jnp.zeros((), jnp.int32))
+    (_, _, _, dstage, dhead, dmicro, loss_acc, metrics_acc, _), _ = lax.scan(
+        tick, carry0, (injects, lbls))
+
+    dmicro = lax.psum(
+        jnp.where(i == 0, dmicro, jnp.zeros_like(dmicro)), axis)
     loss = lax.psum(loss_acc, axis)
     dhead = jax.tree.map(lambda g: lax.psum(g, axis), dhead)
     metrics = jax.tree.map(lambda g: lax.psum(g, axis), metrics_acc)
