@@ -510,12 +510,15 @@ def _worker_llama(tiny: bool) -> int:
     else:
         cfg = LlamaConfig.llama3_1b()
         seq, per_chip_batch, steps, warmup = 2048, 4, 20, 3
-    if os.environ.get("TPUCFN_BENCH_REMAT") == "0":
-        # Remat trades ~1/3 extra flops for activation memory; when the
-        # model fits without it, turning it off is pure MFU.
+    remat_env = os.environ.get("TPUCFN_BENCH_REMAT")
+    if remat_env is not None:
+        # Remat trades ~1/3 extra flops for activation memory; "0"/none
+        # is pure MFU when the model fits, "dots" keeps MXU outputs and
+        # recomputes only elementwise ops (the usual TPU middle ground).
         import dataclasses
 
-        cfg = dataclasses.replace(cfg, remat=False)
+        cfg = dataclasses.replace(
+            cfg, remat={"0": False, "1": True}.get(remat_env, remat_env))
     per_chip_batch = int(os.environ.get("TPUCFN_BENCH_BATCH", per_chip_batch))
     seq = int(os.environ.get("TPUCFN_BENCH_SEQ", seq))
     steps = int(os.environ.get("TPUCFN_BENCH_STEPS", steps))

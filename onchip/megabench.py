@@ -467,6 +467,16 @@ def main() -> int:
             "TPUCFN_BENCH_STEPS": "8", "TPUCFN_BENCH_WARMUP": "2"},
             critical=False):
         return 44
+    # Selective remat (save-dots): keep MXU outputs, recompute only
+    # elementwise — the middle point between remat-everything (25.9%
+    # analytic MFU) and no-remat (fits-or-not at b4). Numerics-identical
+    # by construction (tests/test_llama.py::test_remat_modes...).
+    if not xla_phase("llama_b4_remat_dots", {
+            "TPUCFN_BENCH_MODEL": "llama", "TPUCFN_BENCH_BATCH": "4",
+            "TPUCFN_BENCH_REMAT": "dots",
+            "TPUCFN_BENCH_STEPS": "8", "TPUCFN_BENCH_WARMUP": "2"},
+            critical=False):
+        return 44
     for k in ("TPUCFN_BENCH_REMAT", "TPUCFN_BENCH_STEPS",
               "TPUCFN_BENCH_WARMUP"):
         os.environ.pop(k, None)

@@ -4,6 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from tpucfn.models.llama import (
@@ -62,6 +63,37 @@ def test_scan_matches_unrolled():
     out_s = scanned.apply({"params": p_scan}, toks)
     out_u = unrolled.apply({"params": p_unroll}, toks)
     np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_u), atol=1e-5)
+
+
+def test_remat_modes_numerics_identical():
+    """remat is a flops/HBM schedule choice, never a numerics one: every
+    policy (full, dots, dots_no_batch, none) must produce the same loss
+    and grads bit-for-bit on CPU."""
+    from tpucfn.models.llama import causal_lm_loss
+
+    toks = jnp.asarray(_tokens(b=2, s=16))
+    base = LlamaConfig.tiny()
+    params = Llama(base).init(jax.random.key(0), toks)["params"]
+
+    def lg(remat):
+        cfg = dataclasses.replace(base, remat=remat)
+        model = Llama(cfg)
+
+        def loss(p):
+            return causal_lm_loss(model.apply({"params": p}, toks), toks)[0]
+
+        return jax.jit(jax.value_and_grad(loss))(params)
+
+    l_ref, g_ref = lg(True)
+    for mode in ("dots", "dots_no_batch", False):
+        l_m, g_m = lg(mode)
+        np.testing.assert_allclose(float(l_m), float(l_ref), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(g_m), jax.tree.leaves(g_ref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6)
+
+    with pytest.raises(ValueError, match="remat="):
+        dataclasses.replace(base, remat="bogus")
 
 
 def test_llama3_8b_param_count():

@@ -35,6 +35,23 @@ from tpucfn.ops.attention import dot_product_attention
 from tpucfn.parallel.sharding import ShardingRules
 
 
+def remat_policy(remat: bool | str):
+    """(do_remat, jax.checkpoint policy) for a ``LlamaConfig.remat``
+    value — shared by the scanned model and the pipeline stage body so
+    both paths honor the same policy vocabulary."""
+    if remat in (True, "full"):
+        return True, None
+    if remat in (False, "none"):
+        return False, None
+    if remat == "dots":
+        return True, jax.checkpoint_policies.checkpoint_dots
+    if remat == "dots_no_batch":
+        return True, jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(
+        f"remat={remat!r} — expected True/'full', 'dots', "
+        "'dots_no_batch', or False/'none'")
+
+
 @dataclasses.dataclass(frozen=True)
 class LlamaConfig:
     vocab_size: int = 128256
@@ -49,12 +66,25 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     scan_layers: bool = True
-    remat: bool = True
+    # Rematerialization policy for the block stack (numerics-identical
+    # across all choices — only the flops/HBM schedule differs):
+    #   True / "full": checkpoint everything (max memory savings, ~1/3
+    #     extra recompute flops) — the fits-anywhere default.
+    #   "dots": jax.checkpoint_policies.checkpoint_dots — keep matmul
+    #     (MXU) outputs, recompute only cheap elementwise ops; the
+    #     standard TPU middle ground when activations almost fit.
+    #   "dots_no_batch": dots_with_no_batch_dims_saveable — save only
+    #     weight-stationary matmuls (Megatron-style selective remat).
+    #   False / "none": no remat (pure MFU when the model fits).
+    remat: bool | str = True
     moe: MoEConfig | None = None  # None = dense SwiGLU MLP
 
     @property
     def head_dim(self) -> int:
         return self.dim // self.n_heads
+
+    def __post_init__(self):
+        remat_policy(self.remat)  # validate early, not at first apply
 
     @classmethod
     def llama3_8b(cls) -> "LlamaConfig":
@@ -160,8 +190,9 @@ class Llama(nn.Module):
         )(tokens)
 
         block = LlamaBlock
-        if cfg.remat and not self.decode:
-            block = nn.remat(block, prevent_cse=False)
+        do_remat, policy = remat_policy(cfg.remat)
+        if do_remat and not self.decode:
+            block = nn.remat(block, prevent_cse=False, policy=policy)
         carry = (x, jnp.asarray(q_offset))
         if cfg.scan_layers:
             carry, _ = nn.scan(

@@ -40,7 +40,8 @@ import flax.linen as nn
 from tpucfn.kernels.ring_attention import ring_attention
 from tpucfn.mesh import AXIS_CONTEXT, AXIS_PIPELINE
 from tpucfn.models.layers import RMSNorm
-from tpucfn.models.llama import LlamaBlock, LlamaConfig, sharding_rules
+from tpucfn.models.llama import (LlamaBlock, LlamaConfig, remat_policy,
+                                 sharding_rules)
 from tpucfn.models.moe import collect_moe_aux
 from tpucfn.ops.attention import dot_product_attention
 from tpucfn.parallel.pipeline import (
@@ -113,6 +114,8 @@ def _make_stage_fn(cfg: LlamaConfig, att, context_parallel: bool,
         else:
             q_off = jnp.zeros((), jnp.int32)
 
+        do_remat, policy = remat_policy(cfg.remat)
+
         def body(carry, layer_params):
             if with_aux:
                 def apply_fn(p, c):
@@ -120,16 +123,18 @@ def _make_stage_fn(cfg: LlamaConfig, att, context_parallel: bool,
                         {"params": p}, c, mutable=["losses"])
                     return out[0], collect_moe_aux(lcl)
 
-                if cfg.remat:
-                    apply_fn = jax.checkpoint(apply_fn, prevent_cse=False)
+                if do_remat:
+                    apply_fn = jax.checkpoint(apply_fn, prevent_cse=False,
+                                              policy=policy)
                 carry, aux = apply_fn(layer_params, carry)
                 return carry, aux
-            if cfg.remat:
+            if do_remat:
                 apply = jax.checkpoint(
                     lambda p, c: LlamaBlock(cfg, att).apply(
                         {"params": p}, c
                     )[0],
                     prevent_cse=False,
+                    policy=policy,
                 )
                 carry = apply(layer_params, carry)
             else:
