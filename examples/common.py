@@ -28,7 +28,13 @@ def add_cluster_args(p: argparse.ArgumentParser) -> None:
                    help="checkpoints, metrics, staged data land here (≈ the EFS mount)")
     p.add_argument("--batch-size", type=int, default=256, help="GLOBAL batch size")
     p.add_argument("--steps", type=int, default=0,
-                   help="hard step cap (0 = run the full epoch budget)")
+                   help="hard step cap that IS the run's budget (0 = the "
+                        "full epoch budget); LR schedules anneal over it")
+    p.add_argument("--stop-after", type=int, default=0,
+                   help="halt once the global step reaches N WITHOUT "
+                        "changing the budget or LR schedule — a simulated "
+                        "interruption/preemption; relaunching resumes the "
+                        "same schedule where it stopped (0 = off)")
     p.add_argument("--num-epochs", type=int, default=1)
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--seed", type=int, default=0)
@@ -189,11 +195,12 @@ def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=(),
             state = trainer.init(jax.random.key(args.seed))
 
         total = args.steps or len(ds) * args.num_epochs
+        halt = min(total, args.stop_after) if args.stop_after else total
         metrics = {}
         with profile_steps(run_dir / "profile", enabled=args.profile):
             for batch in prefetch_to_mesh(ds.batches(None), mesh,
                                           extra_axes=extra_axes):
-                if int(state.step) >= total:
+                if int(state.step) >= halt:
                     break
                 state, metrics = trainer.step(state, batch)
                 step = int(state.step)  # blocks -> honest step timing
@@ -203,7 +210,7 @@ def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=(),
                     logger.log(step, {"time_to_first_step": round(
                         time.perf_counter() - t_start, 2)})
                     t_start = None
-                if step % args.log_every == 0 or step == total:
+                if step % args.log_every == 0 or step == halt:
                     logger.log(step, {**{k: float(v) for k, v in metrics.items()},
                                       "step_time": timer._last or 0.0})
                 if args.eval_every and step % args.eval_every == 0:

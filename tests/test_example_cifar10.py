@@ -66,6 +66,25 @@ def test_cifar10_example_end_to_end(tmp_path):
     assert "resumed" not in r3.stdout
 
 
+def test_cifar10_example_stop_after_keeps_budget(tmp_path):
+    """--stop-after halts execution without redefining the budget: the
+    first leg stops at 4 of a 12-step budget, the relaunch resumes at 4
+    and runs to the SAME 12-step budget (an interruption must not change
+    the LR schedule — using --steps as the cap would anneal a --cosine
+    schedule to zero by the interruption point; observed degrading eval
+    on the full accuracy run)."""
+    r = _run_example(tmp_path, steps=12, extra=("--stop-after", "4", "--cosine"))
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    m = re.search(r"final: step=(\d+)", r.stdout)
+    assert m and int(m.group(1)) == 4
+
+    r2 = _run_example(tmp_path, steps=12, extra=("--cosine",))
+    assert r2.returncode == 0, f"stdout:\n{r2.stdout}\nstderr:\n{r2.stderr}"
+    assert "resumed from step 4" in r2.stdout
+    m = re.search(r"final: step=(\d+)", r2.stdout)
+    assert m and int(m.group(1)) == 12
+
+
 def test_cifar10_example_fsdp_mode(tmp_path):
     r = _run_example(tmp_path, steps=4, extra=("--fsdp", "2"))
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
