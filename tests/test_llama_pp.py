@@ -505,6 +505,54 @@ def test_1f1b_moe_loss_and_grads_match_sequential():
         assert _grad_diff(g_pp, g_ref, path) < 2e-5, path
 
 
+def test_1f1b_interleaved_moe_matches_sequential():
+    """Interleaved (V=2) x MoE: the stage_aux plumbing under the circular
+    flight schedule — loss incl. aux and grads == per-micro sequential."""
+    from tpucfn.models.llama_pp import pipelined_llama_value_and_grad
+
+    mesh = build_mesh(MeshSpec(pipeline=2, expert=2, data=2))
+    cfg = _moe_cfg(4)
+    model = Llama(cfg)
+    toks = jnp.asarray(_tokens())
+    params = model.init(jax.random.key(1), toks)["params"]
+
+    loss_ref = _per_micro_seq_loss(model, toks, num_micro=2)
+    l_ref, g_ref = jax.jit(jax.value_and_grad(loss_ref))(params)
+    l_pp, g_pp = jax.jit(lambda p, t: pipelined_llama_value_and_grad(
+        cfg, mesh, p, t, num_microbatches=2, num_virtual=2))(params, toks)
+
+    np.testing.assert_allclose(float(l_pp), float(l_ref), rtol=1e-5)
+    for path in [("layers", "mlp", "experts/gate_proj/kernel"),
+                 ("layers", "mlp", "router", "kernel"),
+                 ("layers", "attn", "q_proj", "kernel"),
+                 ("embed_tokens", "embedding")]:
+        assert _grad_diff(g_pp, g_ref, path) < 2e-5, path
+
+
+def test_1f1b_interleaved_context_parallel_matches_sequential():
+    """Interleaved (V=2) x ring-attention context parallelism: the
+    reduce_axes path under the flight schedule."""
+    from tpucfn.models.llama_pp import pipelined_llama_value_and_grad
+
+    cfg = _cfg(4)
+    model = Llama(cfg)
+    toks = jnp.asarray(_tokens(b=8, s=32))
+    params = model.init(jax.random.key(1), toks)["params"]
+
+    def loss_ref(p):
+        return causal_lm_loss(model.apply({"params": p}, toks), toks)[0]
+
+    l_ref, g_ref = jax.jit(jax.value_and_grad(loss_ref))(params)
+    mesh = build_mesh(MeshSpec(pipeline=2, context=2, data=2))
+    sharded = _sharded_params(mesh, cfg, params)
+    l_pp, g_pp = jax.jit(lambda p, t: pipelined_llama_value_and_grad(
+        cfg, mesh, p, t, num_microbatches=2, context_parallel=True,
+        num_virtual=2))(sharded, toks)
+    np.testing.assert_allclose(float(l_pp), float(l_ref), rtol=1e-5)
+    assert _grad_diff(g_pp, g_ref, ("layers", "attn", "q_proj", "kernel")) < 1e-5
+    assert _grad_diff(g_pp, g_ref, ("embed_tokens", "embedding")) < 1e-5
+
+
 def test_gpipe_moe_aux_matches_sequential():
     """GPipe x MoE with_aux: (logits, aux) and AD grads through the
     schedule's aux accumulator match the per-micro reference."""
