@@ -219,6 +219,64 @@ def test_convert_cifar_binary(tmp_path):
     assert np.array_equal(batch["image"][0], expect)
 
 
+def test_recordio_roundtrip_and_convert(tmp_path):
+    """MXNet RecordIO: pack → .rec write/read round-trip → convert to
+    shards → stream + decode through the normal path (the reference
+    user's existing im2rec datasets port directly)."""
+    from tpucfn.data.recordio import (
+        convert_recordio,
+        pack_image_record,
+        read_recordio,
+        unpack_image_record,
+        write_recordio,
+    )
+
+    rs = np.random.RandomState(0)
+    imgs = [encode_jpeg(rs.randint(0, 255, (32 + i, 32, 3), dtype=np.uint8))
+            for i in range(7)]  # odd lengths exercise the 4-byte padding
+    labels = rs.randint(0, 5, 7)
+    rec = tmp_path / "train.rec"
+    write_recordio(rec, (pack_image_record(int(l), d, rec_id=i)
+                         for i, (l, d) in enumerate(zip(labels, imgs))))
+
+    got = [unpack_image_record(p) for p in read_recordio(rec)]
+    assert [int(lv[0]) for lv, _ in got] == labels.tolist()
+    assert [d for _, d in got] == imgs
+
+    # multi-label records keep the full vector
+    multi = pack_image_record([1.0, 2.5, -3.0], imgs[0])
+    lv, d = unpack_image_record(multi)
+    assert lv.tolist() == [1.0, 2.5, -3.0] and d == imgs[0]
+
+    out = tmp_path / "shards"
+    paths = convert_recordio(rec, out, num_shards=2)
+    from tpucfn.data.transforms import Compose
+
+    ds = ShardedDataset(paths, batch_size_per_process=7, shuffle=False,
+                        drop_remainder=False,
+                        process_index=0, process_count=1,
+                        transform=Compose([decode_transform(),
+                                           center_crop_resize(32)]))
+    batch = next(ds.epoch(0))
+    assert batch["image"].shape == (7, 32, 32, 3)
+    assert sorted(batch["label"].tolist()) == sorted(labels.tolist())
+
+    # converting a multi-label .rec refuses loudly instead of silently
+    # truncating the label vector
+    multirec = tmp_path / "multi.rec"
+    write_recordio(multirec, iter([multi]))
+    with pytest.raises(NotImplementedError, match="single integer class"):
+        convert_recordio(multirec, tmp_path / "shards2", num_shards=1)
+
+
+def test_recordio_rejects_bad_magic(tmp_path):
+    from tpucfn.data.recordio import read_recordio
+
+    (tmp_path / "bad.rec").write_bytes(b"\x00" * 16)
+    with pytest.raises(ValueError, match="magic"):
+        list(read_recordio(tmp_path / "bad.rec"))
+
+
 def test_convert_cifar_rejects_corrupt(tmp_path):
     (tmp_path / "data_batch_1.bin").write_bytes(b"x" * 1000)  # not a multiple
     with pytest.raises(ValueError, match="corrupt"):

@@ -173,6 +173,11 @@ def cmd_convert_dataset(args) -> int:
 
     if args.kind == "image-tree":
         paths = convert_image_tree(args.src, args.out, num_shards=args.num_shards)
+    elif args.kind == "recordio":
+        from tpucfn.data.recordio import convert_recordio
+
+        paths = convert_recordio(args.src, args.out,
+                                 num_shards=args.num_shards)
     elif args.kind == "token-jsonl":
         paths = convert_token_jsonl(args.src, args.out,
                                     seq_len=args.seq_len,
@@ -265,13 +270,16 @@ def build_parser() -> argparse.ArgumentParser:
 
     cv = sub.add_parser(
         "convert-dataset",
-        help="pack an image tree / CIFAR binary / tokenized jsonl corpus "
-             "into tpurecord shards")
-    cv.add_argument("--kind", choices=["image-tree", "cifar10", "token-jsonl"],
+        help="pack an image tree / CIFAR binary / MXNet RecordIO / "
+             "tokenized jsonl corpus into tpurecord shards")
+    cv.add_argument("--kind",
+                    choices=["image-tree", "cifar10", "recordio",
+                             "token-jsonl"],
                     required=True)
     cv.add_argument("--src", required=True,
                     help="dataset root directory (or .jsonl file for "
-                         "token-jsonl)")
+                         "token-jsonl; .rec file or directory of them "
+                         "for recordio)")
     cv.add_argument("--out", required=True, help="output shard directory")
     cv.add_argument("--num-shards", type=int, default=16)
     cv.add_argument("--test-split", action="store_true",

@@ -23,6 +23,11 @@ from tpucfn.data.convert import (  # noqa: F401
     convert_image_tree,
     upload_shards,
 )
+from tpucfn.data.recordio import (  # noqa: F401
+    convert_recordio,
+    read_recordio,
+    write_recordio,
+)
 from tpucfn.data.synthetic import (  # noqa: F401
     synthetic_cifar10,
     synthetic_imagenet,

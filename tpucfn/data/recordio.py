@@ -1,0 +1,149 @@
+"""MXNet RecordIO — read (and write, for round-trips) the reference's
+on-disk dataset format.
+
+The reference's entire data story was RecordIO: ``im2rec`` packed images
+into ``.rec`` files which were staged from S3 and read by MXNet's
+``ImageRecordIter`` (SURVEY.md §2.1 "S3 data staging", §3.2). A
+reference user switching to tpucfn brings those ``.rec`` files along;
+``tpucfn convert-dataset --kind recordio`` re-packs them into tpurecord
+shards once, after which the normal streaming/decode path applies.
+
+Format (MXNet ``src/io/recordio``-compatible, reimplemented from the
+published format constants — no MXNet code consulted):
+
+* stream of records, each: ``uint32 magic = 0xced7230a``, ``uint32
+  lrec`` (upper 3 bits: continuation flag, lower 29: payload length),
+  ``payload``, zero-padding to a 4-byte boundary.
+* image payloads (``im2rec``/``mx.recordio.pack``) start with IRHeader:
+  ``uint32 flag; float32 label; uint64 id; uint64 id2`` (little-endian,
+  24 bytes). ``flag > 0`` means the scalar label is replaced by ``flag``
+  float32 label values following the header. The rest is the encoded
+  (usually JPEG) image, passed through untouched — decode stays on the
+  training host exactly like the image-tree path.
+
+Multi-part records (continuation flag != 0, used by MXNet for >512MB
+payloads) are refused loudly rather than silently mis-parsed.
+"""
+
+from __future__ import annotations
+
+import struct
+from pathlib import Path
+from typing import Iterator
+
+import numpy as np
+
+from tpucfn.data.records import write_dataset_shards
+
+_MAGIC = 0xCED7230A
+_LEN_MASK = (1 << 29) - 1
+_HDR = struct.Struct("<II")  # magic, lrec
+_IRHEADER = struct.Struct("<IfQQ")  # flag, label, id, id2
+
+
+def read_recordio(path: str | Path) -> Iterator[bytes]:
+    """Yield each record's raw payload from a ``.rec`` file, streaming —
+    im2rec datasets are routinely single multi-GB files, so memory stays
+    at one record."""
+    with Path(path).open("rb") as f:
+        off = 0
+        while True:
+            hdr = f.read(_HDR.size)
+            if not hdr:
+                return
+            if len(hdr) < _HDR.size:
+                raise ValueError(f"{path}: truncated record header at {off}")
+            magic, lrec = _HDR.unpack(hdr)
+            if magic != _MAGIC:
+                raise ValueError(
+                    f"{path}: bad magic {magic:#x} at offset {off} — not "
+                    "MXNet RecordIO (or corrupt)")
+            cflag, length = lrec >> 29, lrec & _LEN_MASK
+            if cflag:
+                raise NotImplementedError(
+                    f"{path}: multi-part record (continuation flag {cflag}) "
+                    f"at offset {off} — payloads over 2^29 bytes are not "
+                    "supported")
+            pad = -length % 4
+            body = f.read(length + pad)
+            if len(body) < length:
+                raise ValueError(f"{path}: truncated payload at {off}")
+            yield body[:length]
+            off += _HDR.size + length + pad
+
+
+def write_recordio(path: str | Path, payloads: Iterator[bytes]) -> None:
+    """Write payloads as a ``.rec`` file (round-trip/testing aid and a
+    migration escape hatch back toward MXNet tooling)."""
+    with Path(path).open("wb") as f:
+        for p in payloads:
+            if len(p) > _LEN_MASK:
+                raise NotImplementedError(
+                    f"payload of {len(p)} bytes exceeds the single-part "
+                    "limit (2^29 - 1)")
+            f.write(_HDR.pack(_MAGIC, len(p)))
+            f.write(p)
+            f.write(b"\x00" * (-len(p) % 4))
+
+
+def pack_image_record(label: float | list[float], data: bytes,
+                      rec_id: int = 0) -> bytes:
+    """IRHeader + encoded image bytes (the ``mx.recordio.pack`` layout)."""
+    labels = np.atleast_1d(np.asarray(label, np.float32))
+    if labels.size == 1:
+        return _IRHEADER.pack(0, float(labels[0]), rec_id, 0) + data
+    return (_IRHEADER.pack(labels.size, 0.0, rec_id, 0)
+            + labels.tobytes() + data)
+
+
+def unpack_image_record(payload: bytes) -> tuple[np.ndarray, bytes]:
+    """(label vector float32, encoded image bytes) from an image record."""
+    if len(payload) < _IRHEADER.size:
+        raise ValueError(f"record of {len(payload)} bytes is shorter than "
+                         "an IRHeader")
+    flag, label, _id, _id2 = _IRHEADER.unpack_from(payload, 0)
+    off = _IRHEADER.size
+    if flag:
+        labels = np.frombuffer(payload, np.float32, count=flag, offset=off)
+        off += 4 * flag
+    else:
+        labels = np.asarray([label], np.float32)
+    return labels, payload[off:]
+
+
+def iter_recordio_images(src: str | Path) -> Iterator[dict]:
+    """Examples ({"image": encoded bytes, "label": int32}) from one
+    ``.rec`` file or a directory of them — the same example schema as
+    :func:`convert.iter_image_tree`, so the downstream decode/augment
+    path is shared."""
+    src = Path(src)
+    files = sorted(src.glob("*.rec")) if src.is_dir() else [src]
+    if not files:
+        raise FileNotFoundError(f"no .rec files under {src}")
+    for f in files:
+        for i, payload in enumerate(read_recordio(f)):
+            labels, data = unpack_image_record(payload)
+            if labels.size != 1 or labels[0] != int(labels[0]):
+                # Multi-label / float-label records exist (detection
+                # boxes, soft labels); silently keeping labels[0] would
+                # produce wrong training data. Refuse loudly — the
+                # pack/unpack API handles these for custom pipelines.
+                raise NotImplementedError(
+                    f"{f} record {i}: label vector {labels.tolist()} is "
+                    "not a single integer class — convert-dataset "
+                    "--kind recordio only maps classification records; "
+                    "use read_recordio/unpack_image_record directly for "
+                    "custom label schemas")
+            yield {
+                "image": np.frombuffer(data, dtype=np.uint8),
+                "label": np.int32(labels[0]),
+            }
+
+
+def convert_recordio(
+    src: str | Path, out_dir: str | Path, *, num_shards: int,
+    prefix: str = "data",
+) -> list[Path]:
+    """``.rec`` file(s) → tpurecord shards of encoded images."""
+    return write_dataset_shards(iter_recordio_images(src), Path(out_dir),
+                                num_shards=num_shards, prefix=prefix)
