@@ -29,6 +29,16 @@ def test_pack_sequences_first_fit():
     np.testing.assert_array_equal(segments[2, 7:], [0])
 
 
+def test_pack_sequences_accepts_one_pass_iterator():
+    """A generator input must survive the min-length pre-scan (which
+    iterates twice) — the pre-scan materializes first (ADVICE r4)."""
+    seqs = [np.arange(1, 5), np.arange(10, 13)]
+    tokens_gen, segs_gen = pack_sequences((s for s in seqs), seq_len=8)
+    tokens_list, segs_list = pack_sequences(seqs, seq_len=8)
+    np.testing.assert_array_equal(tokens_gen, tokens_list)
+    np.testing.assert_array_equal(segs_gen, segs_list)
+
+
 def test_pack_sequences_rejects_overlong_and_empty():
     with pytest.raises(ValueError, match="exceeds"):
         pack_sequences([np.arange(9)], seq_len=8)

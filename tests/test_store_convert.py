@@ -277,6 +277,21 @@ def test_recordio_rejects_bad_magic(tmp_path):
         list(read_recordio(tmp_path / "bad.rec"))
 
 
+def test_recordio_rejects_truncated_padding(tmp_path):
+    """A file cut inside the final record's zero-padding (payload intact)
+    is corrupt and must fail as loudly as a cut inside the payload
+    (ADVICE r4)."""
+    from tpucfn.data.recordio import read_recordio, write_recordio
+
+    rec = tmp_path / "t.rec"
+    write_recordio(rec, iter([b"abcde"]))  # 5 bytes -> 3 bytes padding
+    whole = rec.read_bytes()
+    assert whole[-3:] == b"\x00\x00\x00"
+    rec.write_bytes(whole[:-2])  # payload complete, padding truncated
+    with pytest.raises(ValueError, match="truncated payload"):
+        list(read_recordio(rec))
+
+
 def test_convert_cifar_rejects_corrupt(tmp_path):
     (tmp_path / "data_batch_1.bin").write_bytes(b"x" * 1000)  # not a multiple
     with pytest.raises(ValueError, match="corrupt"):

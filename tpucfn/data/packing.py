@@ -24,7 +24,7 @@ Parity is tested against running each document through the model alone.
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Iterable
 
 import jax
 import jax.numpy as jnp
@@ -32,7 +32,7 @@ import numpy as np
 
 
 def pack_sequences(
-    sequences: Sequence[np.ndarray],
+    sequences: Iterable[np.ndarray],
     seq_len: int,
     *,
     pad_id: int = 0,
@@ -51,11 +51,15 @@ def pack_sequences(
     # row would never have been chosen), but the per-document scan is
     # over OPEN rows only — on real corpora that is what keeps packing
     # from going quadratic in document count (ADVICE r3).
-    lens = [len(np.asarray(s)) for s in sequences]
+    # Materialize first: the pre-scan below iterates the input a second
+    # time, and a one-pass iterator/generator (part of the accepted
+    # Iterable contract) would arrive at the main loop already consumed
+    # (ADVICE r4).
+    sequences = [np.asarray(s) for s in sequences]
+    lens = [len(s) for s in sequences]
     min_len = min((n for n in lens if n > 0), default=0)
     open_rows: list[int] = []  # indices into rows, in creation order
     for seq in sequences:
-        seq = np.asarray(seq)
         if seq.ndim != 1:
             raise ValueError(f"sequences must be rank-1, got shape {seq.shape}")
         if len(seq) > seq_len:

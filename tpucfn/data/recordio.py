@@ -66,7 +66,10 @@ def read_recordio(path: str | Path) -> Iterator[bytes]:
                     "supported")
             pad = -length % 4
             body = f.read(length + pad)
-            if len(body) < length:
+            if len(body) < length + pad:
+                # Covers truncation inside the payload AND inside the
+                # trailing zero-padding — a file cut mid-padding is just
+                # as corrupt and must fail as loudly (ADVICE r4).
                 raise ValueError(f"{path}: truncated payload at {off}")
             yield body[:length]
             off += _HDR.size + length + pad

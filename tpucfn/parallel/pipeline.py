@@ -158,7 +158,14 @@ def bubble_fraction(num_microbatches: int, num_stages: int,
       of :func:`pipeline_1f1b` ``num_virtual``): ticks = MV + PV + P - 2,
       busy = MV per slot → (PV+P-2)/(MV+PV+P-2), strictly below the
       vanilla fraction for the same per-device work (V-times-deeper
-      stages): V·(M + 2(P-1)) chunk-ticks vs MV + PV + P - 2."""
+      stages): V·(M + 2(P-1)) chunk-ticks vs MV + PV + P - 2.
+
+    NOTE on accounting continuity (ADVICE r4): through round 3 the
+    ``1f1b`` schedule returned the gpipe forward-tick figure
+    (P-1)/(M+P-1); round 4 switched it to per-slot accounting, so 1f1b
+    numbers logged by benches/examples before and after are on
+    different scales — recompute rather than compare across rounds, and
+    compare gpipe↔1f1b only via this function at one version."""
     if num_stages <= 1:
         return 0.0
     m, p, v = num_microbatches, num_stages, num_virtual
@@ -495,16 +502,13 @@ def _pipeline_1f1b_interleaved(
         grad_head = jax.value_and_grad(scaled_head, argnums=(0, 1))
         metrics0 = ()
 
-    # Injection / head-label tick tables, built by scatter (ticks are
-    # non-contiguous across flights — static padding alone can't express
-    # the flight gaps).  Garbage rows stay zero; every read is masked.
+    # Microbatches/labels are indexed IN-BODY from the inverse tick maps
+    # (inject at t = f·VP + q, head at t + VP - 1) rather than scattered
+    # into (ticks, ...) scan inputs: the scatter form holds ~V extra
+    # copies of the full microbatch stack in HBM — a real per-device cost
+    # at training activation sizes (ADVICE r4).  Out-of-schedule ticks
+    # index a clipped (arbitrary) row; every consumer is masked.
     m_idx = jnp.arange(m)
-    inj_ticks = (m_idx // p) * vp + (m_idx % p)
-    head_ticks = inj_ticks + vp - 1
-    injects = jnp.zeros((ticks,) + microbatches.shape[1:],
-                        microbatches.dtype).at[inj_ticks].set(microbatches)
-    lbls = jnp.zeros((ticks,) + labels.shape[1:],
-                     labels.dtype).at[head_ticks].set(labels)
 
     zero_act = jnp.zeros_like(microbatches[0])
     stash0 = jnp.zeros((depth,) + microbatches.shape[1:], microbatches.dtype)
@@ -515,10 +519,21 @@ def _pipeline_1f1b_interleaved(
     def slot_mask(slot):
         return (jnp.arange(depth) == slot % depth)
 
-    def tick(carry, xs):
+    def tick(carry, _):
         (fwd_recv, bwd_recv, stash, dstage, dhead, dmicro, loss_acc,
          metrics_acc, t) = carry
-        inject, lbl = xs
+        # Inverse tick maps (see buffer note above): micro injected at
+        # this tick, and the micro whose head fires at this tick (the
+        # head tick is the inject tick shifted by VP - 1).
+        def micro_at(tt):
+            return jnp.clip(
+                jnp.floor_divide(tt, vp) * p + jnp.remainder(tt, vp),
+                0, m - 1)
+
+        inject = lax.dynamic_index_in_dim(
+            microbatches, micro_at(t), 0, keepdims=False)
+        lbl = lax.dynamic_index_in_dim(
+            labels, micro_at(t - (vp - 1)), 0, keepdims=False)
 
         # ---- forward slot: device i runs chunk v_f of micro m_f --------
         w_f = t - i
@@ -595,7 +610,7 @@ def _pipeline_1f1b_interleaved(
               dmicro0, jnp.zeros((), jnp.float32), metrics0,
               jnp.zeros((), jnp.int32))
     (_, _, _, dstage, dhead, dmicro, loss_acc, metrics_acc, _), _ = lax.scan(
-        tick, carry0, (injects, lbls))
+        tick, carry0, None, length=ticks)
 
     dmicro = lax.psum(
         jnp.where(i == 0, dmicro, jnp.zeros_like(dmicro)), axis)
