@@ -161,9 +161,16 @@ def main() -> int:
     attention = (make_ring_attention(
         mesh, hop_attention="flash" if args.ring_flash else "auto")
         if args.context > 1 else None)
-    model = Llama(cfg, **({"attention_fn": attention} if attention else {}))
+    model = Llama(cfg,
+                  **({"attention_fn": attention} if attention else {}),
+                  # expert axis > 1: explicit EP all-to-all dispatch
+                  # inside the MoE layers (single-mesh path only; the
+                  # PP schedules keep MoE stage-local)
+                  **({"ep_mesh": mesh}
+                     if cfg.moe is not None and args.pipeline == 1
+                     and mesh.shape["expert"] > 1 else {}))
     # init sample must divide evenly over the batch/context mesh axes
-    dp = mesh.shape["data"] * mesh.shape["fsdp"]
+    dp = mesh.shape["data"] * mesh.shape["fsdp"] * mesh.shape["expert"]
     sample = jnp.zeros((dp, args.seq_len), jnp.int32)
 
     def init_fn(rng):

@@ -30,7 +30,14 @@ os.chdir(REPO)
 
 STATE = HERE / "megabench_state.json"
 RESULTS = HERE / "megabench_results.jsonl"
+REFRESH = Path(os.environ.get("TPUCFN_BENCH_REFRESH_PATH",
+                              HERE / "refresh_request.json"))
 WATCHDOG_S = float(os.environ.get("MEGABENCH_WATCHDOG_S", "4000"))
+# Resident-service budget (VERDICT r4 #3): after the phase queue drains,
+# keep THIS client alive (the tunnel wedges whenever a client exits) and
+# service fresh-headline requests filed by bench.py, so the driver bench
+# can get a live same-commit number while megabench holds the tunnel.
+SERVE_S = float(os.environ.get("MEGABENCH_SERVE_S", "28800"))
 
 
 def log(msg: str) -> None:
@@ -600,16 +607,72 @@ def main() -> int:
     except OSError as e:
         log(f"tune table copy failed: {e!r}")
 
-    if not (llama_ok and extras_ok):
-        # Completed results above are checkpointed; retrying costs only
-        # the failed model phases. rc 45 keeps the supervisor looping so
-        # a memory fix landing in the worker mid-session gets its shot.
+    final_rc = 0 if (llama_ok and extras_ok) else 45
+
+    # ---- resident refresh service (VERDICT r4 #3) ---------------------
+    # The queue is drained; do NOT exit (exiting wedges the tunnel for
+    # every later client). Hold the client and service bench.py's
+    # refresh requests: the request names the model whose headline to
+    # re-run; the fresh row is recorded under a phase matching that
+    # model's replay prefix (`<headline>_refresh_*`) so bench.py's
+    # _recorded_onchip poll finds it.
+    serve_deadline = time.time() + SERVE_S  # from queue DRAIN, not start
+    base_env = {"TPUCFN_BENCH_MODEL": None, "TPUCFN_BENCH_BATCH": None,
+                "TPUCFN_BENCH_STEPS": None, "TPUCFN_BENCH_WARMUP": None,
+                "TPUCFN_BENCH_OVERLAP": "0", "TPUCFN_BENCH_REMAT": None,
+                "TPUCFN_BENCH_OPT": None, "TPUCFN_BENCH_SEQ": None,
+                "TPUCFN_BENCH_PROFILE": None, "TPUCFN_BENCH_WARM_TTFS": None,
+                "TPUCFN_BENCH_LOADER_WORKERS": None,
+                "TPUCFN_FLASH_MIN_S": None}
+    # Same model -> headline-phase map as bench._recorded_onchip.
+    headline = {"llama": "llama_1b", "bert": "bert_full",
+                "unet": "unet_full", "resnet": "resnet_full"}
+    served = 0
+    while time.time() < serve_deadline:
+        wd.reset()
+        if not _client_alive():
+            log("resident client died — rc 44 so the supervisor reconnects")
+            wd.cancel()
+            return 44
+        if REFRESH.exists():
+            try:
+                req = json.loads(REFRESH.read_text())
+            except (OSError, json.JSONDecodeError):
+                req = {}
+            try:
+                REFRESH.unlink()
+            except OSError:
+                pass
+            served += 1
+            model = req.get("model", "resnet")
+            want = headline.get(model, "resnet_full")
+            phase = f"{want}_refresh_{int(time.time())}"
+            log(f"servicing refresh request {req} -> {phase}")
+            os.environ["TPUCFN_BENCH_PRESET"] = "full"
+            for kk, vv in base_env.items():
+                (os.environ.pop(kk, None) if vv is None
+                 else os.environ.__setitem__(kk, vv))
+            if model in ("llama", "bert", "unet"):
+                os.environ["TPUCFN_BENCH_MODEL"] = model
+            if model == "unet":
+                os.environ["TPUCFN_BENCH_OPT"] = "adafactor"
+            try:
+                rows = run_capturing_json(bench.worker)
+                record(phase, rows[-1] if rows else None)
+            except Exception as exc:  # noqa: BLE001
+                log(f"refresh FAILED: {exc!r}")
+                record(phase, {"error": repr(exc)})
+        time.sleep(15)
+
+    if final_rc:
+        # Retrying costs only the failed model phases (everything else is
+        # checkpointed). rc 45 keeps the supervisor looping so a memory
+        # fix landing in the worker mid-session gets its shot.
         log("megabench complete EXCEPT a model phase (rc 45; retries)")
-        wd.cancel()
-        return 45
-    log("megabench complete")
+    else:
+        log(f"megabench complete (served {served} refresh requests)")
     wd.cancel()
-    return 0
+    return final_rc
 
 
 if __name__ == "__main__":

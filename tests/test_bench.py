@@ -76,6 +76,11 @@ def test_bench_replays_recorded_onchip_result(tmp_path):
         "TPUCFN_BENCH_PROBE_BUDGET_S": "1",
         "TPUCFN_BENCH_PROBE_TIMEOUT_S": "5",
         "TPUCFN_BENCH_PROBE_INTERVAL_S": "1",
+        # A REAL resident megabench may be live on this host: keep the
+        # refresh handshake out of the repo's onchip/ dir and don't wait
+        # on it (it polls a temp results file nobody will write).
+        "TPUCFN_BENCH_REFRESH_PATH": str(tmp_path / "req.json"),
+        "TPUCFN_BENCH_REFRESH_WAIT_S": "1",
     })
     assert r.returncode == 0, f"stderr:\n{r.stderr[-2000:]}"
     rec = json.loads(r.stdout.strip().splitlines()[-1])
@@ -85,3 +90,93 @@ def test_bench_replays_recorded_onchip_result(tmp_path):
     assert d["platform"] == "tpu" and d["mfu"] == 0.31
     assert d["recorded"]["phase"] == "resnet_full"
     assert d["recorded"]["utc"] == "2026-07-29T00:00:00Z"
+    # ts=1.0 is ancient AND the row carries no git_commit — stale either
+    # way (VERDICT r4 weak #3: unknown provenance must not read as fresh).
+    assert d["recorded"]["stale"] is True
+
+
+def test_bench_null_commit_recording_is_stale(tmp_path):
+    """A recent recorded row that predates commit stamping (git_commit
+    null) must be flagged stale: its provenance is unknowable."""
+    import time as _time
+
+    row = {
+        "phase": "resnet_full", "ts": _time.time(), "utc": "now",
+        "result": {"metric": "m", "value": 2.0, "unit": "u",
+                   "vs_baseline": 1.0, "detail": {"platform": "tpu"}}}
+    path = tmp_path / "recorded.jsonl"
+    path.write_text(json.dumps(row) + "\n")
+    r = _run_bench({
+        "PALLAS_AXON_POOL_IPS": "203.0.113.1",
+        "TPUCFN_BENCH_RECORDED_PATH": str(path),
+        "TPUCFN_BENCH_PROBE_BUDGET_S": "1",
+        "TPUCFN_BENCH_PROBE_TIMEOUT_S": "5",
+        "TPUCFN_BENCH_PROBE_INTERVAL_S": "1",
+        "TPUCFN_BENCH_REFRESH_PATH": str(tmp_path / "req.json"),
+        "TPUCFN_BENCH_REFRESH_WAIT_S": "1",
+    })
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["detail"]["backend_mode"] == "tpu-recorded"
+    assert rec["detail"]["recorded"]["git_commit"] is None
+    assert rec["detail"]["recorded"]["stale"] is True
+
+
+def test_bench_refresh_handshake(tmp_path):
+    """While a resident megabench client holds the tunnel, bench.py files
+    a refresh request and serves the freshly recorded row as a LIVE
+    result (backend_mode tpu), not a replay (VERDICT r4 #3). The resident
+    client is faked: a process whose argv matches the pgrep pattern and
+    which services the request file by appending a fresh row."""
+    recorded_path = tmp_path / "recorded.jsonl"
+    req_path = tmp_path / "refresh_request.json"
+    # Old row that must NOT be served (would be the stale-replay answer).
+    recorded_path.write_text(json.dumps({
+        "phase": "resnet_full", "ts": 1.0,
+        "result": {"metric": "m", "value": 1.0, "unit": "u",
+                   "vs_baseline": 0.1, "detail": {"platform": "tpu"}}}) + "\n")
+
+    # the servicer must stamp the CURRENT commit: a mismatch (resident
+    # client running older code) is correctly flagged stale
+    commit = subprocess.run(
+        ["git", "rev-parse", "--short", "HEAD"], cwd=REPO,
+        capture_output=True, text=True).stdout.strip()
+    fake_dir = tmp_path / "onchip"
+    fake_dir.mkdir()
+    servicer = fake_dir / "megabench.py"
+    servicer.write_text(f"""
+import json, time, os
+req = {str(req_path)!r}
+out = {str(recorded_path)!r}
+deadline = time.time() + 110
+while time.time() < deadline:
+    if os.path.exists(req):
+        os.remove(req)
+        row = {{"phase": "resnet_full_refresh_test", "ts": time.time(),
+               "utc": "fresh", "git_commit": {commit!r},
+               "result": {{"metric": "m", "value": 42.0, "unit": "u",
+                          "vs_baseline": 4.2,
+                          "detail": {{"platform": "tpu", "mfu": 0.5}}}}}}
+        with open(out, "a") as f:
+            f.write(json.dumps(row) + "\\n")
+        break
+    time.sleep(0.5)
+time.sleep(30)  # stay alive so pgrep keeps matching while bench polls
+""")
+    proc = subprocess.Popen([sys.executable, str(servicer)])
+    try:
+        r = _run_bench({
+            "PALLAS_AXON_POOL_IPS": "203.0.113.1",
+            "TPUCFN_BENCH_RECORDED_PATH": str(recorded_path),
+            "TPUCFN_BENCH_REFRESH_PATH": str(req_path),
+            "TPUCFN_BENCH_REFRESH_WAIT_S": "90",
+        })
+    finally:
+        proc.terminate()
+        proc.wait()
+    assert r.returncode == 0, f"stderr:\n{r.stderr[-2000:]}"
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["value"] == 42.0, rec
+    d = rec["detail"]
+    assert d["backend_mode"] == "tpu"
+    assert d["recorded"]["stale"] is False
+    assert d["recorded"]["git_commit"] == commit

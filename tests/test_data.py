@@ -97,7 +97,7 @@ def test_prefetch_to_mesh_yields_sharded(tmp_path, mesh_dp8):
     ds = ShardedDataset(paths, batch_size_per_process=16)
     out = list(prefetch_to_mesh(ds.epoch(0), mesh_dp8))
     assert len(out) == 4
-    assert out[0]["image"].sharding.spec == P(("data", "fsdp"))
+    assert out[0]["image"].sharding.spec == P(("data", "fsdp", "expert"))
     assert out[0]["image"].addressable_shards[0].data.shape[0] == 2
 
 

@@ -37,6 +37,7 @@ def test_llama_auto_dispatch_matches_dense(monkeypatch):
     must reproduce the dense default exactly (fwd and grads)."""
     monkeypatch.setattr(auto_mod, "_backend", lambda: "tpu")
     monkeypatch.setenv("TPUCFN_FLASH_MIN_S", "16")
+    monkeypatch.setenv("TPUCFN_FLASH_UNTUNED_MIN_S", "16")
 
     cfg = LlamaConfig.tiny()
     toks = jnp.asarray(np.random.RandomState(0).randint(0, 256, (2, 32)),
@@ -81,6 +82,7 @@ def test_ring_auto_hops(monkeypatch):
 
     monkeypatch.setattr(auto_mod, "_backend", lambda: "tpu")
     monkeypatch.setenv("TPUCFN_FLASH_MIN_S", "8")
+    monkeypatch.setenv("TPUCFN_FLASH_UNTUNED_MIN_S", "8")
 
     mesh = build_mesh(MeshSpec(context=4, data=2))
     rs = np.random.RandomState(0)
@@ -149,11 +151,24 @@ def test_builtin_tune_table_layering(tmp_path, monkeypatch):
     monkeypatch.setattr(fa, "_MEM_CACHE", None)
     table = fa._load()
     key = "TPU v5 lite|causal|8192|128|bfloat16"
-    assert table[key] == (256, 512)  # measured on chip, round 3
+    # blocks measured on chip round 3; speedup vs dense recorded round 5
+    assert table[key][:2] == (256, 512)
+    assert table[key][2] == 15.11
 
     (tmp_path / "user.json").write_text(json.dumps({key: [128, 128]}))
     monkeypatch.setattr(fa, "_MEM_CACHE", None)
     assert fa._load()[key] == (128, 128)
+    # ...and the builtin speedup is honestly dropped (different blocks,
+    # the old measurement doesn't apply)
+    assert fa.lookup_speedup(8192, 128, jnp.bfloat16, True) is None \
+        or fa._load()[key][2:] == ()
+
+    # A LEGACY user entry agreeing with the builtin blocks keeps the
+    # builtin measured speedup (must not flip a measured-winning family
+    # back to the no-evidence rule).
+    (tmp_path / "user.json").write_text(json.dumps({key: [256, 512]}))
+    monkeypatch.setattr(fa, "_MEM_CACHE", None)
+    assert fa._load()[key] == (256, 512, 15.11)
 
 
 def test_full_attention_auto_dispatch_policy(monkeypatch):
@@ -164,6 +179,9 @@ def test_full_attention_auto_dispatch_policy(monkeypatch):
     calls = []
     monkeypatch.setattr(auto_mod, "_backend", lambda: "tpu")
     monkeypatch.setenv("TPUCFN_FLASH_MIN_S", "2048")
+    # this test pins the BOTH-SIDES-LONG rule; drop the untuned-family
+    # guard out of the way (tested separately below)
+    monkeypatch.setenv("TPUCFN_FLASH_UNTUNED_MIN_S", "2048")
 
     import importlib
 
@@ -192,3 +210,47 @@ def test_full_attention_auto_dispatch_policy(monkeypatch):
     auto_mod.full_attention_auto(q1k, q1k, q1k)       # short self -> dense
     assert calls == [("flash", 4096, 4096), ("dense", 4096, 77),
                      ("dense", 1024, 1024)]
+
+
+def test_dispatch_consults_measured_speedup(tmp_path, monkeypatch):
+    """VERDICT r4 #5: dispatch is measurement-backed per (S, D, dtype)
+    family — tuned-and-losing falls back to dense, tuned-and-winning
+    takes flash, never-measured takes flash only past the untuned
+    threshold (the round-4 D=40 UNet regression guard)."""
+    monkeypatch.setattr(auto_mod, "_backend", lambda: "tpu")
+    monkeypatch.setenv("TPUCFN_FLASH_MIN_S", "1024")
+    monkeypatch.setenv("TPUCFN_FLASH_TUNE_CACHE", str(tmp_path / "t.json"))
+    monkeypatch.setattr(flash_autotune, "_MEM_CACHE", None)
+    kind = jax.devices()[0].device_kind
+    (tmp_path / "t.json").write_text(json.dumps({
+        f"{kind}|causal|2048|64|float32": [128, 128, 0.9],   # losing
+        f"{kind}|causal|4096|64|float32": [256, 256, 1.8],   # winning
+        f"{kind}|full|4096|64|float32": [128, 128, 0.95],    # losing
+    }))
+    assert not auto_mod.should_use_flash(2048, d=64, dtype=jnp.float32)
+    assert auto_mod.should_use_flash(4096, d=64, dtype=jnp.float32)
+    assert not auto_mod.should_use_flash_full(4096, 4096, d=64,
+                                              dtype=jnp.float32)
+    # untuned family: dense below the untuned threshold, flash above
+    assert not auto_mod.should_use_flash(4096, d=40, dtype=jnp.float32)
+    assert auto_mod.should_use_flash(8192, d=40, dtype=jnp.float32)
+    assert not auto_mod.should_use_flash_full(4096, 4096, d=40,
+                                              dtype=jnp.float32)
+    # d-less legacy callers keep the pure length rule
+    assert auto_mod.should_use_flash(2048)
+
+
+def test_tune_records_dense_speedup(tmp_path, monkeypatch):
+    """tune() with include_bwd measures XLA dense at the same shape and
+    persists the ratio; lookup_speedup surfaces it to the dispatch."""
+    monkeypatch.setenv("TPUCFN_FLASH_TUNE_CACHE", str(tmp_path / "tune.json"))
+    monkeypatch.setattr(flash_autotune, "_MEM_CACHE", None)
+    res = flash_autotune.tune(128, 32, heads=2, kv_heads=2,
+                              dtype=jnp.float32, candidates=((32, 32),),
+                              iters=1)
+    assert res["speedup_vs_dense"] is not None
+    monkeypatch.setattr(flash_autotune, "_MEM_CACHE", None)
+    assert (flash_autotune.lookup_speedup(128, 32, jnp.float32, True)
+            == res["speedup_vs_dense"])
+    # blocks lookup still works on the 3-field entry
+    assert flash_autotune.lookup(128, 32, jnp.float32, True) == (32, 32)

@@ -78,7 +78,7 @@ def _moe_llama_cfg():
 
 def test_moe_llama_trains(mesh_ep):
     cfg = _moe_llama_cfg()
-    model = Llama(cfg)
+    model = Llama(cfg, ep_mesh=mesh_ep)  # explicit EP all-to-all dispatch
     sample = jnp.zeros((2, 16), jnp.int32)
 
     def init_fn(rng):
@@ -107,6 +107,77 @@ def test_moe_llama_trains(mesh_ep):
         state, m = trainer.step(state, batch)
         first = first if first is not None else float(m["loss"])
     assert float(m["loss"]) < first
+
+
+def test_ep_dispatch_lowers_to_all_to_all(mesh_ep):
+    """VERDICT r4 #6: the expert-sharded step's compiled HLO must contain
+    the EP all-to-all pair, no all-gather, and no collective carrying the
+    FULL (E*C, D) dispatch buffer (the partitioner's default lowering of
+    a sharded scatter is local-scatter + full-buffer all-reduce — exactly
+    what the explicit shard_map dispatch exists to prevent)."""
+    cfg = _moe_llama_cfg()
+    model = Llama(cfg, ep_mesh=mesh_ep)
+    sample = jnp.zeros((2, 16), jnp.int32)
+
+    def init_fn(rng):
+        return model.init(rng, sample)["params"], {}
+
+    def loss_fn(params, mstate, batch, rng):
+        logits, muts = model.apply({"params": params}, batch["tokens"],
+                                   mutable=["losses", "metrics"])
+        loss, acc = causal_lm_loss(logits, batch["tokens"])
+        return loss + collect_moe_aux(muts), ({"accuracy": acc}, mstate)
+
+    trainer = Trainer(mesh_ep, sharding_rules(cfg, tensor=False), loss_fn,
+                      optax.adamw(3e-3), init_fn)
+    state = trainer.init(jax.random.key(0))
+    batch = shard_batch(mesh_ep, {"tokens": np.zeros((8, 16), np.int32)})
+    state, _ = trainer.step(state, batch)  # builds + caches the jit
+    txt = trainer._jit_step.lower(state, batch).compile().as_text()
+
+    collective_lines = [l for l in txt.splitlines()
+                        if "all-to-all(" in l or "all-gather(" in l
+                        or "all-reduce(" in l]
+    assert any("all-to-all(" in l for l in collective_lines), \
+        "no all-to-all in the expert-sharded step"
+    assert not any("all-gather(" in l for l in collective_lines), \
+        "EP dispatch must not all-gather"
+    # Global dispatch buffer at this config: T=8*16=128 tokens, k=2,
+    # cf=2.0, E=4 -> C=128, buffer (E*C, D) = (512, 64). No collective
+    # may carry it (weight grads are (1, 128, 64)/(1, 64, 128); loss
+    # scalars are f32[]).
+    full_buffer = "512,64"
+    offenders = [l.strip()[:120] for l in collective_lines if full_buffer in l]
+    assert not offenders, offenders
+
+
+def test_ep_dispatch_matches_single_device(mesh_ep):
+    """With capacity generous enough that nothing drops, the explicit EP
+    dispatch computes the same function as the single-device ragged path:
+    outputs and parameter gradients match."""
+    cfg = MoEConfig(n_experts=4, top_k=2, capacity_factor=4.0)
+    x = jax.random.normal(jax.random.key(1), (2, 64, 16), jnp.float32)
+
+    ref = MoEMLP(32, cfg, dtype=jnp.float32)
+    variables = ref.init(jax.random.key(0), x)
+    ep = MoEMLP(32, cfg, dtype=jnp.float32, ep_mesh=mesh_ep)
+
+    def fwd(module):
+        def f(params):
+            out, _ = module.apply({"params": params}, x,
+                                  mutable=["losses", "metrics"])
+            return out.sum(), out
+        # jit: the partial-manual shard_map (auto fsdp/tensor axes) is a
+        # jit-context feature — same as every real call site (Trainer).
+        return jax.jit(jax.value_and_grad(f, has_aux=True))
+
+    (s_ref, o_ref), g_ref = fwd(ref)(variables["params"])
+    (s_ep, o_ep), g_ep = fwd(ep)(variables["params"])
+    np.testing.assert_allclose(np.asarray(o_ep), np.asarray(o_ref),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(s_ep), float(s_ref), rtol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5), g_ep, g_ref)
 
 
 def _moe_apply(dispatch, x, capacity_factor=1.25):

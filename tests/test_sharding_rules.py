@@ -97,7 +97,7 @@ def test_named_sharding_tree_binds_mesh(mesh8):
 def test_shard_batch_places_on_batch_axes(mesh8):
     batch = {"x": np.ones((16, 4), np.float32), "y": np.ones((16,), np.int32)}
     out = shard_batch(mesh8, batch)
-    assert out["x"].sharding.spec == P(("data", "fsdp"))
+    assert out["x"].sharding.spec == P(("data", "fsdp", "expert"))
     # 4-way batch split (data=2 * fsdp=2): each device holds 4 rows.
     assert out["x"].addressable_shards[0].data.shape == (4, 4)
     assert isinstance(out["y"], jax.Array)

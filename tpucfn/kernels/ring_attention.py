@@ -72,7 +72,8 @@ def ring_attention(
     if hop_attention == "auto":
         from tpucfn.kernels.auto import should_use_flash
 
-        hop_attention = "flash" if should_use_flash(q.shape[1]) else "dense"
+        hop_attention = ("flash" if should_use_flash(
+            q.shape[1], d=q.shape[-1], dtype=q.dtype) else "dense")
     n = lax.axis_size(axis)
     idx = lax.axis_index(axis)
     sq, sk = q.shape[1], k.shape[1]

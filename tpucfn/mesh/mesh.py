@@ -48,8 +48,12 @@ ALL_AXES = (
 )
 
 # Axes over which the global batch is split. FSDP is "data parallelism with
-# sharded state", so the batch dimension shards over both.
-BATCH_AXES = (AXIS_DATA, AXIS_FSDP)
+# sharded state", so the batch dimension shards over both.  The expert axis
+# is a batch axis too (the standard expert-parallel layout): outside MoE
+# layers its devices do ordinary data-parallel work instead of replicating
+# it, and inside MoE the per-device token shard is what the explicit
+# all-to-all dispatch exchanges over ``expert`` (tpucfn/models/moe.py).
+BATCH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT)
 
 
 @dataclasses.dataclass(frozen=True)

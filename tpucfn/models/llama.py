@@ -110,6 +110,10 @@ class LlamaBlock(nn.Module):
     cfg: LlamaConfig
     attention_fn: AttentionFn = dot_product_attention
     decode: bool = False
+    # Mesh for the MoE explicit expert-parallel dispatch (models/moe.py);
+    # None keeps MoE single-device. Static module metadata, like
+    # attention_fn.
+    ep_mesh: Any = None
 
     @nn.compact
     def __call__(self, carry, _=None):
@@ -125,7 +129,7 @@ class LlamaBlock(nn.Module):
         normed = RMSNorm(cfg.norm_eps, cfg.dtype, name="post_attn_norm")(x)
         if cfg.moe is not None:
             h = MoEMLP(cfg.ffn_dim, cfg.moe, cfg.dtype, cfg.param_dtype,
-                       name="mlp")(normed)
+                       ep_mesh=self.ep_mesh, name="mlp")(normed)
         else:
             h = SwiGLUMLP(cfg.ffn_dim, cfg.dtype, cfg.param_dtype, name="mlp")(normed)
         return (x + h, q_offset), None
@@ -138,6 +142,10 @@ class Llama(nn.Module):
     # everywhere else. Pass an explicit fn (dense, ring, flash) to pin.
     attention_fn: AttentionFn | None = None
     decode: bool = False  # KV-cache autoregressive mode (generation)
+    # Mesh enabling the MoE explicit expert-parallel all-to-all dispatch
+    # when its `expert` axis is >1 (tpucfn/models/moe.py). Pass the
+    # training mesh; None (default) keeps MoE on the single-device path.
+    ep_mesh: Any = None
 
     @nn.compact
     def __call__(self, tokens, *, q_offset=0, return_hidden=False,
@@ -201,10 +209,11 @@ class Llama(nn.Module):
                 split_rngs={"params": True},
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(cfg, attention_fn, self.decode, name="layers")(carry)
+            )(cfg, attention_fn, self.decode, self.ep_mesh,
+              name="layers")(carry)
         else:
             for i in range(cfg.n_layers):
-                carry, _ = block(cfg, attention_fn, self.decode,
+                carry, _ = block(cfg, attention_fn, self.decode, self.ep_mesh,
                                  name=f"layers_{i}")(carry)
         x = carry[0]
 
