@@ -206,20 +206,35 @@ def _request_refresh_and_wait() -> dict | None:
                 "model": os.environ.get("TPUCFN_BENCH_MODEL", "resnet")}, f)
     except OSError:
         return None
+    def _cleanup():
+        # Never leave a request behind: a satisfied poll may have been
+        # answered by the still-draining queue's own headline phase, and
+        # an unserviced file would make the resident client burn a
+        # pointless on-chip run hours later.
+        try:
+            os.remove(req_path)
+        except OSError:
+            pass
+
     while time.time() - t0 < budget_s:
         # Poll BEFORE sleeping (a row serviced in seconds shouldn't wait
         # a full interval), and never sleep past the budget.
         rec = _recorded_onchip()
         if rec is not None and rec.get("ts", 0) >= t0:
+            _cleanup()
             return rec
         if not _megabench_live():
             break  # nobody left to service the request
         time.sleep(min(5.0, max(0.1, budget_s - (time.time() - t0))))
-    try:
-        os.remove(req_path)  # don't leave a stale request behind
-    except OSError:
-        pass
+    _cleanup()
     return None
+
+
+# Model -> recorded-headline phase prefix. Shared with the resident
+# megabench serve loop (it records refresh rows under these prefixes),
+# so the two sides can never drift apart.
+HEADLINE_PHASES = {"llama": "llama_1b", "bert": "bert_full",
+                   "unet": "unet_full", "resnet": "resnet_full"}
 
 
 def _recorded_onchip() -> dict | None:
@@ -231,8 +246,7 @@ def _recorded_onchip() -> dict | None:
     path = os.environ.get("TPUCFN_BENCH_RECORDED_PATH") or os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
         "onchip", "megabench_results.jsonl")
-    want = {"llama": "llama_1b", "bert": "bert_full",
-            "unet": "unet_full"}.get(
+    want = HEADLINE_PHASES.get(
         os.environ.get("TPUCFN_BENCH_MODEL", "resnet"), "resnet_full")
     best = None
     try:
@@ -587,6 +601,22 @@ def _worker_llama(tiny: bool) -> int:
     warmup = int(os.environ.get("TPUCFN_BENCH_WARMUP", warmup))
     global_batch = per_chip_batch * n_dev
 
+    # MoE variant (TPUCFN_BENCH_MOE_EXPERTS=N): sized so an 8-expert
+    # top-2 stack fits one 16G chip with Adafactor. Only the ragged
+    # dispatch is runnable at bench scale — the dense one-hot's (T,E,C)
+    # temporaries are hundreds of GB here, which is the point of the
+    # ragged design (tests/test_moe.py pins the memory analysis).
+    moe_experts = int(os.environ.get("TPUCFN_BENCH_MOE_EXPERTS", "0"))
+    if moe_experts:
+        import dataclasses as _dc
+
+        from tpucfn.models.moe import MoEConfig
+
+        if not tiny:
+            cfg = _dc.replace(cfg, dim=1024, n_layers=8, n_heads=16,
+                              n_kv_heads=8, ffn_dim=4096)
+        cfg = _dc.replace(cfg, moe=MoEConfig(n_experts=moe_experts, top_k=2))
+
     mesh = build_mesh(MeshSpec.for_devices(n_dev))
     model = Llama(cfg)
     sample = jnp.zeros((max(2, n_dev), seq), jnp.int32)
@@ -600,12 +630,21 @@ def _worker_llama(tiny: bool) -> int:
     ce_chunk = int(os.environ.get("TPUCFN_BENCH_CE_CHUNK", "512"))
 
     def loss_fn(params, mstate, batch, rng):
-        h = model.apply({"params": params}, batch["tokens"],
-                        return_hidden=True)
+        if moe_experts:
+            from tpucfn.models.moe import collect_moe_aux
+
+            h, muts = model.apply({"params": params}, batch["tokens"],
+                                  return_hidden=True,
+                                  mutable=["losses", "metrics"])
+            aux = collect_moe_aux(muts)
+        else:
+            h = model.apply({"params": params}, batch["tokens"],
+                            return_hidden=True)
+            aux = 0.0
         loss, acc = chunked_causal_lm_loss(
             h, params["lm_head"]["kernel"], batch["tokens"],
             chunk_size=ce_chunk)
-        return loss, ({"accuracy": acc}, mstate)
+        return loss + aux, ({"accuracy": acc}, mstate)
 
     # Optimizer state is the other memory wall at 1B on one 16 GB chip:
     # AdamW keeps 8 bytes/param (mu+nu fp32) on top of fp32 params and
@@ -636,16 +675,28 @@ def _worker_llama(tiny: bool) -> int:
     if m["peak_bf16_tflops"] and m["platform"] == "tpu":
         m["mfu"] = round(model_flops / n_dev / m["mean_step_s"]
                          / (m["peak_bf16_tflops"] * 1e12), 4)
+    if moe_experts and m.get("mfu") is not None:
+        # Analytic 6*N*tokens over TOTAL params overstates MoE flops
+        # (only top_k/E of expert params are active per token); report
+        # the honest active-fraction MFU alongside.
+        mlp_p = sum(x.size for p, x in jax.tree.flatten_with_path(
+            state.params)[0] if "experts" in str(p))
+        active = (n_params - mlp_p) + mlp_p * cfg.moe.top_k / moe_experts
+        m["mfu_active"] = round(m["mfu"] * active / n_params, 4)
+        m["active_param_fraction"] = round(active / n_params, 4)
     toks_chip = global_batch * seq / m["mean_step_s"] / n_dev
+    size_tag = "llama3_1b" if not tiny else "tiny_llama"
+    if moe_experts:
+        size_tag = (f"moe{moe_experts}x_top2" if not tiny
+                    else f"tiny_moe{moe_experts}x")
     print(json.dumps({
-        "metric": ("llama3_1b_train_tokens_per_sec_per_chip" if not tiny
-                   else "tiny_llama_train_tokens_per_sec_per_chip"),
+        "metric": f"{size_tag}_train_tokens_per_sec_per_chip",
         "value": round(toks_chip, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": 0.0,
         "detail": {"devices": n_dev, "global_batch": global_batch,
                    "seq_len": seq, "optimizer": opt_name,
-                   "ce_chunk": ce_chunk, **m},
+                   "ce_chunk": ce_chunk, "moe_experts": moe_experts, **m},
     }))
     return 0
 

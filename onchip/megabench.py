@@ -573,6 +573,36 @@ def main() -> int:
               "TPUCFN_BENCH_PROFILE", "TPUCFN_BENCH_LOADER_WORKERS"):
         os.environ.pop(k, None)
 
+    # Loader-worker scaling (VERDICT r4 #7): decode-worker count sweep
+    # on the overlap leg. host_cores is recorded in every row, so a
+    # 1-core host's flat/negative scaling cannot overclaim; on a
+    # multi-core TPU-VM host the same phases give the real curve.
+    for tag, w in (("t2", "2"), ("p2", "-2"), ("p4", "-4")):
+        if not xla_phase(f"resnet_loader_{tag}", {
+                "TPUCFN_BENCH_MODEL": None, "TPUCFN_BENCH_BATCH": None,
+                "TPUCFN_BENCH_LOADER_WORKERS": w,
+                "TPUCFN_BENCH_STEPS": "10", "TPUCFN_BENCH_WARMUP": "3",
+                "TPUCFN_BENCH_OVERLAP": "1"}, critical=False):
+            return 44
+    os.environ.pop("TPUCFN_BENCH_LOADER_WORKERS", None)
+    os.environ.pop("TPUCFN_BENCH_OVERLAP", None)
+
+    # MoE on-chip throughput (VERDICT r4 #6 follow-through): ~1B-total
+    # 8-expert top-2 stack, ragged dispatch (the only dispatch that fits
+    # at bench scale — the dense one-hot's (T,E,C) temps are 100s of GB
+    # here). Records tokens/sec + honest active-fraction MFU.
+    if not xla_phase("llama_moe8", {
+            "TPUCFN_BENCH_MODEL": "llama", "TPUCFN_BENCH_BATCH": "4",
+            "TPUCFN_BENCH_MOE_EXPERTS": "8",
+            "TPUCFN_BENCH_OPT": "adafactor",
+            "TPUCFN_BENCH_STEPS": "8", "TPUCFN_BENCH_WARMUP": "2"},
+            critical=False):
+        return 44
+    for k in ("TPUCFN_BENCH_MODEL", "TPUCFN_BENCH_BATCH",
+              "TPUCFN_BENCH_MOE_EXPERTS", "TPUCFN_BENCH_OPT",
+              "TPUCFN_BENCH_STEPS", "TPUCFN_BENCH_WARMUP"):
+        os.environ.pop(k, None)
+
     # LAST (long compile; died UNAVAILABLE untuned): batch-8 UNet via
     # flash — the config dense could not fit at all.
     if not xla_phase("unet_b8_flash_tuned", {
@@ -616,6 +646,16 @@ def main() -> int:
     # re-run; the fresh row is recorded under a phase matching that
     # model's replay prefix (`<headline>_refresh_*`) so bench.py's
     # _recorded_onchip poll finds it.
+    if final_rc:
+        # A model phase failed with a live client: return NOW so the
+        # supervisor's 420s retry loop gets its shot at the failed
+        # phases (rc 45's whole point) — serving would defer that past
+        # the session deadline. The serve loop activates only once the
+        # queue is fully clean.
+        log("megabench complete EXCEPT a model phase (rc 45; retries)")
+        wd.cancel()
+        return final_rc
+
     serve_deadline = time.time() + SERVE_S  # from queue DRAIN, not start
     base_env = {"TPUCFN_BENCH_MODEL": None, "TPUCFN_BENCH_BATCH": None,
                 "TPUCFN_BENCH_STEPS": None, "TPUCFN_BENCH_WARMUP": None,
@@ -624,9 +664,7 @@ def main() -> int:
                 "TPUCFN_BENCH_PROFILE": None, "TPUCFN_BENCH_WARM_TTFS": None,
                 "TPUCFN_BENCH_LOADER_WORKERS": None,
                 "TPUCFN_FLASH_MIN_S": None}
-    # Same model -> headline-phase map as bench._recorded_onchip.
-    headline = {"llama": "llama_1b", "bert": "bert_full",
-                "unet": "unet_full", "resnet": "resnet_full"}
+    headline = bench.HEADLINE_PHASES  # one map, shared with the poller
     served = 0
     while time.time() < serve_deadline:
         wd.reset()
@@ -664,15 +702,9 @@ def main() -> int:
                 record(phase, {"error": repr(exc)})
         time.sleep(15)
 
-    if final_rc:
-        # Retrying costs only the failed model phases (everything else is
-        # checkpointed). rc 45 keeps the supervisor looping so a memory
-        # fix landing in the worker mid-session gets its shot.
-        log("megabench complete EXCEPT a model phase (rc 45; retries)")
-    else:
-        log(f"megabench complete (served {served} refresh requests)")
+    log(f"megabench complete (served {served} refresh requests)")
     wd.cancel()
-    return final_rc
+    return 0
 
 
 if __name__ == "__main__":

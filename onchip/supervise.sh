@@ -10,6 +10,14 @@
 # cap bounds deterministic failures. Never kills a running attempt.
 cd /root/repo
 log=onchip/megabench.log
+# Single-instance guard: two megabench clients racing for the one
+# tunnel slot is worse than none (each wedges the other). flock on a
+# lockfile held for the supervisor's lifetime.
+exec 9>/tmp/tpucfn-supervise.lock
+if ! flock -n 9; then
+  echo "=== another supervisor holds the lock; exiting $(date -u +%FT%TZ) ===" >> "$log"
+  exit 0
+fi
 # Run until the session deadline (default ~11h) rather than a fixed
 # attempt count: fast client-creation failures would otherwise exhaust
 # the cap in under 2h of a 12h session.
@@ -17,6 +25,13 @@ deadline=$(( $(date +%s) + ${SUPERVISE_BUDGET_S:-39600} ))
 attempt=0
 while [ "$(date +%s)" -lt "$deadline" ]; do
   attempt=$((attempt + 1))
+  if pgrep -f "python[^ ]* .*onchip/megabench\.py" > /dev/null; then
+    # A client from another lineage is alive; never race it for the
+    # single tunnel slot.
+    echo "=== attempt $attempt skipped: foreign megabench client alive $(date -u +%FT%TZ) ===" >> "$log"
+    sleep 420
+    continue
+  fi
   echo "=== attempt $attempt $(date -u +%FT%TZ) ===" >> "$log"
   python onchip/megabench.py >> "$log" 2>&1
   rc=$?

@@ -222,6 +222,14 @@ def tune(
     if persist:
         global _MEM_CACHE
         user = _read_table(_cache_path())
+        if speedup is None:
+            # A speedup-less re-tune (fwd-only, or dense errored) must
+            # not erase a previously MEASURED ratio it agrees with on
+            # blocks — same preservation rule as the builtin merge.
+            old = user.get(key)
+            if (old is not None and len(old) >= 3 and old[2] is not None
+                    and tuple(old[:2]) == tuple(best)):
+                speedup = old[2]
         user[key] = tuple(best) + ((speedup,) if speedup is not None else ())
         _save(user)
         _MEM_CACHE = None  # re-merge (builtin + user) on next lookup
