@@ -190,13 +190,18 @@ def main() -> int:
 
         hop = "flash" if args.ring_flash else "auto"
 
+        # PP×EP: expert axis >1 runs the explicit all-to-all dispatch
+        # inline in the stage body (manual over {pipeline, expert}).
+        pp_ep = cfg.moe is not None and mesh.shape["expert"] > 1
+
         def forward(params, tokens):
             """Returns (logits, moe_aux) — aux is 0.0 for dense models."""
             out = pipelined_llama_apply(
                 cfg, mesh, params, tokens,
                 num_microbatches=args.microbatches,
                 context_parallel=args.context > 1,
-                hop_attention=hop, with_aux=cfg.moe is not None)
+                hop_attention=hop, with_aux=cfg.moe is not None,
+                expert_parallel=pp_ep)
             return out if cfg.moe is not None else (out, 0.0)
     else:
         def forward(params, tokens):
@@ -249,7 +254,8 @@ def main() -> int:
                     context_parallel=args.context > 1,
                     hop_attention="flash" if args.ring_flash else "auto",
                     z_loss=args.z_loss, with_metrics=True,
-                    num_virtual=args.pp_virtual)
+                    num_virtual=args.pp_virtual,
+                    expert_parallel=pp_ep)
                 return (loss, metrics["accuracy"]), grads
 
             def pp_loss_bwd(grads, cts):

@@ -114,6 +114,10 @@ class LlamaBlock(nn.Module):
     # None keeps MoE single-device. Static module metadata, like
     # attention_fn.
     ep_mesh: Any = None
+    # True when this block runs inside a shard_map whose manual axes
+    # include `expert` (the pipeline stage body): MoE runs its EP body
+    # inline with locally-declared expert params (models/moe.py).
+    ep_manual: bool = False
 
     @nn.compact
     def __call__(self, carry, _=None):
@@ -129,7 +133,8 @@ class LlamaBlock(nn.Module):
         normed = RMSNorm(cfg.norm_eps, cfg.dtype, name="post_attn_norm")(x)
         if cfg.moe is not None:
             h = MoEMLP(cfg.ffn_dim, cfg.moe, cfg.dtype, cfg.param_dtype,
-                       ep_mesh=self.ep_mesh, name="mlp")(normed)
+                       ep_mesh=self.ep_mesh, ep_manual=self.ep_manual,
+                       name="mlp")(normed)
         else:
             h = SwiGLUMLP(cfg.ffn_dim, cfg.dtype, cfg.param_dtype, name="mlp")(normed)
         return (x + h, q_offset), None

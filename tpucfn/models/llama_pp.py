@@ -38,7 +38,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 import flax.linen as nn
 
 from tpucfn.kernels.ring_attention import ring_attention
-from tpucfn.mesh import AXIS_CONTEXT, AXIS_PIPELINE
+from tpucfn.mesh import AXIS_CONTEXT, AXIS_EXPERT, AXIS_PIPELINE
 from tpucfn.models.layers import RMSNorm
 from tpucfn.models.llama import (LlamaBlock, LlamaConfig, remat_policy,
                                  sharding_rules)
@@ -96,8 +96,31 @@ def _attention_for(context_parallel: bool, hop_attention: str = "auto"):
     return att
 
 
+def _is_expert_leaf(path) -> bool:
+    return any("experts" in str(getattr(k, "key", k)) for k in path)
+
+
+def _ep_layer_specs(layers, *, expert_parallel: bool, chunked: bool = False):
+    """Per-leaf manual specs for the stage shard_map: every leaf splits
+    its leading (layer) dim over ``pipeline``; with ``expert_parallel``
+    the per-expert kernels (path contains ``experts``) additionally
+    split their expert dim manually — stage bodies then see their E/ep
+    local slice, matching MoEMLP's ``ep_manual`` contract.  ``chunked``:
+    interleaved layout (PV, L/PV, ...) puts the expert dim one deeper."""
+    if not expert_parallel:
+        return jax.tree.map(lambda _: P(AXIS_PIPELINE), layers)
+
+    def spec(path, _):
+        if _is_expert_leaf(path):
+            return (P(AXIS_PIPELINE, None, AXIS_EXPERT) if chunked
+                    else P(AXIS_PIPELINE, AXIS_EXPERT))
+        return P(AXIS_PIPELINE)
+
+    return jax.tree_util.tree_map_with_path(spec, layers)
+
+
 def _make_stage_fn(cfg: LlamaConfig, att, context_parallel: bool,
-                   with_aux: bool = False):
+                   with_aux: bool = False, expert_parallel: bool = False):
     def stage_fn(stage_params, h):
         """Apply this stage's layer slice (lax.scan over local layers).
 
@@ -116,10 +139,13 @@ def _make_stage_fn(cfg: LlamaConfig, att, context_parallel: bool,
 
         do_remat, policy = remat_policy(cfg.remat)
 
+        def make_block():
+            return LlamaBlock(cfg, att, ep_manual=expert_parallel)
+
         def body(carry, layer_params):
             if with_aux:
                 def apply_fn(p, c):
-                    out, lcl = LlamaBlock(cfg, att).apply(
+                    out, lcl = make_block().apply(
                         {"params": p}, c, mutable=["losses"])
                     return out[0], collect_moe_aux(lcl)
 
@@ -130,7 +156,7 @@ def _make_stage_fn(cfg: LlamaConfig, att, context_parallel: bool,
                 return carry, aux
             if do_remat:
                 apply = jax.checkpoint(
-                    lambda p, c: LlamaBlock(cfg, att).apply(
+                    lambda p, c: make_block().apply(
                         {"params": p}, c
                     )[0],
                     prevent_cse=False,
@@ -138,7 +164,7 @@ def _make_stage_fn(cfg: LlamaConfig, att, context_parallel: bool,
                 )
                 carry = apply(layer_params, carry)
             else:
-                carry, _ = LlamaBlock(cfg, att).apply(
+                carry, _ = make_block().apply(
                     {"params": layer_params}, carry
                 )
             return carry, None
@@ -178,6 +204,7 @@ def pipelined_llama_apply(
     context_parallel: bool = False,
     hop_attention: str = "auto",
     with_aux: bool = False,
+    expert_parallel: bool = False,
 ):
     """tokens (B, S) → logits (B, S, vocab), numerically equal to
     ``Llama(cfg).apply`` with the same params (tests assert it).
@@ -193,9 +220,20 @@ def pipelined_llama_apply(
     aux is defined per microbatch (matching per-micro sequential
     application, not one full-batch apply); under ``context_parallel``
     routing is additionally block-local per context shard and aux is the
-    mean over shards (see the module-level MoE×CP note)."""
+    mean over shards (see the module-level MoE×CP note).
+
+    ``expert_parallel=True`` (MoE with the mesh's ``expert`` axis >1):
+    the stage shard_map goes manual over {pipeline, expert} together,
+    each microbatch's rows split over ``expert``, and the MoE layers run
+    the explicit all-to-all dispatch inline (``MoEMLP.ep_manual`` — one
+    flat manual region, no nesting). Routing/capacity become local per
+    expert shard (E/ep experts' weights per device), and aux follows the
+    shard-mean convention. In the no-drop regime the layer OUTPUT equals
+    single-device routing, so logits still match the plain model."""
     if not cfg.scan_layers:
         raise ValueError("pipeline execution needs scan_layers=True")
+    if expert_parallel and cfg.moe is None:
+        raise ValueError("expert_parallel requires a MoE config")
 
     att = _attention_for(context_parallel, hop_attention)
 
@@ -203,23 +241,35 @@ def pipelined_llama_apply(
                      param_dtype=cfg.param_dtype)
     x = embed.apply({"params": params["embed_tokens"]}, tokens)
 
-    stage_fn = _make_stage_fn(cfg, att, context_parallel, with_aux=with_aux)
+    stage_fn = _make_stage_fn(cfg, att, context_parallel, with_aux=with_aux,
+                              expert_parallel=expert_parallel)
 
     mb = microbatch(x, num_microbatches)  # (M, B/M, S, D)
-    # Manual over pipeline (and context, when sequence-parallel): specs
-    # name just the manual axes; fsdp/tensor/data shardings flow through
-    # as auto axes.
-    manual = {AXIS_PIPELINE} | ({AXIS_CONTEXT} if context_parallel else set())
-    layer_specs = jax.tree.map(lambda _: P(AXIS_PIPELINE), params["layers"])
-    mb_spec = P(None, None, AXIS_CONTEXT) if context_parallel else P()
+    if expert_parallel and mb.shape[1] % mesh.shape[AXIS_EXPERT]:
+        raise ValueError(
+            f"microbatch rows {mb.shape[1]} not divisible by expert axis "
+            f"{mesh.shape[AXIS_EXPERT]}")
+    # Manual over pipeline (and context/expert when enabled): specs name
+    # just the manual axes; fsdp/tensor/data shardings flow through as
+    # auto axes.
+    manual = ({AXIS_PIPELINE}
+              | ({AXIS_CONTEXT} if context_parallel else set())
+              | ({AXIS_EXPERT} if expert_parallel else set()))
+    layer_specs = _ep_layer_specs(params["layers"],
+                                  expert_parallel=expert_parallel)
+    mb_spec = P(None, AXIS_EXPERT if expert_parallel else None,
+                AXIS_CONTEXT if context_parallel else None)
 
     def run_body(p, xs):
         res = gpipe(stage_fn, p, xs, with_aux=with_aux)
-        if with_aux and context_parallel:
-            # Stage aux is shard-local/C (see _make_stage_fn): summing
-            # over context completes the mean over shards.
+        if with_aux and (context_parallel or expert_parallel):
+            # Stage aux is shard-local, pre-divided by the shard count
+            # (context in _make_stage_fn, expert in MoEMLP.ep_manual):
+            # summing completes the mean over shards.
             ys, aux = res
-            return ys, lax.psum(aux, AXIS_CONTEXT)
+            axes = (((AXIS_CONTEXT,) if context_parallel else ())
+                    + ((AXIS_EXPERT,) if expert_parallel else ()))
+            return ys, lax.psum(aux, axes)
         return res
 
     run = jax.shard_map(
@@ -250,6 +300,7 @@ def pipelined_llama_value_and_grad(
     z_loss: float = 0.0,
     with_metrics: bool = False,
     num_virtual: int = 1,
+    expert_parallel: bool = False,
 ):
     """1F1B-scheduled causal-LM loss and gradients.
 
@@ -283,9 +334,15 @@ def pipelined_llama_value_and_grad(
     if not cfg.scan_layers:
         raise ValueError("pipeline execution needs scan_layers=True")
     with_aux = cfg.moe is not None
+    if expert_parallel and cfg.moe is None:
+        raise ValueError("expert_parallel requires a MoE config")
     att = _attention_for(context_parallel, hop_attention)
     b, s = tokens.shape
     mb_size = b // num_microbatches
+    if expert_parallel and mb_size % mesh.shape[AXIS_EXPERT]:
+        raise ValueError(
+            f"microbatch rows {mb_size} not divisible by expert axis "
+            f"{mesh.shape[AXIS_EXPERT]}")
 
     embed = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype)
@@ -320,7 +377,8 @@ def pipelined_llama_value_and_grad(
         correct = jnp.where(valid, jnp.argmax(logits, -1) == lbl, False)
         return loss, {"accuracy": jnp.sum(correct.astype(jnp.float32)) / denom}
 
-    stage_fn = _make_stage_fn(cfg, att, context_parallel, with_aux=with_aux)
+    stage_fn = _make_stage_fn(cfg, att, context_parallel, with_aux=with_aux,
+                              expert_parallel=expert_parallel)
     mb = microbatch(x, num_microbatches)
     lbl_mb = microbatch(labels, num_microbatches)
 
@@ -340,19 +398,43 @@ def pipelined_llama_value_and_grad(
                          layers_in),
             n_stages, num_virtual)
 
-    manual = {AXIS_PIPELINE} | ({AXIS_CONTEXT} if context_parallel else set())
-    layer_specs = jax.tree.map(lambda _: P(AXIS_PIPELINE), layers_in)
+    manual = ({AXIS_PIPELINE}
+              | ({AXIS_CONTEXT} if context_parallel else set())
+              | ({AXIS_EXPERT} if expert_parallel else set()))
+    layer_specs = _ep_layer_specs(layers_in, expert_parallel=expert_parallel,
+                                  chunked=num_virtual > 1)
     head_specs = jax.tree.map(lambda _: P(), head_params)
-    mb_spec = P(None, None, AXIS_CONTEXT) if context_parallel else P()
+    mb_spec = P(None, AXIS_EXPERT if expert_parallel else None,
+                AXIS_CONTEXT if context_parallel else None)
 
-    run = jax.shard_map(
-        lambda lp, hp, xs, lb: pipeline_1f1b(
+    def run_fn(lp, hp, xs, lb):
+        loss, dstage, dhead, dmicro, metrics = pipeline_1f1b(
             stage_fn, head_fn, lp, hp, xs, lb,
+            # `expert` is deliberately NOT a blanket reduce axis: the
+            # expert-SPLIT stage leaves hold grads for DIFFERENT experts
+            # per shard — a uniform psum would mix them. Selective
+            # reduction below.
             reduce_axes=(AXIS_CONTEXT,) if context_parallel else (),
             stage_aux=with_aux,
             head_metrics=True,
             num_virtual=num_virtual,
-        ),
+        )
+        if expert_parallel:
+            # Each expert shard saw only its token rows: loss, head
+            # grads, metrics, and grads of expert-REPLICATED stage
+            # leaves (attn/norms/router) sum over the expert axis;
+            # expert-split leaves keep their own-expert local grads.
+            dstage = jax.tree_util.tree_map_with_path(
+                lambda path, g: g if _is_expert_leaf(path)
+                else lax.psum(g, AXIS_EXPERT), dstage)
+            dhead = jax.tree.map(lambda g: lax.psum(g, AXIS_EXPERT), dhead)
+            loss = lax.psum(loss, AXIS_EXPERT)
+            metrics = jax.tree.map(
+                lambda g: lax.psum(g, AXIS_EXPERT), metrics)
+        return loss, dstage, dhead, dmicro, metrics
+
+    run = jax.shard_map(
+        run_fn,
         mesh=mesh,
         in_specs=(layer_specs, head_specs, mb_spec, mb_spec),
         out_specs=(P(), layer_specs, head_specs, mb_spec, {"accuracy": P()}),

@@ -41,13 +41,13 @@ the preset conventions (``experts/...`` with a leading expert dim,
 P(expert, fsdp, tensor); per-expert kernels enter the shard_map body
 manual over ``expert`` only, so FSDP keeps its gather-on-use semantics.
 
-Composition note: inside the pipeline schedules (models/llama_pp.py)
-MoE stays on the single-device dispatch — the stage body already runs
-in a shard_map manual over ``pipeline``, and nesting a second manual
-region re-binds the outer axis (see llama_pp's module docstring). PP
-meshes put their non-pipeline devices on fsdp/tensor/context, so
-nothing is lost today; PP×EP over one mesh would need the dispatch
-hoisted into the stage shard_map itself.
+Composition note (PP×EP): inside the pipeline schedules
+(models/llama_pp.py) a nested shard_map would re-bind the outer axis,
+so ``expert_parallel=True`` there instead makes {pipeline, expert}
+jointly manual and this layer runs the SAME all-to-all body inline
+(``ep_manual=True`` — expert params declared at local E/ep size,
+shard-local aux divided by ep for the schedules' psum-mean). Without
+that flag, MoE under PP keeps the single-device dispatch.
 """
 
 from __future__ import annotations
@@ -113,12 +113,66 @@ def _aux_losses(cfg, router_logits, probs, expert_idx, within_cap):
     return lb + zl, dropped
 
 
+def _ep_body(cfg, compute_dtype, logits_g, xt_g, wg_l, wu_l, wd_l, *,
+             ep, cap):
+    """Device-local expert-parallel dispatch body. MUST run where the
+    ``expert`` mesh axis is bound manually — inside MoEMLP's own
+    shard_map (``_ep_apply``) or inside an enclosing manual region that
+    includes ``expert`` (the pipeline stage body, ``ep_manual=True``).
+
+    ``logits_g``/``xt_g`` are this shard's own tokens; ``w*_l`` its
+    E/ep local experts. Routing, capacity and the ragged scatter are
+    fully local; the only expert-axis communication is the
+    ``lax.all_to_all`` pair. Returns (out_local, aux_local,
+    dropped_local) with NO cross-shard reduction — callers own the aux
+    convention (pmean over batch axes / schedule psum)."""
+    e, k = cfg.n_experts, cfg.top_k
+    t_loc, d = xt_g.shape
+    el = e // ep
+    probs, gate_vals, expert_idx, _, pos, within = _route(logits_g, k, cap)
+    ti = jnp.broadcast_to(jnp.arange(t_loc)[:, None],
+                          (t_loc, k)).reshape(-1)
+    slot = jnp.where(within, expert_idx * cap + pos, e * cap).reshape(-1)
+    # Local ragged scatter into this device's (E, C, D) sendbuf.
+    buf = (jnp.zeros((e * cap, d), jnp.float32)
+           .at[slot].add(xt_g[ti].astype(jnp.float32), mode="drop")
+           .reshape(ep, el, cap, d).astype(compute_dtype))
+    # → shard g receives every peer's slice for ITS experts.
+    recv = lax.all_to_all(buf, AXIS_EXPERT, split_axis=0,
+                          concat_axis=0)  # (ep=src, el, cap, d)
+    expert_in = recv.transpose(1, 0, 2, 3).reshape(el, ep * cap, d)
+    h = (nn.silu(jnp.einsum("ecd,edf->ecf", expert_in,
+                            wg_l.astype(compute_dtype)))
+         * jnp.einsum("ecd,edf->ecf", expert_in,
+                      wu_l.astype(compute_dtype)))
+    eo = jnp.einsum("ecf,efd->ecd", h, wd_l.astype(compute_dtype))
+    back = eo.reshape(el, ep, cap, d).transpose(1, 0, 2, 3)
+    # Inverse exchange: ret[j] = shard j's experts' outputs for MY
+    # tokens; flat index (j*el + l)*cap + c matches `slot`.
+    ret = lax.all_to_all(back, AXIS_EXPERT, split_axis=0, concat_axis=0)
+    flat_out = ret.reshape(e * cap, d).astype(jnp.float32)
+    picked = flat_out.at[slot].get(mode="fill", fill_value=0.0)
+    out_g = (picked * gate_vals.reshape(-1)[:, None]).reshape(
+        t_loc, k, d).sum(1)
+    aux, dropped = _aux_losses(cfg, logits_g, probs, expert_idx, within)
+    return out_g.astype(compute_dtype), aux, dropped
+
+
 class MoEMLP(nn.Module):
     """Drop-in replacement for a dense SwiGLU MLP block.
 
     ``ep_mesh``: pass the active ``jax.sharding.Mesh`` to enable the
     explicit expert-parallel dispatch when its ``expert`` axis is >1
     (see module docstring); ``None`` keeps the single-device paths.
+
+    ``ep_manual``: the module is being applied INSIDE a shard_map whose
+    manual axes include ``expert`` (the pipeline stage body). The EP
+    body then runs inline — no nested shard_map — on this shard's
+    tokens, and the expert params are declared at their LOCAL size
+    (E/ep leading dim) to match the manually-split slice the enclosing
+    region hands in. Aux comes back shard-local divided by ep, so the
+    pipeline schedules' psum over ``expert`` (reduce_axes) forms the
+    mean — the same convention as MoE×CP.
     """
 
     ffn_dim: int
@@ -126,6 +180,7 @@ class MoEMLP(nn.Module):
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     ep_mesh: Any = None
+    ep_manual: bool = False
 
     @nn.compact
     def __call__(self, x):  # (B, S, D) -> (B, S, D), plus aux losses via sow
@@ -135,20 +190,46 @@ class MoEMLP(nn.Module):
         k = cfg.top_k
         n_tokens = b * s
 
-        # --- routing (fp32 for a stable softmax) -------------------------
+        ep_inline = lax.axis_size(AXIS_EXPERT) if self.ep_manual else 1
+        if e % max(ep_inline, 1):
+            raise ValueError(
+                f"n_experts {e} not divisible by expert-axis size "
+                f"{ep_inline}")
+        # Local declaration under ep_manual: the enclosing manual region
+        # hands this module its E/ep expert slice, and flax validates
+        # param shapes on apply.
+        e_decl = e // ep_inline if ep_inline > 1 else e
+
+        # --- routing (fp32 for a stable softmax; always over ALL E) ------
         router_logits = nn.DenseGeneral(
             e, use_bias=False, dtype=jnp.float32, param_dtype=self.param_dtype,
             name="router",
         )(x.astype(jnp.float32)).reshape(n_tokens, e)
 
         wg = self.param("experts/gate_proj/kernel", nn.initializers.lecun_normal(),
-                        (e, d, self.ffn_dim), self.param_dtype)
+                        (e_decl, d, self.ffn_dim), self.param_dtype)
         wu = self.param("experts/up_proj/kernel", nn.initializers.lecun_normal(),
-                        (e, d, self.ffn_dim), self.param_dtype)
+                        (e_decl, d, self.ffn_dim), self.param_dtype)
         wd = self.param("experts/down_proj/kernel", nn.initializers.lecun_normal(),
-                        (e, self.ffn_dim, d), self.param_dtype)
+                        (e_decl, self.ffn_dim, d), self.param_dtype)
 
         xt = x.reshape(n_tokens, d)
+
+        if ep_inline > 1:
+            # Inside the enclosing manual region: x is already this
+            # expert shard's token slice; capacity is local by
+            # construction.
+            cap = max(1, int(cfg.capacity_factor * n_tokens * k / e))
+            out, aux, dropped = _ep_body(cfg, self.dtype, router_logits, xt,
+                                         wg, wu, wd, ep=ep_inline, cap=cap)
+            # Shard-local aux / ep: the schedules' psum over `expert`
+            # forms the mean (MoE×CP convention). The metric is pmean'd
+            # here instead — nothing psums the metrics collection, so it
+            # must already BE the mean when sown.
+            self.sow("losses", "moe_aux", aux / ep_inline)
+            self.sow("metrics", "moe_dropped_frac",
+                     lax.pmean(dropped, AXIS_EXPERT))
+            return out.reshape(b, s, d).astype(self.dtype)
 
         ep = (self.ep_mesh.shape.get(AXIS_EXPERT, 1)
               if self.ep_mesh is not None else 1)
@@ -240,44 +321,14 @@ class MoEMLP(nn.Module):
             raise ValueError(
                 f"token count {n_tokens} not divisible by the "
                 f"data*fsdp*expert device product {groups}")
-        el = e // ep
         t_loc = n_tokens // groups
         cap = max(1, int(cfg.capacity_factor * t_loc * k / e))
 
         def body(logits_g, xt_g, wg_l, wu_l, wd_l):
-            probs, gate_vals, expert_idx, _, pos, within = _route(
-                logits_g, k, cap)
-            ti = jnp.broadcast_to(jnp.arange(t_loc)[:, None],
-                                  (t_loc, k)).reshape(-1)
-            slot = jnp.where(within, expert_idx * cap + pos,
-                             e * cap).reshape(-1)
-            # Local ragged scatter into this device's (E, C, D) sendbuf.
-            buf = (jnp.zeros((e * cap, d), jnp.float32)
-                   .at[slot].add(xt_g[ti].astype(jnp.float32), mode="drop")
-                   .reshape(ep, el, cap, d).astype(self.dtype))
-            # → shard g receives every peer's slice for ITS experts.
-            recv = lax.all_to_all(buf, AXIS_EXPERT, split_axis=0,
-                                  concat_axis=0)  # (ep=src, el, cap, d)
-            expert_in = recv.transpose(1, 0, 2, 3).reshape(el, ep * cap, d)
-            h = (nn.silu(jnp.einsum("ecd,edf->ecf", expert_in,
-                                    wg_l.astype(self.dtype)))
-                 * jnp.einsum("ecd,edf->ecf", expert_in,
-                              wu_l.astype(self.dtype)))
-            eo = jnp.einsum("ecf,efd->ecd", h, wd_l.astype(self.dtype))
-            back = eo.reshape(el, ep, cap, d).transpose(1, 0, 2, 3)
-            # Inverse exchange: ret[j] = shard j's experts' outputs for
-            # MY tokens; flat index (j*el + l)*cap + c matches `slot`.
-            ret = lax.all_to_all(back, AXIS_EXPERT, split_axis=0,
-                                 concat_axis=0)
-            flat_out = ret.reshape(e * cap, d).astype(jnp.float32)
-            picked = flat_out.at[slot].get(mode="fill", fill_value=0.0)
-            out_g = (picked * gate_vals.reshape(-1)[:, None]).reshape(
-                t_loc, k, d).sum(1)
-            aux, dropped = _aux_losses(cfg, logits_g, probs, expert_idx,
-                                       within)
+            out_g, aux, dropped = _ep_body(cfg, self.dtype, logits_g, xt_g,
+                                           wg_l, wu_l, wd_l, ep=ep, cap=cap)
             batch_axes = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT)
-            return (out_g.astype(self.dtype),
-                    lax.pmean(aux, batch_axes),
+            return (out_g, lax.pmean(aux, batch_axes),
                     lax.pmean(dropped, batch_axes))
 
         tok_spec = P((AXIS_DATA, AXIS_FSDP, AXIS_EXPERT), None)
