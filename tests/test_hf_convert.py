@@ -53,6 +53,51 @@ def test_hf_llama_tied_embeddings():
     np.testing.assert_allclose(np.asarray(out), ref, atol=2e-4, rtol=2e-4)
 
 
+def _tiny_hf_mixtral(sliding_window=None):
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=128, max_position_embeddings=512,
+        num_local_experts=4, num_experts_per_tok=2,
+        rope_theta=10000.0, rms_norm_eps=1e-5,
+        sliding_window=sliding_window, attention_dropout=0.0)
+    torch.manual_seed(0)
+    return transformers.MixtralForCausalLM(hf_cfg).eval()
+
+
+def test_hf_mixtral_logits_parity():
+    """Mixtral import: our capacity-MoE (exactly-dropless capacity,
+    renormalized-top-k gating) must reproduce HF's dropless sparse MoE
+    logits — a cross-implementation check of routing + expert SwiGLU on
+    top of the attention/RoPE stack."""
+    from tpucfn.models.hf_convert import from_hf_mixtral
+
+    hf = _tiny_hf_mixtral()
+    cfg, params = from_hf_mixtral(hf, dtype=jnp.float32, remat=False)
+    assert cfg.moe is not None and cfg.moe.n_experts == 4
+    assert cfg.moe.capacity_factor == 2.0  # E/k: exactly dropless
+
+    rs = np.random.RandomState(0)
+    toks = rs.randint(0, cfg.vocab_size, (2, 24)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(toks).long()).logits.numpy()
+    out, _ = Llama(cfg).apply(
+        {"params": jax.tree.map(jnp.asarray, params)}, jnp.asarray(toks),
+        mutable=["losses", "metrics"])
+    np.testing.assert_allclose(np.asarray(out), ref, atol=3e-4, rtol=3e-4)
+
+
+def test_hf_mixtral_refuses_sliding_window():
+    from tpucfn.models.hf_convert import config_from_hf_mixtral
+
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, num_key_value_heads=2, intermediate_size=64,
+        num_local_experts=2, num_experts_per_tok=1, sliding_window=1024)
+    with pytest.raises(NotImplementedError, match="sliding_window"):
+        config_from_hf_mixtral(hf_cfg)
+
+
 def test_hf_convert_refuses_unsupported_features():
     from tpucfn.models.hf_convert import config_from_hf, from_hf_llama
 

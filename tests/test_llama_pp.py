@@ -568,6 +568,25 @@ def test_1f1b_expert_parallel_matches_gpipe_expert_parallel():
         assert _grad_diff(g_pp, g_gp, path) < 2e-5, path
 
 
+def test_gpipe_expert_parallel_with_context_logits_match_plain():
+    """PP x EP x CP over one mesh: manual {pipeline, expert, context},
+    microbatch rows split over expert AND sequence split over context
+    (ring attention in the stage body). No-drop regime => logits equal
+    the plain model."""
+    mesh = build_mesh(MeshSpec(pipeline=2, expert=2, context=2))
+    cfg = _ep_cfg()
+    model = Llama(cfg)
+    toks = jnp.asarray(_tokens(b=8, s=32))
+    params = model.init(jax.random.key(0), toks)["params"]
+    ref = model.apply({"params": params}, toks)
+
+    out, aux = jax.jit(lambda p, t: pipelined_llama_apply(
+        cfg, mesh, p, t, num_microbatches=2, with_aux=True,
+        expert_parallel=True, context_parallel=True))(params, toks)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+    assert np.isfinite(float(aux))
+
+
 def test_1f1b_interleaved_expert_parallel_matches_gpipe():
     """Interleaved (V=2) x EP: the chunked expert-weight layout
     (PV, L/PV, E/ep, ...) and the selective grad reduction produce the

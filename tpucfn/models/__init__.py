@@ -1,4 +1,8 @@
 from tpucfn.models.resnet import ResNet, ResNetConfig  # noqa: F401
 from tpucfn.models.llama import Llama, LlamaConfig, causal_lm_loss  # noqa: F401
 from tpucfn.models.bert import Bert, BertConfig, mlm_loss  # noqa: F401
+from tpucfn.models.hf_convert import (  # noqa: F401
+    from_hf_llama,
+    from_hf_mixtral,
+)
 

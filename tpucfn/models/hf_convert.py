@@ -1,12 +1,22 @@
-"""HuggingFace Llama checkpoint import.
+"""HuggingFace Llama / Mixtral checkpoint import.
 
 The adoption path for users arriving with standard weights: map a HF
-``LlamaForCausalLM`` state dict onto the tpucfn param tree (same
-rotate-half RoPE convention, so the mapping is transpose/stack only —
-no head permutation) and derive :class:`LlamaConfig` from the HF config.
-The parity test pins our Llama's logits against the canonical HF torch
-implementation on a tiny random model — a cross-implementation
-correctness check of attention/RoPE/RMSNorm/SwiGLU, not just plumbing.
+``LlamaForCausalLM`` (or ``MixtralForCausalLM``) state dict onto the
+tpucfn param tree (same rotate-half RoPE convention, so the mapping is
+transpose/stack only — no head permutation) and derive
+:class:`LlamaConfig` from the HF config. The parity tests pin our
+models' logits against the canonical HF torch implementations on tiny
+random models — a cross-implementation correctness check of
+attention/RoPE/RMSNorm/SwiGLU (and, for Mixtral, the MoE routing/
+expert math), not just plumbing.
+
+Mixtral routing equivalence: HF's sparse MoE block softmaxes ALL
+router logits, takes top-k, and renormalizes the kept probabilities —
+literally the same order as tpucfn's ``_route``. The only semantic
+difference is that HF is dropless while tpucfn is capacity-based, so
+the import pins ``capacity_factor = E / top_k`` (capacity = every
+token, exactly dropless for ANY routing; lower it after import if you
+accept drops for memory).
 
 Torch is only needed at conversion time (CPU is fine); nothing else in
 tpucfn imports it.
@@ -62,13 +72,14 @@ def _np(x) -> np.ndarray:
     return np.asarray(x, np.float32)
 
 
-def params_from_hf_state_dict(state_dict: Mapping[str, Any],
-                              cfg: LlamaConfig) -> dict:
-    """HF ``model.state_dict()`` → the tpucfn Llama param tree
-    (scan-stacked when ``cfg.scan_layers``).  Torch Linear stores
-    (out, in); flax DenseGeneral kernels are (in, out) — transposed
-    here.  Tied embeddings (no ``lm_head.weight``) reuse the embedding
-    transposed."""
+def _convert_hf_state_dict(state_dict: Mapping[str, Any],
+                           cfg: LlamaConfig, mlp_fn) -> dict:
+    """Shared HF→tpucfn mapping core: embed, tied-or-separate lm_head,
+    attention projections, norms, and the leftover-tensor refusal are
+    identical across architectures; ``mlp_fn(take, lstack)`` supplies
+    the per-architecture MLP sub-dict (dense SwiGLU for Llama, router +
+    stacked experts for Mixtral). Torch Linear stores (out, in); flax
+    DenseGeneral kernels are (in, out) — ``lstack`` transposes."""
     if not cfg.scan_layers:
         raise NotImplementedError(
             "HF import targets the scanned layout (cfg.scan_layers=True) — "
@@ -97,8 +108,7 @@ def params_from_hf_state_dict(state_dict: Mapping[str, Any],
         "attn": {p: {"kernel": lstack(
             "model.layers.{i}.self_attn.%s.weight" % p)}
             for p in ("q_proj", "k_proj", "v_proj", "o_proj")},
-        "mlp": {p: {"kernel": lstack("model.layers.{i}.mlp.%s.weight" % p)}
-                for p in ("gate_proj", "up_proj", "down_proj")},
+        "mlp": mlp_fn(take, lstack),
         "input_norm": {"scale": lstack(
             "model.layers.{i}.input_layernorm.weight", transpose=False)},
         "post_attn_norm": {"scale": lstack(
@@ -119,9 +129,20 @@ def params_from_hf_state_dict(state_dict: Mapping[str, Any],
     if leftover:
         raise NotImplementedError(
             f"unmapped tensors in the HF state dict (first 5: "
-            f"{leftover[:5]}) — this checkpoint uses features tpucfn's "
-            "Llama does not implement (e.g. attention biases)")
+            f"{leftover[:5]}) — this checkpoint uses features tpucfn "
+            "does not implement (e.g. attention biases)")
     return params
+
+
+def params_from_hf_state_dict(state_dict: Mapping[str, Any],
+                              cfg: LlamaConfig) -> dict:
+    """HF Llama ``model.state_dict()`` → the tpucfn param tree
+    (scan-stacked when ``cfg.scan_layers``)."""
+    def mlp(take, lstack):
+        return {p: {"kernel": lstack("model.layers.{i}.mlp.%s.weight" % p)}
+                for p in ("gate_proj", "up_proj", "down_proj")}
+
+    return _convert_hf_state_dict(state_dict, cfg, mlp)
 
 
 def from_hf_llama(hf_model: Any, **config_overrides
@@ -129,3 +150,69 @@ def from_hf_llama(hf_model: Any, **config_overrides
     """(cfg, params) from a live ``transformers.LlamaForCausalLM``."""
     cfg = config_from_hf(hf_model.config, **config_overrides)
     return cfg, params_from_hf_state_dict(hf_model.state_dict(), cfg)
+
+
+def config_from_hf_mixtral(hf_config: Any, **overrides) -> LlamaConfig:
+    """LlamaConfig (with ``moe``) from a transformers ``MixtralConfig``.
+
+    Capacity is pinned exactly dropless (see module docstring) so the
+    converted model reproduces HF's dropless routing bit-for-bit in
+    expectation; aux-loss coefficients are tpucfn defaults (they do not
+    affect the forward)."""
+    import dataclasses
+
+    from tpucfn.models.moe import MoEConfig
+
+    sliding = getattr(hf_config, "sliding_window", None)
+    if sliding is not None:
+        raise NotImplementedError(
+            f"sliding_window={sliding} attention is not implemented "
+            "(tpucfn attends full-causal); converting would silently "
+            "change the attention pattern")
+    base = config_from_hf(
+        # MixtralConfig carries the same attention/embedding fields.
+        hf_config)
+    e = hf_config.num_local_experts
+    k = hf_config.num_experts_per_tok
+    cfg = dataclasses.replace(
+        base, moe=MoEConfig(n_experts=e, top_k=k,
+                            capacity_factor=float(e) / k))
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+def params_from_hf_mixtral_state_dict(state_dict: Mapping[str, Any],
+                                      cfg: LlamaConfig) -> dict:
+    """HF Mixtral ``state_dict()`` → the tpucfn param tree. Attention,
+    norms, embed and head map exactly as Llama (shared core); per-expert
+    torch Linears w1/w3/w2 (gate/up/down, (out, in)) stack into the
+    (E, D, F)/(E, F, D) expert kernels, and the router ``gate`` maps to
+    ``router/kernel`` (D, E)."""
+    if cfg.moe is None:
+        raise ValueError("params_from_hf_mixtral_state_dict needs a MoE "
+                         "config (use config_from_hf_mixtral)")
+    E = cfg.moe.n_experts
+
+    def mlp(take, lstack):
+        def estack(w):  # (L, E, in, out) from per-layer per-expert Linears
+            return np.stack([np.stack([take(
+                f"model.layers.{i}.block_sparse_moe.experts.{e}.{w}.weight"
+            ).T for e in range(E)]) for i in range(cfg.n_layers)])
+
+        return {
+            "router": {"kernel": lstack(
+                "model.layers.{i}.block_sparse_moe.gate.weight")},
+            # Mixtral MLP is w2(silu(w1 x) * w3 x) == our
+            # wd(silu(x wg) * (x wu)).
+            "experts/gate_proj/kernel": estack("w1"),
+            "experts/up_proj/kernel": estack("w3"),
+            "experts/down_proj/kernel": estack("w2"),
+        }
+
+    return _convert_hf_state_dict(state_dict, cfg, mlp)
+
+
+def from_hf_mixtral(hf_model: Any, **config_overrides
+                    ) -> tuple[LlamaConfig, dict]:
+    """(cfg, params) from a live ``transformers.MixtralForCausalLM``."""
+    cfg = config_from_hf_mixtral(hf_model.config, **config_overrides)
+    return cfg, params_from_hf_mixtral_state_dict(hf_model.state_dict(), cfg)

@@ -223,12 +223,15 @@ class MoEMLP(nn.Module):
             out, aux, dropped = _ep_body(cfg, self.dtype, router_logits, xt,
                                          wg, wu, wd, ep=ep_inline, cap=cap)
             # Shard-local aux / ep: the schedules' psum over `expert`
-            # forms the mean (MoE×CP convention). The metric is pmean'd
-            # here instead — nothing psums the metrics collection, so it
-            # must already BE the mean when sown.
+            # forms the mean (MoE×CP convention). The dropped metric is
+            # sown shard-LOCAL: no pipeline schedule plumbs the metrics
+            # collection out of the stage body today (they apply with
+            # mutable=["losses"]), and a cross-shard mean here would
+            # have to know every other manual axis (context, ...) to be
+            # right — leave the raw value for a future consumer to
+            # reduce with full knowledge.
             self.sow("losses", "moe_aux", aux / ep_inline)
-            self.sow("metrics", "moe_dropped_frac",
-                     lax.pmean(dropped, AXIS_EXPERT))
+            self.sow("metrics", "moe_dropped_frac", dropped)
             return out.reshape(b, s, d).astype(self.dtype)
 
         ep = (self.ep_mesh.shape.get(AXIS_EXPERT, 1)
