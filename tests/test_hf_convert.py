@@ -87,6 +87,27 @@ def test_hf_mixtral_logits_parity():
     np.testing.assert_allclose(np.asarray(out), ref, atol=3e-4, rtol=3e-4)
 
 
+def test_hf_mixtral_greedy_decode_parity():
+    """Serving-path cross-check: our KV-cache greedy decode on imported
+    Mixtral weights produces the same tokens as HF's generate — pins
+    MoE routing under decode mode (per-step T=B tokens) plus the cache
+    plumbing, not just the teacher-forced forward. Prompt tokens avoid
+    id 0: HF infers attention_mask from pad_token_id and would mask
+    real 0-tokens."""
+    from tpucfn.models.generate import generate
+    from tpucfn.models.hf_convert import from_hf_mixtral
+
+    hf = _tiny_hf_mixtral()
+    cfg, params = from_hf_mixtral(hf, dtype=jnp.float32, remat=False)
+    prompt = np.random.RandomState(3).randint(1, 256, (2, 8)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf.generate(torch.from_numpy(prompt).long(), max_new_tokens=8,
+                          do_sample=False, pad_token_id=0).numpy()
+    out = generate(cfg, jax.tree.map(jnp.asarray, params),
+                   jnp.asarray(prompt), max_new_tokens=8, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(out)[:, 8:], ref[:, 8:])
+
+
 def test_hf_mixtral_refuses_sliding_window():
     from tpucfn.models.hf_convert import config_from_hf_mixtral
 

@@ -64,6 +64,20 @@ def test_collect_moe_aux_empty_is_zero():
     assert float(collect_moe_aux({})) == 0.0
 
 
+def test_dropless_capacity_factor_exact():
+    """capacity_factor = E/k must be EXACTLY dropless even when k does
+    not divide E: capacity = round(cf*T*k/E) — truncation would let
+    float dust shave one slot (cap = T-1) and silently drop a token.
+    The Mixtral import's parity guarantee relies on this."""
+    for e_, k_, t_ in ((3, 2, 7), (8, 3, 7), (6, 4, 10)):
+        cfg = MoEConfig(n_experts=e_, top_k=k_, capacity_factor=e_ / k_)
+        model = MoEMLP(16, cfg, dtype=jnp.float32)
+        x = jax.random.normal(jax.random.key(0), (1, t_, 8))
+        _, muts = _apply(model, x)
+        dropped = float(jax.tree.leaves(muts["metrics"])[0])
+        assert dropped == 0.0, (e_, k_, t_)
+
+
 @pytest.fixture()
 def mesh_ep():
     return build_mesh(MeshSpec(data=2, expert=4))

@@ -67,9 +67,21 @@ def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
 
 
 def _np(x) -> np.ndarray:
+    """Torch tensor → numpy, PRESERVING dtype: a forced fp32 copy would
+    quadruple host RAM on a real bf16 checkpoint (Mixtral-8x7B's expert
+    stack alone is ~90 GB in fp32). bf16 has no native numpy dtype, so
+    it round-trips through a uint16 view into ``ml_dtypes.bfloat16``
+    (the dtype jax arrays use anyway)."""
     if hasattr(x, "detach"):
-        x = x.detach().cpu().numpy()
-    return np.asarray(x, np.float32)
+        t = x.detach().cpu()
+        import torch
+
+        if t.dtype == torch.bfloat16:
+            import ml_dtypes
+
+            return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+        x = t.numpy()
+    return np.asarray(x)
 
 
 def _convert_hf_state_dict(state_dict: Mapping[str, Any],
@@ -155,10 +167,11 @@ def from_hf_llama(hf_model: Any, **config_overrides
 def config_from_hf_mixtral(hf_config: Any, **overrides) -> LlamaConfig:
     """LlamaConfig (with ``moe``) from a transformers ``MixtralConfig``.
 
-    Capacity is pinned exactly dropless (see module docstring) so the
-    converted model reproduces HF's dropless routing bit-for-bit in
-    expectation; aux-loss coefficients are tpucfn defaults (they do not
-    affect the forward)."""
+    Capacity is pinned exactly dropless (see module docstring): the
+    layer computes ``capacity = round(cf * T * k / E)``, so cf = E/k
+    yields exactly T (round, not truncate — float dust must not shave
+    one slot off when k does not divide E). Aux-loss coefficients are
+    tpucfn defaults (they do not affect the forward)."""
     import dataclasses
 
     from tpucfn.models.moe import MoEConfig

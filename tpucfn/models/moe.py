@@ -219,7 +219,7 @@ class MoEMLP(nn.Module):
             # Inside the enclosing manual region: x is already this
             # expert shard's token slice; capacity is local by
             # construction.
-            cap = max(1, int(cfg.capacity_factor * n_tokens * k / e))
+            cap = max(1, round(cfg.capacity_factor * n_tokens * k / e))
             out, aux, dropped = _ep_body(cfg, self.dtype, router_logits, xt,
                                          wg, wu, wd, ep=ep_inline, cap=cap)
             # Shard-local aux / ep: the schedules' psum over `expert`
@@ -243,7 +243,7 @@ class MoEMLP(nn.Module):
             self.sow("metrics", "moe_dropped_frac", dropped)
             return out.reshape(b, s, d).astype(self.dtype)
 
-        capacity = max(1, int(cfg.capacity_factor * n_tokens * k / e))
+        capacity = max(1, round(cfg.capacity_factor * n_tokens * k / e))
         probs, gate_vals, expert_idx, onehot, pos_in_expert, within_cap = \
             _route(router_logits, k, capacity)
 
@@ -325,7 +325,7 @@ class MoEMLP(nn.Module):
                 f"token count {n_tokens} not divisible by the "
                 f"data*fsdp*expert device product {groups}")
         t_loc = n_tokens // groups
-        cap = max(1, int(cfg.capacity_factor * t_loc * k / e))
+        cap = max(1, round(cfg.capacity_factor * t_loc * k / e))
 
         def body(logits_g, xt_g, wg_l, wu_l, wd_l):
             out_g, aux, dropped = _ep_body(cfg, self.dtype, logits_g, xt_g,
