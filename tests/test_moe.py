@@ -194,6 +194,34 @@ def test_ep_dispatch_matches_single_device(mesh_ep):
         np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5), g_ep, g_ref)
 
 
+def test_ep_dispatch_composes_with_ring_attention():
+    """EP x SP in the non-PP path: ep_mesh dispatch (tokens manual over
+    batch axes) under ring attention (sequence manual over context in
+    its own shard_map). Generous capacity => logits match the plain
+    model."""
+    import dataclasses
+
+    from tpucfn.kernels import make_ring_attention
+
+    mesh = build_mesh(MeshSpec(data=2, expert=2, context=2))
+    cfg = dataclasses.replace(
+        LlamaConfig.tiny(),
+        moe=MoEConfig(n_experts=4, top_k=2, capacity_factor=4.0))
+    toks = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (4, 32)),
+        jnp.int32)
+    plain = Llama(cfg)
+    params = plain.init(jax.random.key(0), toks)["params"]
+    ref, _ = plain.apply({"params": params}, toks,
+                         mutable=["losses", "metrics"])
+
+    model = Llama(cfg, attention_fn=make_ring_attention(mesh), ep_mesh=mesh)
+    out, _ = jax.jit(lambda p, t: model.apply(
+        {"params": p}, t, mutable=["losses", "metrics"]))(params, toks)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4)
+
+
 def _moe_apply(dispatch, x, capacity_factor=1.25):
     import dataclasses
 
