@@ -72,6 +72,59 @@ def test_restore_onto_different_mesh(tmp_path):
     np.testing.assert_allclose(np.asarray(restored.params["w"]), w_saved, rtol=1e-6)
 
 
+def test_moe_restore_onto_expert_sharded_mesh(tmp_path):
+    """Resize/resume for MoE: a checkpoint trained WITHOUT expert
+    parallelism (expert axis 1, implicit dispatch) restores onto an
+    expert=4 mesh and continues training through the explicit
+    all-to-all dispatch — the param tree is identical, only placement
+    and dispatch change (SURVEY.md §3.5 resize semantics)."""
+    import dataclasses
+
+    from tpucfn.models.llama import (Llama, LlamaConfig, causal_lm_loss,
+                                     sharding_rules)
+    from tpucfn.models.moe import MoEConfig, collect_moe_aux
+
+    cfg = dataclasses.replace(
+        LlamaConfig.tiny(),
+        moe=MoEConfig(n_experts=4, top_k=2, capacity_factor=2.0))
+    sample = jnp.zeros((2, 16), jnp.int32)
+
+    def make_trainer(mesh, model):
+        def init_fn(rng):
+            return model.init(rng, sample)["params"], {}
+
+        def loss_fn(params, mstate, batch, rng):
+            logits, muts = model.apply({"params": params}, batch["tokens"],
+                                       mutable=["losses", "metrics"])
+            loss, acc = causal_lm_loss(logits, batch["tokens"])
+            return loss + collect_moe_aux(muts), ({"accuracy": acc}, mstate)
+
+        return Trainer(mesh, sharding_rules(cfg, tensor=False), loss_fn,
+                       optax.adamw(3e-3), init_fn)
+
+    toks = {"tokens": np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (8, 16)).astype(np.int32)}
+
+    mesh_a = build_mesh(MeshSpec(data=8))  # no expert sharding
+    tr_a = make_trainer(mesh_a, Llama(cfg))
+    state = tr_a.init(jax.random.key(0))
+    state, _ = tr_a.step(state, shard_batch(mesh_a, toks))
+    with CheckpointManager(tmp_path / "ckpt") as mgr:
+        mgr.save(1, state)
+        mgr.wait()
+
+        mesh_b = build_mesh(MeshSpec(data=2, expert=4))
+        tr_b = make_trainer(mesh_b, Llama(cfg, ep_mesh=mesh_b))
+        restored = mgr.restore(tr_b.abstract_state())
+    wk = restored.params["layers"]["mlp"]["experts/gate_proj/kernel"]
+    assert wk.sharding.spec == P(None, "expert", "fsdp")
+    first = None
+    for _ in range(4):
+        restored, m = tr_b.step(restored, shard_batch(mesh_b, toks))
+        first = first if first is not None else float(m["loss"])
+    assert np.isfinite(float(m["loss"])) and float(m["loss"]) < first
+
+
 def test_latest_step_and_missing(tmp_path, mesh_dp8):
     trainer = _trainer(mesh_dp8)
     state = trainer.init(jax.random.key(0))
