@@ -222,6 +222,33 @@ def test_ep_dispatch_composes_with_ring_attention():
                                atol=2e-4)
 
 
+def test_ep_dispatch_composes_with_ulysses():
+    """EP x Ulysses SP: the head all-to-all (context axis) and the
+    expert all-to-all (expert axis) in one step; logits match the plain
+    model in the no-drop regime."""
+    import dataclasses
+
+    from tpucfn.kernels import make_ulysses_attention
+
+    mesh = build_mesh(MeshSpec(data=2, expert=2, context=2))
+    cfg = dataclasses.replace(
+        LlamaConfig.tiny(),
+        moe=MoEConfig(n_experts=4, top_k=2, capacity_factor=4.0))
+    toks = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (4, 32)),
+        jnp.int32)
+    plain = Llama(cfg)
+    params = plain.init(jax.random.key(0), toks)["params"]
+    ref, _ = plain.apply({"params": params}, toks,
+                         mutable=["losses", "metrics"])
+
+    model = Llama(cfg, attention_fn=make_ulysses_attention(mesh),
+                  ep_mesh=mesh)
+    out, _ = jax.jit(lambda p, t: model.apply(
+        {"params": p}, t, mutable=["losses", "metrics"]))(params, toks)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+
 def _moe_apply(dispatch, x, capacity_factor=1.25):
     import dataclasses
 
