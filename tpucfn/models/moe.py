@@ -191,14 +191,14 @@ class MoEMLP(nn.Module):
         n_tokens = b * s
 
         ep_inline = lax.axis_size(AXIS_EXPERT) if self.ep_manual else 1
-        if e % max(ep_inline, 1):
+        if e % ep_inline:
             raise ValueError(
                 f"n_experts {e} not divisible by expert-axis size "
                 f"{ep_inline}")
         # Local declaration under ep_manual: the enclosing manual region
         # hands this module its E/ep expert slice, and flax validates
         # param shapes on apply.
-        e_decl = e // ep_inline if ep_inline > 1 else e
+        e_decl = e // ep_inline
 
         # --- routing (fp32 for a stable softmax; always over ALL E) ------
         router_logits = nn.DenseGeneral(
