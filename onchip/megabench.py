@@ -432,10 +432,84 @@ def main() -> int:
             record(phase, {"error": repr(e)})
         mark_done(state, phase)
 
+    # Pending phases are ordered by expected value per on-chip minute
+    # (round 5: a late short tunnel window should capture the answers
+    # the VERDICT asked for before any diagnostics).
     tune_full_phase("tune_full_s4k_d40", 4096, 40)
 
-    # Llama-1B's head_dim is 64 (2048/32) — the causal table only has
-    # D=128 entries, so its flash path ran untuned 128/128 blocks.
+    # (1) The round-4 regression re-measure: does tuned-D40 flash beat
+    # dense 14.09 latents/s at b4?
+    if not xla_phase("unet_b4_flash_tuned", {
+            "TPUCFN_BENCH_MODEL": "unet", "TPUCFN_BENCH_BATCH": "4",
+            "TPUCFN_BENCH_OPT": "adafactor"}, critical=False):
+        return 44
+    os.environ.pop("TPUCFN_BENCH_OPT", None)
+
+    # (2) The MFU lever. Selective remat (save-dots): keep MXU outputs,
+    # recompute only elementwise — the middle point between
+    # remat-everything (25.9% analytic MFU) and no-remat (fits-or-not
+    # at b4). Numerics-identical by construction
+    # (tests/test_llama.py::test_remat_modes...).
+    if not xla_phase("llama_b4_remat_dots", {
+            "TPUCFN_BENCH_MODEL": "llama", "TPUCFN_BENCH_BATCH": "4",
+            "TPUCFN_BENCH_REMAT": "dots",
+            "TPUCFN_BENCH_STEPS": "8", "TPUCFN_BENCH_WARMUP": "2"},
+            critical=False):
+        return 44
+    # No-remat retry: the pre-chunked-CE attempt OOMed, but with the
+    # logits tensor gone and factored opt state the activation stash
+    # (~4G at b4) should fit — remat off removes the recompute flops,
+    # a direct tokens/sec lever.
+    if not xla_phase("llama_b4_noremat_v2", {
+            "TPUCFN_BENCH_MODEL": "llama", "TPUCFN_BENCH_BATCH": "4",
+            "TPUCFN_BENCH_REMAT": "0",
+            "TPUCFN_BENCH_STEPS": "8", "TPUCFN_BENCH_WARMUP": "2"},
+            critical=False):
+        return 44
+    for k in ("TPUCFN_BENCH_REMAT", "TPUCFN_BENCH_STEPS",
+              "TPUCFN_BENCH_WARMUP"):
+        os.environ.pop(k, None)
+
+    # (3) Warm time-to-first-step (a named north-star metric): re-lower
+    # + re-compile the headline ResNet step against the persistent XLA
+    # cache earlier phases populated — compile_warm_s vs compile_s is
+    # the relaunch-on-the-same-pod story. Doubles as the b256 roofline
+    # row (bytes accessed + hbm_util recorded).
+    if not xla_phase("resnet_ttfs_warm", {
+            "TPUCFN_BENCH_MODEL": None, "TPUCFN_BENCH_BATCH": None,
+            "TPUCFN_BENCH_WARM_TTFS": "1", "TPUCFN_BENCH_STEPS": "8",
+            "TPUCFN_BENCH_WARMUP": "2", "TPUCFN_BENCH_OVERLAP": "0"},
+            critical=False):
+        return 44
+    # Roofline at the best-MFU batch: mfu vs hbm_util names the bound.
+    if not xla_phase("resnet_roofline_b1024", {
+            "TPUCFN_BENCH_MODEL": None, "TPUCFN_BENCH_BATCH": "1024",
+            "TPUCFN_BENCH_WARM_TTFS": None, "TPUCFN_BENCH_STEPS": "8",
+            "TPUCFN_BENCH_WARMUP": "2", "TPUCFN_BENCH_OVERLAP": "0"},
+            critical=False):
+        return 44
+    # XProf traces of the steady-state step: artifacts land in
+    # onchip/traces/, row records file list + sizes.
+    if not xla_phase("resnet_profiled", {
+            "TPUCFN_BENCH_MODEL": None, "TPUCFN_BENCH_BATCH": None,
+            "TPUCFN_BENCH_PROFILE": str(HERE / "traces" / "resnet"),
+            "TPUCFN_BENCH_STEPS": "6", "TPUCFN_BENCH_WARMUP": "2",
+            "TPUCFN_BENCH_OVERLAP": "0"}, critical=False):
+        return 44
+    if not xla_phase("llama_profiled", {
+            "TPUCFN_BENCH_MODEL": "llama", "TPUCFN_BENCH_BATCH": "4",
+            "TPUCFN_BENCH_PROFILE": str(HERE / "traces" / "llama"),
+            "TPUCFN_BENCH_STEPS": "4", "TPUCFN_BENCH_WARMUP": "1"},
+            critical=False):
+        return 44
+    for k in ("TPUCFN_BENCH_MODEL", "TPUCFN_BENCH_BATCH",
+              "TPUCFN_BENCH_STEPS", "TPUCFN_BENCH_WARMUP",
+              "TPUCFN_BENCH_OVERLAP", "TPUCFN_BENCH_WARM_TTFS",
+              "TPUCFN_BENCH_PROFILE"):
+        os.environ.pop(k, None)
+
+    # (4) Llama-1B's head_dim is 64 (2048/32) — the causal table only
+    # has D=128 entries, so its flash path ran untuned 128/128 blocks.
     def tune_causal_phase(phase, s, d, heads, kv_heads, batch=4):
         if phase in state["done"]:
             return
@@ -460,41 +534,15 @@ def main() -> int:
             critical=False):
         return 44
     os.environ.pop("TPUCFN_BENCH_MODEL", None)
-    if not xla_phase("unet_b4_flash_tuned", {
-            "TPUCFN_BENCH_MODEL": "unet", "TPUCFN_BENCH_BATCH": "4",
-            "TPUCFN_BENCH_OPT": "adafactor"}, critical=False):
-        return 44
-    # No-remat retry: the pre-chunked-CE attempt OOMed, but with the
-    # logits tensor gone and factored opt state the activation stash
-    # (~4G at b4) should fit — remat off removes the recompute flops,
-    # a direct tokens/sec lever.
-    if not xla_phase("llama_b4_noremat_v2", {
-            "TPUCFN_BENCH_MODEL": "llama", "TPUCFN_BENCH_BATCH": "4",
-            "TPUCFN_BENCH_REMAT": "0",
-            "TPUCFN_BENCH_STEPS": "8", "TPUCFN_BENCH_WARMUP": "2"},
-            critical=False):
-        return 44
-    # Selective remat (save-dots): keep MXU outputs, recompute only
-    # elementwise — the middle point between remat-everything (25.9%
-    # analytic MFU) and no-remat (fits-or-not at b4). Numerics-identical
-    # by construction (tests/test_llama.py::test_remat_modes...).
-    if not xla_phase("llama_b4_remat_dots", {
-            "TPUCFN_BENCH_MODEL": "llama", "TPUCFN_BENCH_BATCH": "4",
-            "TPUCFN_BENCH_REMAT": "dots",
-            "TPUCFN_BENCH_STEPS": "8", "TPUCFN_BENCH_WARMUP": "2"},
-            critical=False):
-        return 44
-    for k in ("TPUCFN_BENCH_REMAT", "TPUCFN_BENCH_STEPS",
-              "TPUCFN_BENCH_WARMUP"):
-        os.environ.pop(k, None)
-    # Serving-side: KV-cache decode tokens/sec (net-new vs the
+
+    # (5) Serving-side: KV-cache decode tokens/sec (net-new vs the
     # training-only reference).
     if not xla_phase("llama_decode", {
             "TPUCFN_BENCH_MODEL": "llama-decode",
             "TPUCFN_BENCH_BATCH": None}, critical=False):
         return 44
 
-    # ---- round-4 phases (VERDICT r3 items 2-4, 7) ---------------------
+    # ---- diagnostics (answer questions, not headlines) ----------------
     # Model-level flash-vs-dense at the S=2048 headline: the kernel
     # microbench says flash ~breaks even there; this decides whether the
     # auto-dispatch default earns its keep IN the training step. Named
@@ -524,42 +572,10 @@ def main() -> int:
               "TPUCFN_BENCH_STEPS", "TPUCFN_BENCH_WARMUP"):
         os.environ.pop(k, None)
 
-    # Warm time-to-first-step (VERDICT item 7): this phase re-lowers and
-    # re-compiles the headline ResNet step against the persistent XLA
-    # cache that earlier phases populated — compile_warm_s vs compile_s
-    # is the relaunch-on-the-same-pod story. Doubles as the b256
-    # roofline row (bytes accessed + hbm_util now recorded).
-    if not xla_phase("resnet_ttfs_warm", {
-            "TPUCFN_BENCH_MODEL": None, "TPUCFN_BENCH_BATCH": None,
-            "TPUCFN_BENCH_WARM_TTFS": "1", "TPUCFN_BENCH_STEPS": "8",
-            "TPUCFN_BENCH_WARMUP": "2", "TPUCFN_BENCH_OVERLAP": "0"},
-            critical=False):
-        return 44
-    # Roofline at the best-MFU batch: mfu vs hbm_util names the bound.
-    if not xla_phase("resnet_roofline_b1024", {
-            "TPUCFN_BENCH_MODEL": None, "TPUCFN_BENCH_BATCH": "1024",
-            "TPUCFN_BENCH_WARM_TTFS": None, "TPUCFN_BENCH_STEPS": "8",
-            "TPUCFN_BENCH_WARMUP": "2", "TPUCFN_BENCH_OVERLAP": "0"},
-            critical=False):
-        return 44
-    # XProf traces of the steady-state step (VERDICT item 3): artifacts
-    # land in onchip/traces/, row records file list + sizes.
-    if not xla_phase("resnet_profiled", {
-            "TPUCFN_BENCH_MODEL": None, "TPUCFN_BENCH_BATCH": None,
-            "TPUCFN_BENCH_PROFILE": str(HERE / "traces" / "resnet"),
-            "TPUCFN_BENCH_STEPS": "6", "TPUCFN_BENCH_WARMUP": "2",
-            "TPUCFN_BENCH_OVERLAP": "0"}, critical=False):
-        return 44
-    if not xla_phase("llama_profiled", {
-            "TPUCFN_BENCH_MODEL": "llama", "TPUCFN_BENCH_BATCH": "4",
-            "TPUCFN_BENCH_PROFILE": str(HERE / "traces" / "llama"),
-            "TPUCFN_BENCH_STEPS": "4", "TPUCFN_BENCH_WARMUP": "1"},
-            critical=False):
-        return 44
-    # MultiProcessLoader overlap leg (VERDICT item 2): 2 spawn decode
-    # workers. This host has 1 core, so the expected result is "measured,
-    # machinery works, still host-bound" — recorded with host_cores so
-    # the number can't overclaim.
+    # MultiProcessLoader overlap leg: 2 spawn decode workers. This host
+    # has 1 core, so the expected result is "measured, machinery works,
+    # still host-bound" — recorded with host_cores so the number can't
+    # overclaim.
     if not xla_phase("resnet_overlap_mp", {
             "TPUCFN_BENCH_MODEL": None, "TPUCFN_BENCH_BATCH": None,
             "TPUCFN_BENCH_PROFILE": None,
