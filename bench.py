@@ -287,21 +287,28 @@ def orchestrate() -> int:
             fresh = _request_refresh_and_wait()
             if fresh is not None:
                 result = fresh["result"]
-                mode = "tpu"
                 # Fresh in time, but the resident client may be running
                 # OLDER code than this invocation: the same commit rule
                 # as the replay tier applies (a mismatch or an unstamped
-                # row is stale even if serviced seconds ago).
+                # row is stale even if serviced seconds ago) — and a
+                # stale refresh is published at the SAME tier as a
+                # stale replay, 'tpu-recorded', not as a live 'tpu' row
+                # with a buried stale flag (ADVICE r5).
                 now_commit = _git_commit()
                 fresh_commit = fresh.get("git_commit")
+                stale = bool(fresh_commit is None
+                             or (now_commit and fresh_commit != now_commit))
+                mode = "tpu-recorded" if stale else "tpu"
+                if stale:
+                    notes.append(
+                        "refresh row git_commit missing/mismatched — "
+                        "demoted to tpu-recorded")
                 result.setdefault("detail", {})["recorded"] = {
                     "phase": fresh.get("phase"), "utc": fresh.get("utc"),
                     "age_s": round(time.time() - fresh.get("ts", time.time())),
                     "git_commit": fresh_commit,
                     "current_commit": now_commit,
-                    "stale": bool(fresh_commit is None
-                                  or (now_commit
-                                      and fresh_commit != now_commit)),
+                    "stale": stale,
                     "source": "megabench resident client — fresh run "
                               "serviced for this bench invocation"}
             else:

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Continuous-batching serving benchmark (tpucfn.serve).
+
+Drives a synthetic mixed prefill/decode workload — Zipf-ish spread of
+prompt lengths, Poisson-ish arrival jitter is deliberately OMITTED
+(open-loop arrivals would measure the queue, not the engine; every
+request is submitted up front so the scheduler stays saturated) —
+through the full Server → scheduler → engine path and prints ONE JSON
+line in the standard BENCH row schema:
+
+    {"metric": "serve_tokens_per_sec", "value": N,
+     "unit": "generated tokens/sec", "vs_baseline": 0.0, "detail": {...}}
+
+``vs_baseline`` is 0.0: the reference repo was a training-only harness
+with no serving number to compare against (detail.baseline_note says
+so).  ``detail`` carries TTFT p50/p95, per-request latency, decode-slot
+utilization, KV occupancy/preemptions, and the compile-count-relevant
+knobs (buckets, max_batch), so rows are comparable across runs.
+
+Meaningful throughput needs the real chip; on CPU this is a correctness
+and scheduling-overhead bench.
+
+Usage: python benches/serve_bench.py [--preset tiny --requests 32 ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--preset", choices=["tiny", "llama3-1b", "llama3-8b"],
+                   default="tiny")
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--prompt-len-lo", type=int, default=8)
+    p.add_argument("--prompt-len-hi", type=int, default=96)
+    p.add_argument("--max-new", type=int, default=32)
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--cache-len", type=int, default=256)
+    p.add_argument("--num-blocks", type=int, default=512)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    import jax
+    import numpy as np
+
+    from tpucfn.serve import Server
+    from tpucfn.serve.engine import demo_llama_engine
+
+    print(f"# backend={jax.default_backend()} preset={args.preset} "
+          f"requests={args.requests}", file=sys.stderr)
+    cfg, engine = demo_llama_engine(args.preset, seed=args.seed,
+                                    max_batch=args.max_batch,
+                                    cache_len=args.cache_len)
+    server = Server(engine, num_blocks=args.num_blocks,
+                    block_size=args.block_size)
+
+    rs = np.random.RandomState(args.seed)
+    prompts = [rs.randint(0, cfg.vocab_size,
+                          rs.randint(args.prompt_len_lo,
+                                     args.prompt_len_hi + 1)).tolist()
+               for _ in range(args.requests)]
+
+    # Warm the compile caches outside the timed window (one decode
+    # program + every prefill bucket this workload will hit), mirroring
+    # bench.py's warmup-exclusion rule for training steps.  Same server
+    # (jit caches are per engine instance); metrics are reset after.
+    from tpucfn.serve import ServingMetrics
+    from tpucfn.serve.scheduler import prefill_bucket
+
+    for b in sorted({prefill_bucket(len(q), args.cache_len)
+                     for q in prompts}):
+        server.submit([1] * min(b, args.cache_len - 2), max_new_tokens=2)
+    server.run_until_idle()
+    server.metrics = ServingMetrics()
+
+    t0 = time.perf_counter()
+    reqs = [server.submit(q, max_new_tokens=args.max_new) for q in prompts]
+    server.run_until_idle()
+    wall = time.perf_counter() - t0
+
+    failed = [r for r in reqs if r.error is not None]
+    snap = server.metrics.snapshot()
+    generated = snap["generated_tokens"]
+    row = {
+        "metric": "serve_tokens_per_sec",
+        "value": round(generated / wall, 3),
+        "unit": "generated tokens/sec",
+        "vs_baseline": 0.0,
+        "detail": {
+            "baseline_note": "reference harness was training-only; no "
+                             "published serving number exists",
+            "backend": jax.default_backend(),
+            "preset": args.preset,
+            "requests": args.requests,
+            "failed": len(failed),
+            "wall_s": round(wall, 3),
+            "max_batch": args.max_batch,
+            "cache_len": args.cache_len,
+            "block_size": args.block_size,
+            "num_blocks": args.num_blocks,
+            "max_new": args.max_new,
+            "ttft_s": snap["ttft_s"],
+            "request_latency_s": snap["request_latency_s"],
+            "preemptions": snap["preemptions"],
+            "kv_blocks_high_water": server.kv.allocator.high_water,
+            "kv_blocks_leaked": server.kv.allocator.num_used,
+        },
+    }
+    print(json.dumps(row))
+    return 0 if not failed and server.kv.allocator.num_used == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -180,3 +180,54 @@ time.sleep(30)  # stay alive so pgrep keeps matching while bench polls
     assert d["backend_mode"] == "tpu"
     assert d["recorded"]["stale"] is False
     assert d["recorded"]["git_commit"] == commit
+
+
+def test_bench_refresh_with_stale_commit_demotes_to_recorded(tmp_path):
+    """A refresh row serviced in time but stamped with a DIFFERENT (or
+    missing) commit is published at the same tier as a stale replay —
+    backend_mode 'tpu-recorded', not 'tpu' with a buried stale flag
+    (ADVICE r5): the resident client ran older code, so the number does
+    not describe this invocation's tree."""
+    recorded_path = tmp_path / "recorded.jsonl"
+    req_path = tmp_path / "refresh_request.json"
+    recorded_path.write_text("")
+    fake_dir = tmp_path / "onchip"
+    fake_dir.mkdir()
+    servicer = fake_dir / "megabench.py"
+    servicer.write_text(f"""
+import json, time, os
+req = {str(req_path)!r}
+out = {str(recorded_path)!r}
+deadline = time.time() + 110
+while time.time() < deadline:
+    if os.path.exists(req):
+        os.remove(req)
+        row = {{"phase": "resnet_full_refresh_test", "ts": time.time(),
+               "utc": "fresh", "git_commit": "deadbee",
+               "result": {{"metric": "m", "value": 43.0, "unit": "u",
+                          "vs_baseline": 4.3,
+                          "detail": {{"platform": "tpu"}}}}}}
+        with open(out, "a") as f:
+            f.write(json.dumps(row) + "\\n")
+        break
+    time.sleep(0.5)
+time.sleep(30)  # stay alive so pgrep keeps matching while bench polls
+""")
+    proc = subprocess.Popen([sys.executable, str(servicer)])
+    try:
+        r = _run_bench({
+            "PALLAS_AXON_POOL_IPS": "203.0.113.1",
+            "TPUCFN_BENCH_RECORDED_PATH": str(recorded_path),
+            "TPUCFN_BENCH_REFRESH_PATH": str(req_path),
+            "TPUCFN_BENCH_REFRESH_WAIT_S": "90",
+        })
+    finally:
+        proc.terminate()
+        proc.wait()
+    assert r.returncode == 0, f"stderr:\n{r.stderr[-2000:]}"
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["value"] == 43.0, rec
+    d = rec["detail"]
+    assert d["backend_mode"] == "tpu-recorded"
+    assert d["recorded"]["stale"] is True
+    assert any("demoted" in n for n in d["fallback_notes"])

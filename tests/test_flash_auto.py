@@ -60,6 +60,49 @@ def test_llama_auto_dispatch_matches_dense(monkeypatch):
         np.asarray(g_dense["layers"]["attn"]["q_proj"]["kernel"]), atol=5e-4)
 
 
+def test_untuned_device_kind_warns_once(tmp_path, monkeypatch):
+    """A TPU device kind with ZERO flash-tune table entries gets a
+    one-time warning when a shape lands in the silent dense-fallback
+    zone [flash_threshold, untuned_flash_min_s) — the round-4 UNet
+    regression class made discoverable (ADVICE r5)."""
+    import warnings as _warnings
+
+    monkeypatch.setattr(auto_mod, "_backend", lambda: "tpu")
+    monkeypatch.setenv("TPUCFN_FLASH_MIN_S", "32")
+    monkeypatch.setenv("TPUCFN_FLASH_UNTUNED_MIN_S", "4096")
+    monkeypatch.setenv("TPUCFN_FLASH_TUNE_CACHE", str(tmp_path / "t.json"))
+    # Empty merged table: pretend the builtin table doesn't exist either.
+    monkeypatch.setattr(flash_autotune, "_MEM_CACHE", {})
+    monkeypatch.setattr(auto_mod, "_warned_untuned_kinds", set())
+
+    with pytest.warns(UserWarning, match="no flash-tune table entries"):
+        assert not auto_mod.should_use_flash(64, d=64, dtype=jnp.bfloat16)
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")  # a second warning would raise
+        assert not auto_mod.should_use_flash(64, d=64, dtype=jnp.bfloat16)
+    # Past the untuned boundary the zone doesn't apply: flash, no warning.
+    assert auto_mod.should_use_flash(8192, d=64, dtype=jnp.bfloat16)
+
+
+def test_tuned_device_kind_does_not_warn(monkeypatch):
+    """Any entry for the CURRENT device kind silences the zero-entry
+    warning even when the specific family being asked about is untuned
+    (per-family silence is normal operation, not a config gap)."""
+    import warnings as _warnings
+
+    monkeypatch.setattr(auto_mod, "_backend", lambda: "tpu")
+    monkeypatch.setenv("TPUCFN_FLASH_MIN_S", "32")
+    monkeypatch.setenv("TPUCFN_FLASH_UNTUNED_MIN_S", "4096")
+    kind = jax.devices()[0].device_kind
+    monkeypatch.setattr(
+        flash_autotune, "_MEM_CACHE",
+        {f"{kind}|causal|128|128|bfloat16": (128, 128, 1.5)})
+    monkeypatch.setattr(auto_mod, "_warned_untuned_kinds", set())
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        assert not auto_mod.should_use_flash(64, d=64, dtype=jnp.bfloat16)
+
+
 def test_llama_auto_stays_dense_below_threshold(monkeypatch):
     """Below the threshold the resolved fn must be the dense op (no
     kernel involvement at all) — checked via the policy function."""

@@ -83,6 +83,22 @@ def mesh_ep():
     return build_mesh(MeshSpec(data=2, expert=4))
 
 
+def test_dense_dispatch_with_expert_axis_raises(mesh_ep):
+    """dispatch='dense' is the single-device reference checker; combined
+    with an active expert axis the layer must refuse instead of silently
+    running the ragged all-to-all path (ADVICE r5)."""
+    cfg = MoEConfig(n_experts=4, top_k=2, dispatch="dense")
+    x = jax.random.normal(jax.random.key(0), (2, 8, 16), jnp.float32)
+    model = MoEMLP(32, cfg, dtype=jnp.float32, ep_mesh=mesh_ep)
+    with pytest.raises(ValueError, match="ragged all-to-all"):
+        model.init(jax.random.key(0), x)
+    # Inert expert axis (size 1): the dense checker still works.
+    mesh1 = build_mesh(MeshSpec(data=8))
+    ok = MoEMLP(32, cfg, dtype=jnp.float32, ep_mesh=mesh1)
+    out, _ = _apply(ok, x)
+    assert out.shape == x.shape
+
+
 def _moe_llama_cfg():
     return dataclasses.replace(
         LlamaConfig.tiny(),

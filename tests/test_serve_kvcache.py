@@ -1,0 +1,111 @@
+"""Paged KV-cache allocator/manager invariants (tpucfn.serve.kvcache):
+atomic allocation, validated frees, leak-free lifecycle, fragmentation
+and eviction accounting."""
+
+import pytest
+
+from tpucfn.serve.kvcache import (
+    BlockAllocator,
+    KVCacheManager,
+    OutOfBlocksError,
+)
+
+
+def test_allocator_alloc_free_roundtrip():
+    a = BlockAllocator(8, 16)
+    got = a.alloc(5)
+    assert len(got) == 5 and len(set(got)) == 5
+    assert a.num_free == 3 and a.num_used == 5
+    a.free(got[:2])
+    assert a.num_free == 5
+    more = a.alloc(5)
+    assert set(more) & set(got[2:]) == set()  # still-held blocks not reissued
+    a.free(more)
+    a.free(got[2:])
+    assert a.num_free == 8 and a.num_used == 0
+    assert a.high_water == 8  # 3 held + 5 allocated at the peak
+
+
+def test_allocator_exhaustion_is_atomic():
+    a = BlockAllocator(4, 16)
+    a.alloc(3)
+    with pytest.raises(OutOfBlocksError):
+        a.alloc(2)  # only 1 free
+    assert a.num_free == 1  # nothing partially taken
+
+
+def test_allocator_double_free_rejected():
+    a = BlockAllocator(4, 16)
+    got = a.alloc(2)
+    a.free(got)
+    with pytest.raises(ValueError, match="not allocated"):
+        a.free([got[0]])
+    with pytest.raises(ValueError, match="not allocated"):
+        a.free([99])
+
+
+def test_manager_admit_grow_release_is_leak_free():
+    m = KVCacheManager(num_blocks=8, block_size=4)
+    m.admit("a", 5)  # 2 blocks (5 tokens / 4 per block)
+    assert m.allocator.num_used == 2
+    assert m.internal_fragmentation() == 3
+    # Growth: tokens 6..8 fill block 2; token 9 needs block 3.
+    for _ in range(3):
+        m.reserve_next("a")
+        m.commit_token("a")
+    assert m.allocator.num_used == 2
+    m.reserve_next("a")
+    assert m.allocator.num_used == 3
+    m.commit_token("a")
+    assert m.table("a").num_tokens == 9
+    m.release("a")
+    assert m.allocator.num_free == 8
+    assert m.num_sequences == 0
+
+
+def test_manager_commit_without_reserve_fails():
+    m = KVCacheManager(num_blocks=4, block_size=2)
+    m.admit("a", 2)  # exactly one full block
+    with pytest.raises(RuntimeError, match="reserve_next"):
+        m.commit_token("a")
+
+
+def test_manager_eviction_accounting():
+    m = KVCacheManager(num_blocks=8, block_size=4)
+    m.admit("a", 8)
+    m.admit("b", 4)
+    m.release("a", evicted=True)
+    m.release("b")
+    assert m.evictions == 1
+    assert m.blocks_evicted == 2
+    assert m.allocator.num_free == 8
+
+
+def test_manager_occupancy_and_feasibility():
+    m = KVCacheManager(num_blocks=4, block_size=8)
+    assert m.fits_at_all(32) and not m.fits_at_all(33)
+    assert m.can_admit(32)
+    m.admit("a", 17)  # 3 blocks
+    assert m.occupancy() == 0.75
+    assert m.can_admit(8) and not m.can_admit(9)
+
+
+def test_manager_interleaved_sequences_restore_free_count():
+    """Many sequences with interleaved admit/grow/release: the free count
+    must return exactly to the initial pool — the zero-leak acceptance
+    invariant at the accounting layer."""
+    m = KVCacheManager(num_blocks=32, block_size=4)
+    live = {}
+    for i in range(10):
+        live[i] = m.admit(i, 1 + (i * 7) % 9)
+        if i % 3 == 2:  # retire one early, evict another
+            m.release(i - 1, evicted=True)
+            del live[i - 1]
+        for j in list(live):
+            m.reserve_next(j)
+            m.commit_token(j)
+    for j in list(live):
+        m.release(j)
+    assert m.allocator.num_free == 32
+    assert m.allocator.num_used == 0
+    assert m.internal_fragmentation() == 0

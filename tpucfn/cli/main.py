@@ -207,6 +207,65 @@ def cmd_stage_data(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Continuous-batching inference over a workload of token-id
+    prompts (``--prompts`` JSONL with {"tokens": [...]} rows, or
+    ``--synthetic N`` random prompts) and print the serving metrics
+    snapshot as one JSON line.  Net-new vs the reference (training-only
+    harness); the serving counterpart of ``launch``."""
+    import json as _json
+
+    import numpy as np
+
+    from tpucfn.serve import AdmissionError, Server
+    from tpucfn.serve.engine import demo_llama_engine
+
+    cfg, engine = demo_llama_engine(args.preset, seed=args.seed,
+                                    max_batch=args.max_batch,
+                                    cache_len=args.cache_len)
+
+    rs = np.random.RandomState(args.seed)
+    if args.prompts:
+        prompts = []
+        with open(args.prompts) as f:
+            for line in f:
+                if line.strip():
+                    prompts.append([int(t) for t in
+                                    _json.loads(line)["tokens"]])
+    else:
+        lo, _, hi = (args.prompt_len or "4:32").partition(":")
+        prompts = [
+            rs.randint(0, cfg.vocab_size,
+                       rs.randint(int(lo), int(hi or lo) + 1)).tolist()
+            for _ in range(args.synthetic)]
+    if not prompts:
+        print("error: no prompts (use --prompts file or --synthetic N)",
+              file=sys.stderr)
+        return 2
+
+    server = Server(engine, num_blocks=args.num_blocks,
+                    block_size=args.block_size,
+                    max_queued_tokens=args.max_queued_tokens)
+    reqs = []
+    for p in prompts:
+        try:
+            reqs.append(server.submit(
+                p, max_new_tokens=args.max_new,
+                temperature=args.temperature,
+                deadline_s=args.deadline_s))
+        except AdmissionError as e:
+            print(f"rejected ({e.status}): {e}", file=sys.stderr)
+    server.run_until_idle()
+    ok = sum(1 for r in reqs if r.error is None)
+    print(f"served {ok}/{len(prompts)} requests "
+          f"({len(prompts) - len(reqs)} rejected at submit)",
+          file=sys.stderr)
+    print(_json.dumps(server.metrics.snapshot()))
+    # Partial failure is failure: scripts wrapping this must see expired/
+    # rejected requests in the exit code, not just in the JSON.
+    return 0 if ok == len(prompts) else 1
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tpucfn", description=__doc__)
     p.add_argument("--state-dir", default=os.environ.get("TPUCFN_STATE_DIR", "~/.tpucfn"))
@@ -295,6 +354,34 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--url", required=True, help="gs://, s3://, file://, or path")
     st.add_argument("--dest", required=True)
     st.set_defaults(fn=cmd_stage_data)
+
+    sv = sub.add_parser(
+        "serve",
+        help="continuous-batching inference over a prompt workload "
+             "(paged KV cache, bucketed prefills, admission control)")
+    sv.add_argument("--preset", choices=["tiny", "llama3-1b", "llama3-8b"],
+                    default="tiny")
+    sv.add_argument("--prompts",
+                    help='JSONL file of {"tokens": [ids...]} prompts')
+    sv.add_argument("--synthetic", type=int, default=8,
+                    help="generate N random prompts instead of --prompts")
+    sv.add_argument("--prompt-len", metavar="LO:HI",
+                    help="synthetic prompt length range (default 4:32)")
+    sv.add_argument("--max-new", type=int, default=16)
+    sv.add_argument("--temperature", type=float, default=0.0)
+    sv.add_argument("--max-batch", type=int, default=8,
+                    help="decode slots (the fixed decode batch shape)")
+    sv.add_argument("--cache-len", type=int, default=None,
+                    help="per-slot KV capacity in tokens (default: model "
+                         "max_seq)")
+    sv.add_argument("--num-blocks", type=int, default=256)
+    sv.add_argument("--block-size", type=int, default=16)
+    sv.add_argument("--max-queued-tokens", type=int, default=1 << 16,
+                    help="backpressure cap: outstanding prompt+budget "
+                         "tokens before 429")
+    sv.add_argument("--deadline-s", type=float, default=None)
+    sv.add_argument("--seed", type=int, default=0)
+    sv.set_defaults(fn=cmd_serve)
 
     return p
 

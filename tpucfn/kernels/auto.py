@@ -60,6 +60,40 @@ def _backend() -> str:
         return "cpu"
 
 
+_warned_untuned_kinds: set[str] = set()
+
+
+def _warn_once_if_kind_untuned() -> None:
+    """One-time (per device kind, per process) warning when the CURRENT
+    device kind has ZERO flash-tune table entries: every shape family
+    then runs dense between ``flash_threshold`` and
+    ``untuned_flash_min_s`` — a correct but silent fallback that cost a
+    round-4 regression hunt to discover (ADVICE r5).  The warning names
+    the fix (run ``flash_autotune.tune``) instead of leaving the
+    operator to diff HLO dumps."""
+    import jax
+
+    try:
+        kind = jax.devices()[0].device_kind
+    except Exception:  # noqa: BLE001 — backend init failure → stay quiet
+        return
+    if kind in _warned_untuned_kinds:
+        return
+    _warned_untuned_kinds.add(kind)  # scan the table once per kind
+    from tpucfn.kernels.flash_autotune import kind_has_entries
+
+    if not kind_has_entries(kind):
+        import warnings
+
+        warnings.warn(
+            f"TPU device kind {kind!r} has no flash-tune table entries: "
+            f"sequence lengths in [{flash_threshold()}, "
+            f"{untuned_flash_min_s()}) will silently use DENSE attention. "
+            "Run tpucfn.kernels.flash_autotune.tune(s, d) on this device "
+            "(or lower TPUCFN_FLASH_UNTUNED_MIN_S) to enable flash where "
+            "it wins.", stacklevel=3)
+
+
 def _evidence_says_flash(s: int, d, dtype, causal: bool) -> bool:
     """Measurement-backed dispatch core (VERDICT r4 #5): consult the
     tune table's measured dense/flash ratio for this (S, D, dtype)
@@ -74,7 +108,10 @@ def _evidence_says_flash(s: int, d, dtype, causal: bool) -> bool:
     speedup = lookup_speedup(int(s), int(d), dtype, causal)
     if speedup is not None:
         return speedup >= 1.05
-    return int(s) >= untuned_flash_min_s()
+    if int(s) < untuned_flash_min_s():
+        _warn_once_if_kind_untuned()
+        return False
+    return True
 
 
 def should_use_flash(s: int, *, causal: bool = True, mask=None,
@@ -160,3 +197,23 @@ def auto_attention(q, k, v, *, causal=True, mask=None, q_offset=0,
             "explicit mask or use flash_attention directly")
     return dot_product_attention(q, k, v, causal=causal, mask=mask,
                                  q_offset=q_offset, k_offset=k_offset)
+
+
+def serve_decode_attention_fn(cache_len: int):
+    """Attention path for the serving engine's decode-mode model
+    (tpucfn/serve/engine.py) — the one dispatch site where offsets are
+    TRACED per slot (each slot's cache index rides the vmapped cache),
+    so the Pallas flash kernel (static offsets, blocked s_q) is off the
+    table regardless of length.  Single-token decode over a contiguous
+    cache is memory-bound gather work XLA handles well; the win past
+    this is a dedicated paged/flash-decode kernel keyed on block tables,
+    which slots in HERE when it lands (ROADMAP serving follow-ons) —
+    models and the engine keep calling this one policy point.
+
+    ``cache_len`` is accepted (and deliberately unused today) so the
+    future kernel can pick block shapes without an engine-side change.
+    """
+    from tpucfn.ops.attention import dot_product_attention as dense
+
+    del cache_len  # reserved for the paged-decode kernel's block picker
+    return dense

@@ -108,6 +108,16 @@ def _entry(s: int, d: int, dtype, causal: bool) -> tuple | None:
     return _load().get(_key(kind, causal, s, d, dtype))
 
 
+def kind_has_entries(device_kind: str) -> bool:
+    """Whether the merged table (builtin + user cache) has ANY entry for
+    this device kind — the discoverability probe behind
+    ``kernels.auto``'s one-time untuned-device warning: a kind with zero
+    entries runs dense everywhere below ``untuned_flash_min_s`` and the
+    operator should know why."""
+    prefix = device_kind + "|"
+    return any(k.startswith(prefix) for k in _load())
+
+
 def lookup(s: int, d: int, dtype, causal: bool) -> tuple[int, int] | None:
     """Best known (block_q, block_k) for this shape family on the
     current device, or None. Trace-time safe (no device work)."""

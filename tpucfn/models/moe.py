@@ -38,8 +38,13 @@ The expert computation itself is identical either way: one batched
 matmul over the stacked (E, ...) expert weights. Param layout matches
 the preset conventions (``experts/...`` with a leading expert dim,
 ``router/kernel``): tpucfn/parallel/presets.py rules shard it as
-P(expert, fsdp, tensor); per-expert kernels enter the shard_map body
-manual over ``expert`` only, so FSDP keeps its gather-on-use semantics.
+P(expert, fsdp, tensor).  Sharding inside the manual region: the
+shard_map's ``axis_names`` are ``{data, fsdp, expert}``, so only the
+``tensor`` axis stays under compiler control in the body — expert
+weights enter split over ``expert`` (P(expert) in_specs), and any
+fsdp-sharded inner dims are ALL-GATHERED at the shard_map boundary
+(their full inner extents materialize per device for the duration of
+the layer); Megatron TP on ``tensor`` still composes.
 
 Composition note (PP×EP): inside the pipeline schedules
 (models/llama_pp.py) a nested shard_map would re-bind the outer axis,
@@ -195,6 +200,20 @@ class MoEMLP(nn.Module):
             raise ValueError(
                 f"n_experts {e} not divisible by expert-axis size "
                 f"{ep_inline}")
+        ep_mesh_size = (self.ep_mesh.shape.get(AXIS_EXPERT, 1)
+                        if self.ep_mesh is not None else 1)
+        if (ep_inline > 1 or ep_mesh_size > 1) and cfg.dispatch != "ragged":
+            # The EP body has exactly one dispatch implementation (the
+            # ragged scatter + all_to_all pair); silently running it
+            # under dispatch="dense" would let the reference checker
+            # "verify" the very path it is supposed to be independent of
+            # (ADVICE r5).
+            raise ValueError(
+                f"dispatch={cfg.dispatch!r} with an active expert axis "
+                f"(size {max(ep_inline, ep_mesh_size)}): the expert-"
+                "parallel path always runs the ragged all-to-all "
+                "dispatch; 'dense' is the single-device reference "
+                "checker only")
         # Local declaration under ep_manual: the enclosing manual region
         # hands this module its E/ep expert slice, and flax validates
         # param shapes on apply.
@@ -234,8 +253,7 @@ class MoEMLP(nn.Module):
             self.sow("metrics", "moe_dropped_frac", dropped)
             return out.reshape(b, s, d).astype(self.dtype)
 
-        ep = (self.ep_mesh.shape.get(AXIS_EXPERT, 1)
-              if self.ep_mesh is not None else 1)
+        ep = ep_mesh_size
         if ep > 1:
             out, aux, dropped = self._ep_apply(
                 router_logits, xt, wg, wu, wd, ep=ep)
@@ -307,9 +325,13 @@ class MoEMLP(nn.Module):
         ragged scatter — zero communication), then one ``all_to_all``
         over ``expert`` carries each (local-expert, capacity) slice to
         the shard owning that expert, and a second one carries the
-        expert outputs back.  Expert weights enter manual over
-        ``expert`` only, so fsdp/tensor sharding on their inner dims
-        stays under compiler control (FSDP gather-on-use, Megatron TP).
+        expert outputs back.  With ``axis_names={data, fsdp, expert}``
+        only the ``tensor`` axis stays under compiler control inside
+        the body: expert weights enter split over ``expert``
+        (P(expert) in_specs), which replicates them over data/fsdp —
+        fsdp-sharded expert weights are all-gathered at the shard_map
+        boundary, their full inner dims resident per device for the
+        layer.  Megatron TP sharding on ``tensor`` dims still composes.
         """
         cfg = self.moe
         e, k = cfg.n_experts, cfg.top_k

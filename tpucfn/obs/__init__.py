@@ -1,4 +1,10 @@
-from tpucfn.obs.metrics import MetricLogger, StepTimer  # noqa: F401
+from tpucfn.obs.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    MetricLogger,
+    StepTimer,
+    Summary,
+)
 from tpucfn.obs.profiler import (  # noqa: F401
     enable_compile_cache,
     profile_steps,

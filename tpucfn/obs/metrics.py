@@ -11,11 +11,85 @@ headline baseline metric (BASELINE.md: ResNet-50 images/sec/chip).
 from __future__ import annotations
 
 import json
+import threading
 import time
 from pathlib import Path
 from typing import Any, Mapping
 
 import jax
+
+
+class Counter:
+    """Monotonic, thread-safe counter (requests served, tokens emitted,
+    rejections...).  Serving-side instrumentation shares the training
+    stack's metrics vocabulary so one JSONL/snapshot pipeline carries
+    both (SURVEY.md §5 metrics row)."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def add(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins instantaneous value (queue depth, cache
+    occupancy).  Thread-safe by virtue of atomic float assignment; the
+    lock-free write is deliberate — gauges are sampled, not summed."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+
+class Summary:
+    """Streaming distribution (TTFT, per-request latency): count/sum
+    always exact; percentiles over a bounded reservoir of the most
+    recent ``keep`` samples — serving runs are long, memory must not
+    grow with request count."""
+
+    def __init__(self, name: str = "", keep: int = 4096):
+        self.name = name
+        self.count = 0
+        self.sum = 0.0
+        self._keep = keep
+        self._recent: list[float] = []
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self._recent.append(float(v))
+            if len(self._recent) > self._keep:
+                del self._recent[: len(self._recent) - self._keep]
+
+    def percentile(self, p: float) -> float | None:
+        with self._lock:
+            if not self._recent:
+                return None
+            xs = sorted(self._recent)
+        i = min(len(xs) - 1, max(0, round(p / 100.0 * (len(xs) - 1))))
+        return xs[i]
+
+    @property
+    def mean(self) -> float | None:
+        return self.sum / self.count if self.count else None
+
+    def snapshot(self) -> dict:
+        return {"count": self.count, "mean": self.mean,
+                "p50": self.percentile(50), "p95": self.percentile(95),
+                "p99": self.percentile(99)}
 
 
 class StepTimer:

@@ -1,0 +1,39 @@
+"""tpucfn.serve — continuous-batching inference.
+
+The serving counterpart of the training stack (ROADMAP north star:
+"serves heavy traffic"), layered bottom-up:
+
+* :mod:`tpucfn.serve.kvcache` — paged KV-block accounting (free-list
+  allocator, per-sequence block tables, eviction bookkeeping).
+* :mod:`tpucfn.serve.scheduler` — continuous batching: bucketed prefill
+  admission, in-place retirement, preempt-on-full.
+* :mod:`tpucfn.serve.engine` — the jitted prefill/decode steps over a
+  slot-resident, donated cache (vmapped per-slot cache indices).
+* :mod:`tpucfn.serve.frontend` — thread-safe queue, 429/400 admission
+  control, deadlines, and the obs.metrics serving dashboard.
+
+CLI: ``tpucfn serve`` (see ``tpucfn/cli/main.py``); bench:
+``benches/serve_bench.py``.
+"""
+
+from tpucfn.serve.engine import ServeEngine  # noqa: F401
+from tpucfn.serve.frontend import (  # noqa: F401
+    AdmissionError,
+    DeadlineExceeded,
+    Server,
+    ServeRequest,
+    ServingMetrics,
+)
+from tpucfn.serve.kvcache import (  # noqa: F401
+    BlockAllocator,
+    BlockTable,
+    KVCacheManager,
+    OutOfBlocksError,
+)
+from tpucfn.serve.scheduler import (  # noqa: F401
+    ContinuousBatchingScheduler,
+    DecodeWork,
+    PrefillWork,
+    Sequence,
+    prefill_bucket,
+)

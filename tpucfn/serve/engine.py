@@ -1,0 +1,212 @@
+"""ServeEngine — jitted prefill/decode steps over a slot-resident KV cache.
+
+The engine owns ``max_batch`` physical decode slots.  Each slot carries
+its own flax decode cache (the same ``cache`` collection
+``models/generate.py`` uses), batched on a leading slot axis, so decode
+is ONE jitted program over all slots via ``jax.vmap`` of the
+single-sequence apply — per-slot ``cache_index`` scalars fall out of the
+vmap for free, which is exactly what continuous batching needs (every
+slot sits at a different sequence position) and what the training-style
+shared-scalar cache cannot express.
+
+Two compiled entry points, both with the slot cache DONATED (the
+multi-hundred-MB buffer is updated in place, never double-buffered):
+
+* ``prefill``: one sequence, padded to its length bucket, run through
+  the decode-mode model in a single pass; its per-layer ``cache_index``
+  is then rewound to the TRUE prefix length, so the pad garbage beyond
+  it is overwritten by the next decode step before causality could ever
+  expose it; the fresh cache row is scattered into the donated slot
+  cache and the first token is sampled from the last REAL position's
+  logits.  Compiles once per (bucket) — the scheduler's pow-2 buckets
+  keep that set small.
+* ``decode``: one token for EVERY slot (fixed shape, compiles once).
+  Vacant slots compute garbage lanes that are never read — the standard
+  static-shape trade.
+
+Greedy decode here is token-identical to ``models/generate.py`` (the
+parity test in ``tests/test_serve_engine.py`` pins it): same model code,
+same cache math, same argmax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpucfn.parallel.sharding import _path_str
+
+
+def _sample(logits: jax.Array, temps: jax.Array, key: jax.Array) -> jax.Array:
+    """(N, V) fp32 logits -> (N,) int32 tokens.  temp<=0 is greedy;
+    otherwise categorical over logits/temp (the ``models/generate.py``
+    convention — temperature scaling first)."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temps > 0.0, sampled, greedy)
+
+
+def _rewind_cache_index(cache, true_len):
+    """Post-prefill surgery: every ``cache_index`` leaf (shape (L,) under
+    nn.scan, () unrolled) is set to the TRUE prefix length, un-counting
+    the bucket padding.  Pad K/V beyond ``true_len`` stays in the buffer
+    but is dead: the next decode step overwrites position ``true_len``
+    before attending, and causality masks everything past the query."""
+
+    def fix(path, leaf):
+        if _path_str(path).endswith("cache_index"):
+            return jnp.full(leaf.shape, true_len, leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+class ServeEngine:
+    """Wraps any decode-protocol flax model (init/apply with a ``cache``
+    collection, ``(B, S) int32 -> (B, S, V)`` logits) behind the two
+    jitted serving steps.  Use :meth:`from_llama` for the model zoo's
+    decoder (optionally LoRA-merged via ``train/lora.py``)."""
+
+    def __init__(self, model: Any, params: Any, *, max_batch: int,
+                 cache_len: int, rng: jax.Array | None = None):
+        self.model = model
+        self.params = params
+        self.max_batch = max_batch
+        self.cache_len = cache_len
+        self._base_key = jax.random.key(0) if rng is None else rng
+        self._step_count = 0
+
+        # Single-sequence cache template (b=1) — the per-slot unit.
+        row_shapes = jax.eval_shape(
+            lambda: model.init(jax.random.key(0),
+                               jnp.zeros((1, 1), jnp.int32)))["cache"]
+        self._row_shapes = row_shapes
+        # Slot-batched cache: every leaf gains a leading (max_batch,) axis.
+        self.cache = jax.tree.map(
+            lambda s: jnp.zeros((max_batch,) + s.shape, s.dtype), row_shapes)
+        # Host-side per-slot sampling temperature (set at prefill time).
+        self._temps = np.zeros((max_batch,), np.float32)
+
+        self._prefill_jit = jax.jit(self._prefill_impl, donate_argnums=(0,))
+        self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(0,))
+
+    @classmethod
+    def from_llama(cls, cfg, params, *, max_batch: int = 8,
+                   cache_len: int | None = None, lora_adapters=None,
+                   lora_scale: float = 1.0, rng: jax.Array | None = None):
+        """Engine over the flagship decoder.  ``cache_len`` sizes every
+        slot's KV buffer (default ``cfg.max_seq``); ``lora_adapters``
+        (from ``train.lora.lora_init``-shaped trees) are merged into the
+        weights once, host-side — serving then runs the plain decoder,
+        no per-step merge cost."""
+        from tpucfn.kernels.auto import serve_decode_attention_fn
+        from tpucfn.models.llama import Llama
+
+        cache_len = cache_len or cfg.max_seq
+        dcfg = dataclasses.replace(cfg, max_seq=cache_len)
+        if lora_adapters is not None:
+            from tpucfn.train.lora import lora_materialize
+
+            params = jax.tree.map(np.asarray, lora_materialize(
+                params, lora_adapters, scale=lora_scale))
+        model = Llama(dcfg, decode=True,
+                      attention_fn=serve_decode_attention_fn(cache_len))
+        return cls(model, params, max_batch=max_batch, cache_len=cache_len,
+                   rng=rng)
+
+    # -- jitted bodies -----------------------------------------------------
+    def _apply_one(self, params, cache_row, tokens_row):
+        """One slot's apply: tokens (1, S) against its own cache row."""
+        logits, muts = self.model.apply(
+            {"params": params, "cache": cache_row}, tokens_row,
+            mutable=["cache"])
+        return logits, muts["cache"]
+
+    def _prefill_impl(self, cache, params, prompt, true_len, slot, temp, key):
+        """prompt (bucket,) int32, true_len/slot () int32, temp () f32."""
+        row0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                            self._row_shapes)
+        logits, row = self._apply_one(params, row0, prompt[None])
+        row = _rewind_cache_index(row, true_len)
+        last = jax.lax.dynamic_index_in_dim(
+            logits[0], true_len - 1, axis=0, keepdims=False)  # (V,)
+        tok = _sample(last[None], temp[None], key)[0]
+        new_cache = jax.tree.map(lambda full, r: full.at[slot].set(r),
+                                 cache, row)
+        return tok, new_cache
+
+    def _decode_impl(self, cache, params, tokens, temps, key):
+        """tokens (B,) int32 -> (next (B,), cache).  Every slot steps."""
+
+        def one(cache_row, tok):
+            logits, row = self._apply_one(params, cache_row, tok[None, None])
+            return logits[0, -1], row
+
+        logits, new_cache = jax.vmap(one)(cache, tokens)
+        return _sample(logits.astype(jnp.float32), temps, key), new_cache
+
+    # -- host API (the scheduler loop calls these) -------------------------
+    def _next_key(self) -> jax.Array:
+        self._step_count += 1
+        return jax.random.fold_in(self._base_key, self._step_count)
+
+    def prefill(self, slot: int, prefix: list[int], bucket: int,
+                temperature: float = 0.0) -> int:
+        """Run one bucketed prefill into ``slot``; returns the sequence's
+        first sampled token."""
+        n = len(prefix)
+        if not 1 <= n <= bucket <= self.cache_len:
+            raise ValueError(
+                f"prefix len {n} / bucket {bucket} / cache_len "
+                f"{self.cache_len} violate 1 <= len <= bucket <= cache_len")
+        padded = np.zeros((bucket,), np.int32)
+        padded[:n] = np.asarray(prefix, np.int32)
+        self._temps[slot] = temperature
+        tok, self.cache = self._prefill_jit(
+            self.cache, self.params, jnp.asarray(padded),
+            jnp.int32(n), jnp.int32(slot), jnp.float32(temperature),
+            self._next_key())
+        return int(tok)
+
+    def decode(self, tokens_by_slot: dict[int, int]) -> dict[int, int]:
+        """One decode iteration.  ``tokens_by_slot`` maps ACTIVE slots to
+        their last emitted token; vacant slots run dead lanes.  Returns
+        the next token per active slot."""
+        toks = np.zeros((self.max_batch,), np.int32)
+        for slot, tok in tokens_by_slot.items():
+            toks[slot] = tok
+        nxt, self.cache = self._decode_jit(
+            self.cache, self.params, jnp.asarray(toks),
+            jnp.asarray(self._temps), self._next_key())
+        nxt = np.asarray(nxt)
+        return {slot: int(nxt[slot]) for slot in tokens_by_slot}
+
+
+# Named Llama configs for the demo/bench surfaces (one source of truth
+# for `tpucfn serve --preset` and `benches/serve_bench.py`).
+LLAMA_PRESETS = ("tiny", "llama3-1b", "llama3-8b")
+
+
+def demo_llama_engine(preset: str, *, seed: int = 0, max_batch: int = 8,
+                      cache_len: int | None = None):
+    """(cfg, ServeEngine) over a RANDOM-init Llama preset — the shared
+    bring-up for the CLI demo workload and the serving bench (real
+    deployments construct the engine from checkpointed params
+    themselves)."""
+    import jax
+
+    from tpucfn.models.llama import Llama, LlamaConfig
+
+    ctors = {"tiny": LlamaConfig.tiny, "llama3-1b": LlamaConfig.llama3_1b,
+             "llama3-8b": LlamaConfig.llama3_8b}
+    cfg = ctors[preset]()
+    params = Llama(cfg).init(jax.random.key(seed),
+                             jnp.zeros((1, 8), jnp.int32))["params"]
+    return cfg, ServeEngine.from_llama(cfg, params, max_batch=max_batch,
+                                       cache_len=cache_len)

@@ -1,0 +1,305 @@
+"""Serving frontend: thread-safe request queue, admission control, and
+the loop that binds queue → scheduler → engine.
+
+Admission control is LAYERED, and each layer rejects for a different
+reason with a different status:
+
+* 429 (:class:`AdmissionError`, ``status=429``) — backpressure: the sum
+  of OUTSTANDING tokens (prompt + budgeted new tokens of every request
+  submitted but not yet completed) would exceed ``max_queued_tokens``.
+  Outstanding, not merely queued: a frontend that only counts its own
+  queue believes itself empty while the scheduler drowns.
+* 400 (``status=400``) — the request can never run on this engine
+  (empty prompt, prompt + max_new over the cache capacity, or more KV
+  blocks than the whole pool): rejecting at submit beats starving at
+  the head of the queue.
+* 503-equivalent deadline expiry — a request whose deadline passes
+  while queued or running is completed with :class:`DeadlineExceeded`;
+  capacity goes back to live traffic instead of computing answers
+  nobody is waiting for.
+
+Metrics ride on ``tpucfn.obs.metrics`` primitives (Counter/Gauge/
+Summary): TTFT, generated tokens/sec, queue depth, KV-cache occupancy,
+preemptions, rejections — ``ServingMetrics.snapshot()`` is the one dict
+the CLI, the bench, and tests all read.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from tpucfn.obs.metrics import Counter, Gauge, Summary
+from tpucfn.serve.engine import ServeEngine
+from tpucfn.serve.kvcache import KVCacheManager
+from tpucfn.serve.scheduler import (
+    ContinuousBatchingScheduler,
+    PrefillWork,
+    Sequence,
+    SequenceState,
+)
+
+
+class AdmissionError(RuntimeError):
+    """Request refused at submit time.  ``status`` follows HTTP
+    semantics: 429 = retry later (backpressure), 400 = never valid on
+    this engine."""
+
+    def __init__(self, msg: str, *, status: int = 429):
+        super().__init__(msg)
+        self.status = status
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline passed before it finished."""
+
+
+class ServeRequest:
+    """Caller-facing handle: block on :meth:`result` (or poll
+    :attr:`done`).  Timing fields are filled by the serve loop —
+    ``t_first_token - t_submit`` is the TTFT the metrics record."""
+
+    def __init__(self, req_id: int, prompt: list[int], max_new_tokens: int,
+                 temperature: float, deadline: float | None):
+        self.req_id = req_id
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.deadline = deadline
+        self.tokens: list[int] | None = None
+        self.error: BaseException | None = None
+        self.t_submit = time.monotonic()
+        self.t_first_token: float | None = None
+        self.t_done: float | None = None
+        self.done = threading.Event()
+
+    def result(self, timeout: float | None = None) -> list[int]:
+        """Generated tokens (prompt excluded); raises the request's
+        error (DeadlineExceeded, ValueError...) if it failed."""
+        if not self.done.wait(timeout):
+            raise TimeoutError(f"request {self.req_id} still in flight")
+        if self.error is not None:
+            raise self.error
+        assert self.tokens is not None
+        return self.tokens
+
+
+class ServingMetrics:
+    """The serving dashboard in one object (obs.metrics primitives)."""
+
+    def __init__(self):
+        self.ttft_s = Summary("ttft_s")
+        self.request_latency_s = Summary("request_latency_s")
+        self.generated_tokens = Counter("generated_tokens")
+        self.prompt_tokens = Counter("prompt_tokens")
+        self.completed = Counter("completed_requests")
+        self.rejected = Counter("rejected_requests")
+        self.expired = Counter("expired_requests")
+        self.preemptions = Counter("preemptions")
+        self.queue_depth = Gauge("queue_depth")
+        self.running = Gauge("running_sequences")
+        self.cache_occupancy = Gauge("kv_cache_occupancy")
+        self._t0 = time.monotonic()
+
+    def snapshot(self) -> dict:
+        elapsed = max(time.monotonic() - self._t0, 1e-9)
+        return {
+            "elapsed_s": round(elapsed, 3),
+            "completed": self.completed.value,
+            "rejected": self.rejected.value,
+            "expired": self.expired.value,
+            "preemptions": self.preemptions.value,
+            "prompt_tokens": self.prompt_tokens.value,
+            "generated_tokens": self.generated_tokens.value,
+            "tokens_per_sec": self.generated_tokens.value / elapsed,
+            "ttft_s": self.ttft_s.snapshot(),
+            "request_latency_s": self.request_latency_s.snapshot(),
+            "queue_depth": self.queue_depth.value,
+            "running_sequences": self.running.value,
+            "kv_cache_occupancy": self.cache_occupancy.value,
+        }
+
+
+class Server:
+    """One engine + one scheduler + the frontend queue.
+
+    Two driving modes sharing one step function: :meth:`run_until_idle`
+    (synchronous — CLI, benches, deterministic tests) and
+    :meth:`start`/:meth:`stop` (a background thread that sleeps on a
+    condition until work arrives — the long-lived serving posture).
+    """
+
+    def __init__(self, engine: ServeEngine, *, num_blocks: int = 256,
+                 block_size: int = 16, max_queued_tokens: int = 1 << 16,
+                 eos_id: int | None = None):
+        self.engine = engine
+        self.kv = KVCacheManager(num_blocks, block_size)
+        self.scheduler = ContinuousBatchingScheduler(
+            self.kv, max_batch=engine.max_batch,
+            cache_len=engine.cache_len, eos_id=eos_id)
+        self.metrics = ServingMetrics()
+        self.max_queued_tokens = max_queued_tokens
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._incoming: deque[ServeRequest] = deque()
+        self._outstanding_tokens = 0
+        self._by_seq: dict[int, ServeRequest] = {}
+        self._next_id = 0
+        self._thread: threading.Thread | None = None
+        self._stopping = False
+
+    # -- submit path (any thread) ------------------------------------------
+    def submit(self, prompt: list[int], *, max_new_tokens: int,
+               temperature: float = 0.0,
+               deadline_s: float | None = None) -> ServeRequest:
+        budget = len(prompt) + max_new_tokens
+        if not prompt or max_new_tokens < 1:
+            self.metrics.rejected.add()
+            raise AdmissionError(
+                f"empty prompt or max_new_tokens {max_new_tokens} < 1",
+                status=400)
+        if budget > self.engine.cache_len \
+                or not self.kv.fits_at_all(budget - 1):
+            self.metrics.rejected.add()
+            raise AdmissionError(
+                f"prompt {len(prompt)} + max_new {max_new_tokens} exceeds "
+                f"engine capacity (cache_len {self.engine.cache_len}, "
+                f"{self.kv.allocator.num_blocks} KV blocks)", status=400)
+        with self._lock:
+            if self._outstanding_tokens + budget > self.max_queued_tokens:
+                self.metrics.rejected.add()
+                raise AdmissionError(
+                    f"queue full: {self._outstanding_tokens} outstanding "
+                    f"tokens + {budget} > {self.max_queued_tokens} "
+                    "(back off and retry)", status=429)
+            self._outstanding_tokens += budget
+            req = ServeRequest(
+                self._next_id, list(prompt), max_new_tokens, temperature,
+                None if deadline_s is None
+                else time.monotonic() + deadline_s)
+            self._next_id += 1
+            self._incoming.append(req)
+            self._work.notify()
+        self.metrics.prompt_tokens.add(len(prompt))
+        self.metrics.queue_depth.set(len(self._incoming)
+                                     + self.scheduler.num_waiting)
+        return req
+
+    # -- completion --------------------------------------------------------
+    def _complete(self, req: ServeRequest, *, tokens=None, error=None):
+        req.t_done = time.monotonic()
+        req.tokens, req.error = tokens, error
+        with self._lock:
+            self._outstanding_tokens -= len(req.prompt) + req.max_new_tokens
+        if error is None:
+            self.metrics.completed.add()
+            self.metrics.request_latency_s.observe(req.t_done - req.t_submit)
+        elif isinstance(error, DeadlineExceeded):
+            self.metrics.expired.add()
+        else:
+            self.metrics.rejected.add()
+        req.done.set()
+
+    # -- the step function (one scheduler decision + one engine call) ------
+    def _ingest(self) -> None:
+        with self._lock:
+            batch = list(self._incoming)
+            self._incoming.clear()
+        for req in batch:
+            seq = Sequence(
+                seq_id=req.req_id, prompt=req.prompt,
+                max_new_tokens=req.max_new_tokens,
+                temperature=req.temperature, deadline=req.deadline,
+                arrival=req.t_submit)
+            self._by_seq[req.req_id] = req
+            try:
+                self.scheduler.add(seq)
+            except ValueError as e:
+                # add() re-checks feasibility because Server config and
+                # direct-scheduler users can disagree; surface as 400.
+                self._by_seq.pop(req.req_id)
+                self._complete(req, error=AdmissionError(str(e), status=400))
+
+    def step(self) -> bool:
+        """One iteration: ingest, expire deadlines, run one prefill or
+        one decode round, record results.  Returns False when idle."""
+        self._ingest()
+        preempt0 = self.kv.evictions
+        for seq in self.scheduler.expire():
+            req = self._by_seq.pop(seq.seq_id)
+            self._complete(req, error=DeadlineExceeded(
+                f"deadline passed after {len(seq.generated)}"
+                f"/{seq.max_new_tokens} tokens"))
+        work = self.scheduler.next_work()
+        if work is None:
+            self._refresh_gauges()
+            return False
+        if isinstance(work, PrefillWork):
+            # The prefill's sampled token is ALWAYS new output: for a
+            # fresh sequence it's token 1; for a preempted one, the
+            # recomputed prefix already contains everything previously
+            # emitted, so the last position's logits predict the next
+            # unseen token.
+            tok = self.engine.prefill(work.slot, work.seq.prefix, work.bucket,
+                                      work.seq.temperature)
+            req = self._by_seq[work.seq.seq_id]
+            if req.t_first_token is None:  # preempted reruns keep the first
+                req.t_first_token = time.monotonic()
+                self.metrics.ttft_s.observe(req.t_first_token - req.t_submit)
+            self.metrics.generated_tokens.add()
+            self._finish(self.scheduler.record_prefill(work.slot, tok))
+        else:
+            out = self.engine.decode(
+                {slot: seq.last_token for slot, seq in work.slots.items()})
+            for slot, tok in out.items():
+                self.metrics.generated_tokens.add()
+                self._finish(self.scheduler.record_decode(slot, tok))
+        self.metrics.preemptions.add(self.kv.evictions - preempt0)
+        self._refresh_gauges()
+        return True
+
+    def _finish(self, seq) -> None:
+        if seq is not None and seq.state is SequenceState.FINISHED:
+            req = self._by_seq.pop(seq.seq_id)
+            self._complete(req, tokens=list(seq.generated))
+
+    def _refresh_gauges(self) -> None:
+        self.metrics.queue_depth.set(len(self._incoming)
+                                     + self.scheduler.num_waiting)
+        self.metrics.running.set(self.scheduler.num_running)
+        self.metrics.cache_occupancy.set(self.kv.occupancy())
+
+    # -- driving modes -----------------------------------------------------
+    def run_until_idle(self) -> None:
+        while self.step():
+            pass
+
+    def start(self) -> None:
+        if self._thread is not None:
+            raise RuntimeError("server already started")
+        self._stopping = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="tpucfn-serve")
+        self._thread.start()
+
+    def stop(self, timeout: float = 30.0) -> None:
+        with self._lock:
+            self._stopping = True
+            self._work.notify()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def _run(self) -> None:
+        while True:
+            if not self.step():
+                with self._lock:
+                    if self._stopping:
+                        return
+                    if not self._incoming and not self.scheduler.has_work():
+                        # Truly idle: no queued or running sequences means
+                        # no pending deadlines either (_by_seq drains with
+                        # the scheduler), so sleep until submit()/stop()
+                        # notifies — zero idle wakeups.
+                        self._work.wait()

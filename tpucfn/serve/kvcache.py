@@ -1,0 +1,185 @@
+"""Paged KV-cache accounting: fixed-size blocks, free-list allocator,
+per-sequence block tables, eviction bookkeeping.
+
+The serving memory problem (vLLM's observation, PAPERS.md serving rows):
+a contiguous per-request KV allocation sized for ``prompt + max_new``
+wastes most of HBM on requests that finish early or never reach their
+limit.  Paging fixes the ACCOUNTING even before it changes the kernel:
+sequences own lists of fixed-size blocks, blocks come from one shared
+free list, a sequence is charged only for tokens it has actually cached
+(plus at most one partially-filled block of internal fragmentation), and
+admission control can answer "does this prompt fit right now?" exactly.
+
+This module is pure host-side bookkeeping (no jax): it governs what the
+scheduler admits and when it preempts.  The device-side cache today is
+the engine's slot-contiguous layout (``serve/engine.py``); the block
+tables produced here are exactly the indirection a future paged-
+attention kernel consumes, so the allocator/scheduler layer survives
+that swap untouched (ROADMAP serving follow-ons).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+class OutOfBlocksError(RuntimeError):
+    """The free list cannot satisfy an allocation.  Callers (the
+    scheduler) react by preempting or queueing — never by partially
+    allocating: ``BlockAllocator.alloc`` is atomic."""
+
+
+class BlockAllocator:
+    """Fixed pool of ``num_blocks`` KV blocks handed out LIFO.
+
+    LIFO keeps the working set of physical blocks small and recently
+    used (friendlier to any cache level below us); allocation is atomic
+    (all-or-nothing) and every free is validated so leaks and double
+    frees fail loudly in tests instead of silently shrinking capacity.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._free = list(range(num_blocks - 1, -1, -1))  # pop() -> block 0 first
+        self._used: set[int] = set()
+        self.high_water = 0  # max simultaneously-used blocks ever
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used(self) -> int:
+        return len(self._used)
+
+    def alloc(self, n: int) -> list[int]:
+        """n blocks or OutOfBlocksError — never a partial allocation."""
+        if n < 0:
+            raise ValueError(f"cannot alloc {n} blocks")
+        if n > len(self._free):
+            raise OutOfBlocksError(
+                f"need {n} blocks, {len(self._free)} free "
+                f"(pool {self.num_blocks})")
+        got = [self._free.pop() for _ in range(n)]
+        self._used.update(got)
+        self.high_water = max(self.high_water, len(self._used))
+        return got
+
+    def free(self, blocks: list[int]) -> None:
+        for b in blocks:
+            if b not in self._used:
+                raise ValueError(
+                    f"freeing block {b} that is not allocated "
+                    "(double free or foreign id)")
+            self._used.remove(b)
+            self._free.append(b)
+
+
+@dataclasses.dataclass
+class BlockTable:
+    """One sequence's view of the cache: ordered physical block ids plus
+    the number of tokens actually cached.  ``num_tokens`` may lag the
+    capacity ``len(blocks) * block_size`` by up to ``block_size - 1``
+    (internal fragmentation) and by exactly 1 between ``reserve_next``
+    and ``commit_token``."""
+
+    blocks: list[int]
+    num_tokens: int
+
+    def capacity(self, block_size: int) -> int:
+        return len(self.blocks) * block_size
+
+
+class KVCacheManager:
+    """Admission + growth + release accounting over one BlockAllocator.
+
+    Protocol (driven by the scheduler):
+
+    * ``admit(seq_id, prompt_len)`` — allocate the prompt's blocks
+      atomically (prefill writes exactly ``prompt_len`` K/V entries).
+    * ``reserve_next(seq_id)`` — before a decode step, guarantee room
+      for the token that step will write; grows the table by one block
+      at block boundaries (raises :class:`OutOfBlocksError` when the
+      pool is dry — the scheduler's preemption trigger).
+    * ``commit_token(seq_id)`` — after the step, charge the token.
+    * ``release(seq_id, evicted=False)`` — free everything; ``evicted``
+      marks a preemption so evictions are first-class numbers, not
+      log archaeology.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        self.allocator = BlockAllocator(num_blocks, block_size)
+        self.block_size = block_size
+        self._tables: dict[object, BlockTable] = {}
+        self.evictions = 0
+        self.blocks_evicted = 0
+
+    # -- sizing ------------------------------------------------------------
+    def blocks_for(self, tokens: int) -> int:
+        return -(-tokens // self.block_size)  # ceil div
+
+    @property
+    def total_tokens_capacity(self) -> int:
+        return self.allocator.num_blocks * self.block_size
+
+    def fits_at_all(self, tokens: int) -> bool:
+        """Whole-pool feasibility (admission-time sanity: a request whose
+        worst case can never fit must be rejected up front, not starved)."""
+        return self.blocks_for(tokens) <= self.allocator.num_blocks
+
+    def can_admit(self, prompt_len: int) -> bool:
+        return self.blocks_for(prompt_len) <= self.allocator.num_free
+
+    # -- lifecycle ---------------------------------------------------------
+    def admit(self, seq_id, prompt_len: int) -> BlockTable:
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id!r} already admitted")
+        if prompt_len < 1:
+            raise ValueError(f"prompt_len must be >= 1, got {prompt_len}")
+        table = BlockTable(self.allocator.alloc(self.blocks_for(prompt_len)),
+                           prompt_len)
+        self._tables[seq_id] = table
+        return table
+
+    def reserve_next(self, seq_id) -> None:
+        t = self._tables[seq_id]
+        if t.num_tokens + 1 > t.capacity(self.block_size):
+            t.blocks.extend(self.allocator.alloc(1))
+
+    def commit_token(self, seq_id) -> None:
+        t = self._tables[seq_id]
+        if t.num_tokens + 1 > t.capacity(self.block_size):
+            raise RuntimeError(
+                f"commit_token for {seq_id!r} without reserve_next "
+                f"({t.num_tokens} tokens in {len(t.blocks)} blocks)")
+        t.num_tokens += 1
+
+    def release(self, seq_id, *, evicted: bool = False) -> None:
+        t = self._tables.pop(seq_id)
+        if evicted:
+            self.evictions += 1
+            self.blocks_evicted += len(t.blocks)
+        self.allocator.free(t.blocks)
+
+    def table(self, seq_id) -> BlockTable:
+        return self._tables[seq_id]
+
+    # -- observability -----------------------------------------------------
+    @property
+    def num_sequences(self) -> int:
+        return len(self._tables)
+
+    def occupancy(self) -> float:
+        """Fraction of the pool in use — the cache-occupancy gauge."""
+        return self.allocator.num_used / self.allocator.num_blocks
+
+    def internal_fragmentation(self) -> int:
+        """Allocated-but-unfilled token slots across live sequences
+        (bounded by ``num_sequences * (block_size - 1)`` + reservations)."""
+        return sum(t.capacity(self.block_size) - t.num_tokens
+                   for t in self._tables.values())

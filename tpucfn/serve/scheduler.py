@@ -1,0 +1,272 @@
+"""Continuous-batching decode scheduler.
+
+The serving throughput lever on TPU is the SCHEDULER, not the kernel
+(PAPERS.md: the Gemma-on-TPU serving writeup and the Podracer
+architectures both win at the batching layer): keep a fixed-shape decode
+batch full by admitting new prefills the moment slots and KV blocks free
+up, and retire finished sequences in place instead of draining the whole
+batch (the static-batch failure mode, where one long request holds B-1
+finished slots hostage).
+
+Shape discipline (the TPU-specific part): every jitted engine entry
+point runs at a FIXED shape — decode always at ``max_batch`` slots, and
+each prefill padded to a power-of-two length bucket capped at the cache
+capacity (the same next-pow2 family rule as
+``kernels/flash_autotune._bucket``), so steady state compiles
+``len(buckets) + 1`` programs total and never again.  Admission control
+(queue caps, deadlines, 429s) lives one layer up in
+``serve/frontend.py``; this module decides only WHAT RUNS NEXT.
+
+Preemption: when the block pool runs dry mid-decode, the youngest
+running sequence is evicted (blocks freed, sequence re-queued at the
+front of the waiting line) and later recomputed from its full prefix —
+prompt plus everything it had generated.  Greedy decode makes the
+recompute token-identical; sampled requests resume from a fresh rng fold
+(documented, not hidden).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from collections import deque
+
+from tpucfn.serve.kvcache import KVCacheManager, OutOfBlocksError
+
+# Smallest prefill bucket: below this, padding waste beats recompiles.
+MIN_PREFILL_BUCKET = 16
+
+
+def prefill_bucket(n: int, cache_len: int,
+                   min_bucket: int = MIN_PREFILL_BUCKET) -> int:
+    """Padded prefill length for an ``n``-token prefix: next power of two
+    from ``min_bucket``, capped at the cache capacity (a bucket longer
+    than the cache would trip the decode model's overflow poisoning).
+    One compile per bucket — the flash-autotune S-bucket rule applied to
+    serving shapes."""
+    if n > cache_len:
+        raise ValueError(f"prefix of {n} tokens exceeds cache_len {cache_len}")
+    b = min_bucket
+    while b < n:
+        b *= 2
+    return min(b, cache_len)
+
+
+class SequenceState(enum.Enum):
+    WAITING = "waiting"
+    RUNNING = "running"
+    FINISHED = "finished"
+    EXPIRED = "expired"   # deadline passed before completion
+
+
+@dataclasses.dataclass
+class Sequence:
+    """One in-flight generation.  ``prompt`` is immutable; ``generated``
+    grows one token per decode step.  After a preemption the re-prefill
+    prefix is ``prompt + generated`` (recompute, not cache migration)."""
+
+    seq_id: int
+    prompt: list[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    deadline: float | None = None   # absolute time.monotonic() cutoff
+    arrival: float = 0.0
+    generated: list[int] = dataclasses.field(default_factory=list)
+    state: SequenceState = SequenceState.WAITING
+    preemptions: int = 0
+
+    @property
+    def prefix(self) -> list[int]:
+        return self.prompt + self.generated
+
+    @property
+    def last_token(self) -> int:
+        return self.generated[-1] if self.generated else self.prompt[-1]
+
+    @property
+    def remaining(self) -> int:
+        return self.max_new_tokens - len(self.generated)
+
+
+@dataclasses.dataclass
+class PrefillWork:
+    """Run one bucketed prefill and sample the sequence's first token."""
+    seq: Sequence
+    slot: int
+    bucket: int
+
+
+@dataclasses.dataclass
+class DecodeWork:
+    """Run one decode iteration over every running slot."""
+    slots: dict[int, Sequence]  # slot -> sequence, all reserved for +1 token
+
+
+class ContinuousBatchingScheduler:
+    """FCFS admission, prefill-priority interleave, preempt-on-full.
+
+    The engine owns ``max_batch`` physical decode slots; this class owns
+    which sequence occupies each slot and whether the next engine call is
+    a prefill (a slot and the prompt's KV blocks are available — filling
+    the batch beats another decode iteration for every queued request's
+    TTFT) or a decode iteration over everything running.
+    """
+
+    def __init__(self, kv: KVCacheManager, *, max_batch: int, cache_len: int,
+                 eos_id: int | None = None,
+                 min_bucket: int = MIN_PREFILL_BUCKET):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.kv = kv
+        self.max_batch = max_batch
+        self.cache_len = cache_len
+        self.eos_id = eos_id
+        self.min_bucket = min_bucket
+        self.waiting: deque[Sequence] = deque()
+        self.running: dict[int, Sequence] = {}
+        self._free_slots = list(range(max_batch - 1, -1, -1))
+
+    # -- intake ------------------------------------------------------------
+    def add(self, seq: Sequence) -> None:
+        """Accept a sequence or raise ValueError when it can NEVER run —
+        the whole-pool feasibility check that keeps an oversized request
+        from starving at the head of the queue forever.  (Queue-depth
+        backpressure and deadlines are the frontend's jurisdiction.)"""
+        if not seq.prompt:
+            raise ValueError("empty prompt")
+        if seq.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {seq.max_new_tokens}")
+        total = len(seq.prompt) + seq.max_new_tokens
+        if total > self.cache_len:
+            raise ValueError(
+                f"prompt {len(seq.prompt)} + max_new {seq.max_new_tokens} "
+                f"exceeds cache_len {self.cache_len}")
+        # The last sampled token is never written back, hence total - 1.
+        if not self.kv.fits_at_all(total - 1):
+            raise ValueError(
+                f"request needs {self.kv.blocks_for(total - 1)} KV blocks; "
+                f"pool has {self.kv.allocator.num_blocks}")
+        seq.state = SequenceState.WAITING
+        self.waiting.append(seq)
+
+    # -- deadline sweep ----------------------------------------------------
+    def expire(self, now: float | None = None) -> list[Sequence]:
+        """Drop every waiting AND running sequence whose deadline has
+        passed (a running one frees its slot and blocks — capacity back
+        to live traffic immediately).  Returns the casualties; the
+        caller completes their requests with a timeout error."""
+        now = time.monotonic() if now is None else now
+        dead = [s for s in self.waiting
+                if s.deadline is not None and now > s.deadline]
+        for s in dead:
+            self.waiting.remove(s)
+            s.state = SequenceState.EXPIRED
+        for slot, s in list(self.running.items()):
+            if s.deadline is not None and now > s.deadline:
+                self._vacate(slot)
+                s.state = SequenceState.EXPIRED
+                dead.append(s)
+        return dead
+
+    # -- the core decision -------------------------------------------------
+    def next_work(self) -> PrefillWork | DecodeWork | None:
+        """Prefill if a waiting sequence fits (slot + blocks), else one
+        decode iteration, else None (idle)."""
+        if self._free_slots and self.waiting:
+            seq = self.waiting[0]
+            if self.kv.can_admit(len(seq.prefix)):
+                self.waiting.popleft()
+                slot = self._free_slots.pop()
+                self.kv.admit(seq.seq_id, len(seq.prefix))
+                seq.state = SequenceState.RUNNING
+                self.running[slot] = seq
+                return PrefillWork(
+                    seq, slot,
+                    prefill_bucket(len(seq.prefix), self.cache_len,
+                                   self.min_bucket))
+            # else: blocks are tied up in running sequences; decode below
+            # makes progress and will free them (add() guaranteed fit).
+        if self.running:
+            return DecodeWork(self._reserve_all())
+        return None
+
+    def _reserve_all(self) -> dict[int, Sequence]:
+        """Reserve the block slot every decode step is about to write
+        into (each step caches its INPUT token's K/V — one entry per
+        step, last step included), preempting youngest-first whenever
+        the pool runs dry.  Oldest sequences reserve first so preemption
+        converges: the oldest sequence alone always fits, because add()
+        checked the whole pool.  Returns the surviving running map."""
+        by_age = sorted(self.running.items(), key=lambda kv_: kv_[1].arrival)
+        for slot, seq in by_age:
+            if self.running.get(slot) is not seq:
+                continue  # preempted by an earlier reservation this round
+            while True:
+                try:
+                    self.kv.reserve_next(seq.seq_id)
+                    break
+                except OutOfBlocksError:
+                    victim_slot, victim = max(
+                        self.running.items(),
+                        key=lambda kv_: (kv_[1].arrival, kv_[1].seq_id))
+                    self.preempt(victim_slot)
+                    if victim is seq:
+                        break
+        return dict(self.running)
+
+    # -- step results ------------------------------------------------------
+    def record_prefill(self, slot: int, token: int) -> Sequence | None:
+        """First sampled token for a just-prefilled slot.  Returns the
+        sequence if it is already finished (max_new=1 or instant EOS)."""
+        seq = self.running[slot]
+        seq.generated.append(token)
+        return self._maybe_retire(slot, token)
+
+    def record_decode(self, slot: int, token: int) -> Sequence | None:
+        """One decoded token: charge the cache entry the step wrote (the
+        K/V of its INPUT token, covered by this round's reservation),
+        append, retire in place when done.  Returns the sequence iff
+        finished."""
+        seq = self.running[slot]
+        self.kv.commit_token(seq.seq_id)
+        seq.generated.append(token)
+        return self._maybe_retire(slot, token)
+
+    def _maybe_retire(self, slot: int, token: int) -> Sequence | None:
+        seq = self.running[slot]
+        if (self.eos_id is not None and token == self.eos_id) \
+                or len(seq.generated) >= seq.max_new_tokens:
+            self._vacate(slot)
+            seq.state = SequenceState.FINISHED
+            return seq
+        return None
+
+    def preempt(self, slot: int) -> Sequence:
+        """Evict a running sequence: blocks freed (counted as eviction),
+        slot returned, sequence re-queued FIRST so it is recomputed as
+        soon as capacity returns (no starvation of preempted work)."""
+        seq = self.running[slot]
+        self._vacate(slot, evicted=True)
+        seq.state = SequenceState.WAITING
+        seq.preemptions += 1
+        self.waiting.appendleft(seq)
+        return seq
+
+    def _vacate(self, slot: int, *, evicted: bool = False) -> None:
+        seq = self.running.pop(slot)
+        self.kv.release(seq.seq_id, evicted=evicted)
+        self._free_slots.append(slot)
+
+    # -- observability -----------------------------------------------------
+    @property
+    def num_waiting(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def num_running(self) -> int:
+        return len(self.running)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
