@@ -113,6 +113,11 @@ def main() -> int:
             "preemptions": snap["preemptions"],
             "kv_blocks_high_water": server.kv.allocator.high_water,
             "kv_blocks_leaked": server.kv.allocator.num_used,
+            # The full ServingMetrics snapshot rides on every row so a
+            # perf regression carries its own latency decomposition
+            # (queue depth, occupancy, token counts) instead of just the
+            # headline number (ISSUE 2 satellite).
+            "serving_metrics": snap,
         },
     }
     print(json.dumps(row))

@@ -117,8 +117,16 @@ def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=(),
 
     from tpucfn.ckpt import CheckpointManager
     from tpucfn.data import prefetch_to_mesh
-    from tpucfn.obs import MetricLogger, StepTimer, profile_steps
+    from tpucfn.obs import (
+        MetricLogger,
+        StepTimer,
+        Tracer,
+        profile_steps,
+        set_default_labels,
+        start_obs_server,
+    )
     from tpucfn.parallel import shard_batch
+    from tpucfn.train.trainer import TrainerObs
 
     from tpucfn.obs import enable_compile_cache, start_profiler_server
 
@@ -167,9 +175,8 @@ def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=(),
                     "process 0 — see its log for the path")
         elif delete_err:
             raise RuntimeError(delete_err)
-    logger = MetricLogger(run_dir / "logs", stdout_every=args.log_every)
     timer = StepTimer()
-    t_start = time.perf_counter()
+    host = jax.process_index()
 
     def run_eval(state, step):
         if eval_ds is None or not args.eval_every:
@@ -182,6 +189,46 @@ def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=(),
             n += 1
         if n:
             logger.log(step, {f"eval_{k}": v / n for k, v in sums.items()})
+
+    # try/finally from the FIRST resource on: a failing step, interrupt,
+    # or a bind error from the obs endpoint itself must still release
+    # the bound port and the open log/trace files — a retry in the same
+    # process would otherwise hit "Address already in use".
+    logger = tracer = obs_srv = None
+    try:
+        logger = MetricLogger(run_dir / "logs", stdout_every=args.log_every)
+        # The observability plane (ISSUE 2): registry metrics + trace
+        # spans per loop phase, and — when the launcher assigned this
+        # process a port (TPUCFN_OBS_PORT) — the per-host
+        # /metrics·/healthz·/varz endpoint, so every trainer rank in the
+        # fan-out is scrapeable.
+        registry = set_default_labels(host=str(host), role="trainer")
+        tracer = Tracer(run_dir / "trace", host_id=host, role="trainer")
+        obs = TrainerObs(registry, tracer)
+        obs_srv = start_obs_server(
+            registry, role="trainer", host_id=host,
+            health_fn=lambda: (True, {"step": obs.last_step.value}))
+        t_start = time.perf_counter()
+        return _train_loop_body(
+            trainer, ds, mesh, args, items_per_step, extra_axes, run_eval,
+            logger, timer, obs, t_start, run_dir)
+    finally:
+        if logger is not None:
+            logger.close()
+        if tracer is not None:
+            tracer.close()
+        if obs_srv is not None:
+            obs_srv.close()
+
+
+def _train_loop_body(trainer, ds, mesh, args, items_per_step, extra_axes,
+                     run_eval, logger, timer, obs, t_start, run_dir):
+    import jax
+
+    from tpucfn.ckpt import CheckpointManager
+    from tpucfn.data import prefetch_to_mesh
+    from tpucfn.obs import profile_steps
+
     with CheckpointManager(run_dir / "ckpt",
                            save_interval_steps=args.ckpt_every) as ckpt:
         # Restart implies resume: a relaunched job (restart supervisor,
@@ -197,13 +244,27 @@ def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=(),
         total = args.steps or len(ds) * args.num_epochs
         halt = min(total, args.stop_after) if args.stop_after else total
         metrics = {}
+        step = int(state.step)
         with profile_steps(run_dir / "profile", enabled=args.profile):
-            for batch in prefetch_to_mesh(ds.batches(None), mesh,
-                                          extra_axes=extra_axes):
-                if int(state.step) >= halt:
+            batches = iter(prefetch_to_mesh(ds.batches(None), mesh,
+                                            extra_axes=extra_axes))
+            _end = object()
+            while True:
+                # data_wait vs step vs ckpt: the three spans that say WHY
+                # a slow step was slow (input pipeline vs compute vs
+                # save) — per host, trace_id = the global step.  The wait
+                # is recorded only once the loop commits to a step, so
+                # the end-of-data drain never shows up as a phantom
+                # step's data wait.
+                t0_wait = time.monotonic()
+                batch = next(batches, _end)
+                t_wait = time.monotonic() - t0_wait
+                if batch is _end or step >= halt:
                     break
-                state, metrics = trainer.step(state, batch)
-                step = int(state.step)  # blocks -> honest step timing
+                obs.record_data_wait(step + 1, t0_wait, t_wait)
+                with obs.step(step + 1):
+                    state, metrics = trainer.step(state, batch)
+                    step = int(state.step)  # blocks -> honest step timing
                 timer.tick()
                 if t_start is not None:
                     # data staging + init/restore + first compile+step
@@ -212,12 +273,22 @@ def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=(),
                     t_start = None
                 if step % args.log_every == 0 or step == halt:
                     logger.log(step, {**{k: float(v) for k, v in metrics.items()},
-                                      "step_time": timer._last or 0.0})
+                                      "step_time": timer._last or 0.0,
+                                      "data_wait_time": t_wait})
                 if args.eval_every and step % args.eval_every == 0:
                     run_eval(state, step)
-                ckpt.save(step, state)
+                # CheckpointManager gates on save_interval_steps; record
+                # the span only when a save actually ran, else the ckpt
+                # metric measures no-op call overhead.
+                t0_ckpt = time.monotonic()
+                if ckpt.save(step, state):
+                    obs.record_ckpt(step, t0_ckpt,
+                                    time.monotonic() - t0_ckpt)
         run_eval(state, int(state.step))
-        ckpt.save(int(state.step), state, force=True)
+        t0_ckpt = time.monotonic()
+        if ckpt.save(int(state.step), state, force=True):
+            obs.record_ckpt(int(state.step), t0_ckpt,
+                            time.monotonic() - t0_ckpt)
 
     if jax.process_index() == 0:
         ips = timer.throughput(items_per_step)
@@ -227,5 +298,4 @@ def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=(),
             line += (f" items/sec={ips:.1f}"
                      f" items/sec/chip={ips / jax.device_count():.1f}")
         print(line, flush=True)
-    logger.close()
     return state

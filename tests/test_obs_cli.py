@@ -1,0 +1,165 @@
+"""`tpucfn obs` — the fleet aggregation view (ISSUE 2 tentpole): merged
+step timeline, per-host straggler report, request latency breakdown,
+as tables and as one JSON report."""
+
+import json
+
+import pytest
+
+from tpucfn.cli.main import main
+from tpucfn.obs.aggregate import (
+    host_straggler_report,
+    merge_step_timeline,
+    read_metrics_dir,
+    render_table,
+    step_spans_by_host,
+)
+
+
+def _write_host_logs(d, host, rows):
+    d.mkdir(parents=True, exist_ok=True)
+    p = d / f"train-host{host:03d}.jsonl"
+    with open(p, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    return p
+
+
+@pytest.fixture()
+def fleet_run(tmp_path):
+    """Two-host run where host 1 is a 2x straggler, plus a traced serve
+    workload — the obs CLI's full diet."""
+    logs = tmp_path / "logs"
+    for host, base in ((0, 0.10), (1, 0.20)):
+        _write_host_logs(logs, host, [
+            {"step": s, "time": 1000.0 + s, "loss": 2.0 - s * 0.1,
+             "step_time": base + s * 0.001, "data_wait_time": 0.01}
+            for s in range(1, 6)])
+    # serve trace via the real instrumented frontend
+    from test_obs_trace import FakeEngine  # tests dir is on sys.path
+
+    from tpucfn.obs import Tracer
+    from tpucfn.serve import Server
+
+    tracer = Tracer(tmp_path / "trace", host_id=0, role="server")
+    server = Server(FakeEngine(), num_blocks=64, block_size=8, tracer=tracer)
+    reqs = [server.submit([1] * n, max_new_tokens=2) for n in (3, 6)]
+    server.run_until_idle()
+    tracer.close()
+    assert all(r.error is None for r in reqs)
+    return tmp_path
+
+
+# ---- pure aggregation ---------------------------------------------------
+
+def test_merge_step_timeline_names_straggler(fleet_run):
+    by_host = read_metrics_dir(fleet_run / "logs")
+    timeline = merge_step_timeline(by_host, key="step_time")
+    assert [r["step"] for r in timeline] == [1, 2, 3, 4, 5]
+    for row in timeline:
+        assert row["hosts"] == 2
+        assert row["straggler"] == "train-host001"
+        assert row["max"] > row["min"]
+    assert merge_step_timeline(by_host, key="step_time", last=2)[0]["step"] == 4
+
+
+def test_host_straggler_report_flags_slow_host(fleet_run):
+    by_host = read_metrics_dir(fleet_run / "logs")
+    rows = host_straggler_report(by_host,
+                                 keys=("step_time", "data_wait_time"))
+    by_name = {r["host"]: r for r in rows}
+    slow = by_name["train-host001"]
+    fast = by_name["train-host000"]
+    assert slow["slow"] and not fast["slow"]
+    assert slow["vs_fleet_median"] > 1.2
+    assert slow["mean_data_wait_time"] == pytest.approx(0.01)
+
+
+def test_step_spans_feed_the_same_views(tmp_path):
+    from tpucfn.obs import Tracer, read_trace_dir
+
+    tr = Tracer(tmp_path / "trace", host_id=4, role="trainer")
+    for step in (1, 2):
+        tr.record("data_wait", start=0.0, dur_s=0.02, trace_id=step)
+        tr.record("step", start=0.0, dur_s=0.5, trace_id=step)
+    tr.record("ckpt", start=0.0, dur_s=0.1, trace_id=2)
+    tr.close()
+    by_host = step_spans_by_host(read_trace_dir(tmp_path / "trace"))
+    assert set(by_host) == {"host4"}
+    timeline = merge_step_timeline(by_host, key="step_time")
+    assert [r["step"] for r in timeline] == [1, 2]
+    assert timeline[0]["median"] == pytest.approx(0.5)
+
+
+def test_render_table_alignment_and_none():
+    text = render_table([{"a": 1.5, "b": None, "c": True},
+                         {"a": 10.25, "b": "x", "c": False}],
+                        ["a", "b", "c"])
+    lines = text.splitlines()
+    assert lines[0].split() == ["a", "b", "c"]
+    assert "1.5000" in lines[2] and "YES" in lines[2]
+    assert "10.2500" in lines[3]
+
+
+# ---- the CLI ------------------------------------------------------------
+
+def test_obs_cli_tables(fleet_run, capsys):
+    rc = main(["obs", "--run-dir", str(fleet_run)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "merged step timeline" in out
+    assert "train-host001" in out          # straggler named
+    assert "per-host stragglers" in out
+    assert "request latency breakdown" in out
+    assert "2/2 completed" in out
+
+
+def test_obs_cli_json_report(fleet_run, capsys):
+    rc = main(["obs", "--run-dir", str(fleet_run), "--json"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["hosts"] == ["train-host000", "train-host001"]
+    assert len(report["timeline"]) == 5
+    assert report["request_aggregate"]["completed"] == 2
+    assert {r["outcome"] for r in report["requests"]} == {"ok"}
+    # every request decomposes: queue + prefill + decode present
+    for r in report["requests"]:
+        assert r["queue_wait_s"] is not None
+        assert r["prefill_s"] is not None
+        assert r["ttft_s"] == pytest.approx(
+            r["queue_wait_s"] + r["prefill_s"], abs=0.005)
+
+
+def test_request_breakdown_keys_by_host_and_trace_id(tmp_path):
+    """Each server process numbers requests from 0 — a two-host gang's
+    traces must yield one row per (host, request), not fuse them."""
+    from tpucfn.obs import Tracer, read_trace_dir
+    from tpucfn.obs.aggregate import request_breakdown
+
+    for host, (lat, outcome) in ((0, (1.0, "ok")), (1, (9.0, "expired"))):
+        tr = Tracer(tmp_path / "trace", host_id=host, role="server")
+        tr.record("queue_wait", start=0.0, dur_s=0.1, trace_id=0)
+        tr.record("prefill", start=0.1, dur_s=0.2, trace_id=0)
+        tr.event("request_done", trace_id=0, outcome=outcome,
+                 latency_s=lat, ttft_s=0.3, generated=4)
+        tr.close()
+    rows, agg = request_breakdown(read_trace_dir(tmp_path / "trace"))
+    assert agg["requests"] == 2 and agg["completed"] == 1
+    assert [(r["host"], r["outcome"]) for r in rows] == \
+        [(0, "ok"), (1, "expired")]
+    assert rows[1]["total_s"] == 9.0
+
+
+def test_obs_cli_empty_run_dir(tmp_path, capsys):
+    rc = main(["obs", "--run-dir", str(tmp_path)])
+    assert rc == 0
+    assert "no metrics or trace JSONL found" in capsys.readouterr().out
+
+
+def test_obs_cli_explicit_dirs(fleet_run, tmp_path, capsys):
+    rc = main(["obs", "--run-dir", str(tmp_path),
+               "--logs-dir", str(fleet_run / "logs"),
+               "--trace-dir", str(fleet_run / "trace"), "--json"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["timeline"] and report["requests"]

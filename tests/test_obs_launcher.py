@@ -1,0 +1,98 @@
+"""Observability-plane wiring through the launch path (ISSUE 2): the
+launcher assigns every host a TPUCFN_OBS_PORT, the restart supervisor
+publishes its own metrics, and `tpucfn launch --obs-port` serves the
+supervisor endpoint while the gang runs."""
+
+import json
+import socket
+import sys
+import urllib.request
+from pathlib import Path
+
+from tpucfn.bootstrap import EnvContract
+from tpucfn.launch import Launcher, LocalTransport, run_with_restarts
+from tpucfn.obs import MetricRegistry
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _contract(tmp_path, n=3) -> EnvContract:
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("".join("127.0.0.1:0\n" for _ in range(n)))
+    return EnvContract(
+        workers_path=str(hostfile), workers_count=n, worker_chip_count=1,
+        coordinator="127.0.0.1:1234", host_id=0, storage=str(tmp_path),
+        generation=1)
+
+
+def test_host_env_obs_port_fanout(tmp_path):
+    launcher = Launcher(_contract(tmp_path), LocalTransport(),
+                        obs_base_port=9100)
+    # supervisor keeps 9100; hosts get base+1+host_id
+    assert launcher.host_env(0)["TPUCFN_OBS_PORT"] == "9101"
+    assert launcher.host_env(2)["TPUCFN_OBS_PORT"] == "9103"
+    plain = Launcher(_contract(tmp_path), LocalTransport())
+    assert "TPUCFN_OBS_PORT" not in plain.host_env(0)
+
+
+def test_children_receive_their_obs_port(tmp_path):
+    launcher = Launcher(_contract(tmp_path, n=2), LocalTransport(),
+                        obs_base_port=9200)
+    marker = tmp_path / "markers"
+    marker.mkdir()
+    code = (f"import os,pathlib;pathlib.Path(r'{marker}').joinpath("
+            "os.environ['TPUCFN_HOST_ID']).write_text("
+            "os.environ['TPUCFN_OBS_PORT'])")
+    procs = launcher.launch([sys.executable, "-c", code])
+    assert launcher.wait(procs) == 0
+    assert (marker / "0").read_text() == "9201"
+    assert (marker / "1").read_text() == "9202"
+
+
+def test_run_with_restarts_publishes_supervisor_metrics(tmp_path):
+    """Fail once, succeed on relaunch: attempts=2, restarts=1, rc=0."""
+    launcher = Launcher(_contract(tmp_path, n=1), LocalTransport())
+    flag = tmp_path / "ran_once"
+    code = (f"import pathlib,sys; p = pathlib.Path(r'{flag}');\n"
+            "sys.exit(0) if p.exists() else (p.write_text('x'), sys.exit(3))")
+    registry = MetricRegistry()
+    rc = run_with_restarts(launcher, [sys.executable, "-c", code],
+                           max_restarts=2, registry=registry)
+    assert rc == 0
+    v = registry.varz()["metrics"]
+    assert v["supervisor_launch_attempts_total"] == 2
+    assert v["supervisor_restarts_total"] == 1
+    assert v["supervisor_gang_hosts"] == 1
+    assert v["supervisor_last_exit_code"] == 0
+
+
+def test_cli_launch_obs_port_serves_supervisor_and_hands_out_ports(
+        tmp_path, capsys):
+    """The full CLI path: `tpucfn launch --obs-port` binds the
+    supervisor /metrics on the base port and each rank sees its own
+    TPUCFN_OBS_PORT — every role in the job scrapeable."""
+    from tpucfn.cli.main import main
+
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        base = probe.getsockname()[1]
+    state = str(tmp_path / "state")
+    assert main(["--state-dir", state, "create-stack", "--name", "obs",
+                 "--accelerator", "cpu-8"]) == 0
+    marker = tmp_path / "markers"
+    marker.mkdir()
+    # While the gang runs, scrape the supervisor endpoint from inside a
+    # rank (the supervisor closes it when launch returns).
+    code = (
+        "import os, pathlib, urllib.request\n"
+        f"body = urllib.request.urlopen('http://127.0.0.1:{base}/metrics',"
+        " timeout=5).read().decode()\n"
+        f"pathlib.Path(r'{marker}').joinpath(os.environ['TPUCFN_HOST_ID'])"
+        ".write_text(os.environ['TPUCFN_OBS_PORT'] + '\\n' + body)\n")
+    rc = main(["--state-dir", state, "launch", "--name", "obs",
+               "--obs-port", str(base), "--", sys.executable, "-c", code])
+    assert rc == 0
+    got = (marker / "0").read_text().splitlines()
+    assert got[0] == str(base + 1)
+    assert any(line.startswith("supervisor_launch_attempts_total")
+               for line in got[1:])

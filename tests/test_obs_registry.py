@@ -1,0 +1,245 @@
+"""Obs primitives + MetricRegistry/Histogram/exposition (ISSUE 2).
+
+Covers the satellite checklist — Summary reservoir truncation and
+percentile edge cases, StepTimer warmup exclusion, Counter.add under
+thread contention, Histogram bucket boundaries — plus the registry's
+get-or-create semantics and the Prometheus text format it renders.
+"""
+
+import json
+import math
+import re
+import threading
+
+import pytest
+
+from tpucfn.obs import Counter, Gauge, Histogram, MetricRegistry, StepTimer, Summary
+from tpucfn.obs.registry import sanitize_metric_name
+
+
+# ---- Summary ------------------------------------------------------------
+
+def test_summary_empty_percentiles_are_none():
+    s = Summary("x")
+    assert s.percentile(50) is None
+    snap = s.snapshot()
+    assert snap["count"] == 0 and snap["mean"] is None and snap["p99"] is None
+
+
+def test_summary_single_sample_every_percentile():
+    s = Summary("x")
+    s.observe(7.0)
+    assert s.percentile(0) == 7.0
+    assert s.percentile(50) == 7.0
+    assert s.percentile(100) == 7.0
+    assert s.snapshot()["p95"] == 7.0
+
+
+def test_summary_p0_p100_are_min_max():
+    s = Summary("x")
+    for v in (5.0, 1.0, 3.0, 9.0, 2.0):
+        s.observe(v)
+    assert s.percentile(0) == 1.0
+    assert s.percentile(100) == 9.0
+
+
+def test_summary_reservoir_truncates_to_recent_keep():
+    s = Summary("x", keep=10)
+    for v in range(100):
+        s.observe(float(v))
+    # exact aggregates survive truncation...
+    assert s.count == 100 and s.sum == sum(range(100))
+    # ...percentiles cover only the most recent `keep` samples (90..99)
+    assert len(s._recent) == 10
+    assert s.percentile(0) == 90.0 and s.percentile(100) == 99.0
+
+
+def test_summary_percentiles_one_pass_matches_individual():
+    s = Summary("x")
+    for v in (0.4, 0.1, 0.9, 0.2, 0.6):
+        s.observe(v)
+    pcts = s.percentiles((0.0, 50.0, 95.0, 100.0))
+    assert pcts == {0.0: s.percentile(0), 50.0: s.percentile(50),
+                    95.0: s.percentile(95), 100.0: s.percentile(100)}
+
+
+# ---- StepTimer ----------------------------------------------------------
+
+def test_step_timer_warmup_ticks_excluded_from_mean(monkeypatch):
+    clock = {"t": 0.0}
+
+    def fake_clock():
+        clock["t"] += 1.0  # one second per observation, deterministic
+        return clock["t"]
+
+    monkeypatch.setattr("tpucfn.obs.metrics.time.perf_counter", fake_clock)
+    t = StepTimer(warmup=2)
+    for _ in range(6):
+        t.tick()
+    # 6 ticks -> 5 measured deltas of 1.0; the first 2 are warmup
+    assert t._count == 5
+    assert t.mean_step_time == pytest.approx(1.0)
+    assert t._total == pytest.approx(3.0)  # only steady-state summed
+
+
+# ---- Counter under contention ------------------------------------------
+
+def test_counter_thread_contention_exact():
+    c = Counter("hits")
+    n_threads, n_adds = 8, 2000
+
+    def work():
+        for _ in range(n_adds):
+            c.add()
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n_threads * n_adds
+
+
+# ---- Histogram ----------------------------------------------------------
+
+def test_histogram_bucket_boundaries_le_semantics():
+    h = Histogram("h", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 100.0):
+        h.observe(v)
+    cum = dict(h.cumulative())
+    # le is INCLUSIVE: 1.0 lands in the le=1.0 bucket, 2.0 in le=2.0...
+    assert cum[1.0] == 2          # 0.5, 1.0
+    assert cum[2.0] == 4          # + 1.5, 2.0
+    assert cum[4.0] == 6          # + 3.0, 4.0
+    assert cum[math.inf] == 7     # + 100.0 overflow
+    assert h.count == 7
+    assert h.sum == pytest.approx(0.5 + 1.0 + 1.5 + 2.0 + 3.0 + 4.0 + 100.0)
+
+
+def test_histogram_cumulative_monotone_and_inf_equals_count():
+    h = Histogram("h")
+    import random
+    rng = random.Random(0)
+    for _ in range(500):
+        h.observe(rng.expovariate(10.0))
+    cum = h.cumulative()
+    counts = [c for _, c in cum]
+    assert counts == sorted(counts)
+    assert cum[-1][0] == math.inf and cum[-1][1] == 500
+
+
+def test_histogram_rejects_bad_buckets():
+    with pytest.raises(ValueError):
+        Histogram("h", buckets=())
+    with pytest.raises(ValueError):
+        Histogram("h", buckets=(1.0, 1.0))
+    with pytest.raises(ValueError):
+        Histogram("h", buckets=(2.0, 1.0))
+
+
+def test_histogram_snapshot_json_roundtrips():
+    h = Histogram("h", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    snap = json.loads(json.dumps(h.snapshot()))
+    assert snap["count"] == 1 and snap["buckets"]["+Inf"] == 1
+
+
+# ---- MetricRegistry -----------------------------------------------------
+
+def test_registry_get_or_create_returns_same_instrument():
+    r = MetricRegistry()
+    assert r.counter("a_total") is r.counter("a_total")
+    with pytest.raises(ValueError):
+        r.gauge("a_total")  # same name, different type
+
+
+def test_registry_rejects_conflicting_histogram_buckets():
+    r = MetricRegistry()
+    h = r.histogram("lat_seconds", buckets=(0.1, 1.0))
+    assert r.histogram("lat_seconds", buckets=(0.1, 1.0)) is h  # same config
+    with pytest.raises(ValueError):
+        r.histogram("lat_seconds", buckets=(0.5, 5.0))  # silently-wrong SLOs
+
+
+def test_registry_rejects_conflicting_summary_keep():
+    r = MetricRegistry()
+    s = r.summary("ttft_seconds", keep=128)
+    assert r.summary("ttft_seconds", keep=128) is s
+    with pytest.raises(ValueError):
+        r.summary("ttft_seconds", keep=4096)
+
+
+def test_registry_register_conflicting_object_raises():
+    r = MetricRegistry()
+    s = Summary("ttft")
+    assert r.register("ttft_seconds", s) is s
+    assert r.register("ttft_seconds", s) is s  # idempotent for same object
+    with pytest.raises(ValueError):
+        r.register("ttft_seconds", Summary("other"))
+
+
+def test_sanitize_metric_name():
+    assert sanitize_metric_name("ok_name:x") == "ok_name:x"
+    assert sanitize_metric_name("bad-name.1") == "bad_name_1"
+    assert sanitize_metric_name("9leading") == "_9leading"
+
+
+# ---- Prometheus exposition ---------------------------------------------
+
+LINE_RE = re.compile(
+    r"^(?:# (?:HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(?:\{[^{}]*\})? (?:[+-]?(?:\d+\.?\d*(?:e[+-]?\d+)?|Inf)|NaN))$"
+)
+
+
+def _valid_exposition(text: str) -> None:
+    """Line-by-line structural validation of the text format."""
+    assert text.endswith("\n")
+    for line in text.rstrip("\n").splitlines():
+        assert LINE_RE.match(line), f"invalid exposition line: {line!r}"
+
+
+def test_prometheus_exposition_all_types():
+    r = MetricRegistry(labels={"host": "3", "role": "trainer"})
+    r.counter("reqs_total", "requests").add(5)
+    r.gauge("depth").set(2)
+    s = r.summary("lat_seconds")
+    for v in (0.1, 0.2, 0.4):
+        s.observe(v)
+    h = r.histogram("step_seconds", buckets=(0.5, 1.0))
+    h.observe(0.25)
+    h.observe(3.0)
+    text = r.to_prometheus()
+    _valid_exposition(text)
+    assert '# TYPE reqs_total counter' in text
+    assert 'reqs_total{host="3",role="trainer"} 5.0' in text
+    assert '# HELP reqs_total requests' in text
+    assert 'lat_seconds{host="3",role="trainer",quantile="0.5"} 0.2' in text
+    assert 'lat_seconds_count{host="3",role="trainer"} 3.0' in text
+    assert 'step_seconds_bucket{host="3",role="trainer",le="+Inf"} 2.0' in text
+    assert 'step_seconds_bucket{host="3",role="trainer",le="0.5"} 1.0' in text
+
+
+def test_empty_summary_emits_no_quantiles_but_keeps_count():
+    r = MetricRegistry()
+    r.summary("empty_seconds")
+    text = r.to_prometheus()
+    _valid_exposition(text)
+    assert "quantile" not in text
+    assert "empty_seconds_count 0.0" in text
+
+
+def test_varz_snapshot_shape():
+    r = MetricRegistry(labels={"host": "0"})
+    r.counter("c_total").add(2)
+    r.histogram("h_seconds", buckets=(1.0,)).observe(0.5)
+    v = json.loads(json.dumps(r.varz()))
+    assert v["labels"] == {"host": "0"}
+    assert v["metrics"]["c_total"] == 2.0
+    assert v["metrics"]["h_seconds"]["count"] == 1
+
+
+def test_gauge_still_lock_free_assignment():
+    g = Gauge("g")
+    g.set(4)
+    assert g.value == 4.0

@@ -1,0 +1,124 @@
+"""Per-host obs endpoint smoke test (ISSUE 2 satellite): start the
+HTTP server on an ephemeral port, scrape it, and validate the
+Prometheus exposition line-by-line — plus /healthz semantics (200/503)
+and the /varz JSON snapshot.  Tier-1-safe: loopback only, port 0."""
+
+import json
+import re
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpucfn.obs import MetricRegistry, ObsServer, obs_port_from_env, start_obs_server
+
+LINE_RE = re.compile(
+    r"^(?:# (?:HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(?:\{[^{}]*\})? (?:[+-]?(?:\d+\.?\d*(?:e[+-]?\d+)?|Inf)|NaN))$"
+)
+
+
+def _get(url, timeout=5):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read().decode()
+
+
+@pytest.fixture()
+def obs():
+    reg = MetricRegistry(labels={"host": "0", "role": "test"})
+    reg.counter("scrapes_total", "how many").add(1)
+    reg.gauge("depth").set(3)
+    s = reg.summary("lat_seconds")
+    for v in (0.01, 0.02):
+        s.observe(v)
+    reg.histogram("step_seconds", buckets=(0.1, 1.0)).observe(0.05)
+    srv = ObsServer(reg, port=0, host="127.0.0.1", role="test", host_id=0)
+    yield srv
+    srv.close()
+
+
+def test_metrics_scrape_is_valid_prometheus_exposition(obs):
+    status, ctype, body = _get(obs.url("/metrics"))
+    assert status == 200
+    assert ctype.startswith("text/plain")
+    assert body.endswith("\n")
+    lines = body.rstrip("\n").splitlines()
+    assert lines, "empty exposition"
+    for line in lines:  # the line-by-line validation the satellite asks for
+        assert LINE_RE.match(line), f"invalid exposition line: {line!r}"
+    assert 'scrapes_total{host="0",role="test"} 1.0' in lines
+    assert '# TYPE step_seconds histogram' in lines
+    assert 'step_seconds_bucket{host="0",role="test",le="+Inf"} 1.0' in lines
+    # every histogram series carries cumulative counts ending at _count
+    count = [ln for ln in lines if ln.startswith("step_seconds_count")]
+    assert count and count[0].endswith(" 1.0")
+
+
+def test_healthz_ok_and_unhealthy_503():
+    reg = MetricRegistry()
+    state = {"ok": True}
+    srv = ObsServer(reg, port=0, host="127.0.0.1", role="trainer", host_id=2,
+                    health_fn=lambda: (state["ok"], {"step": 17}))
+    try:
+        status, _, body = _get(srv.url("/healthz"))
+        payload = json.loads(body)
+        assert status == 200
+        assert payload["status"] == "ok"
+        assert payload["role"] == "trainer" and payload["host_id"] == 2
+        assert payload["step"] == 17 and payload["uptime_s"] >= 0
+        state["ok"] = False
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.url("/healthz"))
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read().decode())["status"] == "unhealthy"
+    finally:
+        srv.close()
+
+
+def test_crashing_health_probe_is_unhealthy():
+    def boom():
+        raise RuntimeError("probe died")
+
+    srv = ObsServer(MetricRegistry(), port=0, host="127.0.0.1",
+                    health_fn=boom)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.url("/healthz"))
+        assert ei.value.code == 503
+        assert "probe_error" in json.loads(ei.value.read().decode())
+    finally:
+        srv.close()
+
+
+def test_varz_json_snapshot(obs):
+    status, ctype, body = _get(obs.url("/varz"))
+    assert status == 200 and ctype.startswith("application/json")
+    v = json.loads(body)
+    assert v["labels"]["role"] == "test"
+    assert v["metrics"]["scrapes_total"] == 1.0
+    assert v["metrics"]["lat_seconds"]["count"] == 2
+
+
+def test_unknown_path_404_and_index(obs):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(obs.url("/nope"))
+    assert ei.value.code == 404
+    status, _, body = _get(obs.url("/"))
+    assert status == 200 and "/metrics" in body
+
+
+def test_start_obs_server_env_gating(monkeypatch):
+    monkeypatch.delenv("TPUCFN_OBS_PORT", raising=False)
+    assert obs_port_from_env() is None
+    assert start_obs_server(MetricRegistry(), role="trainer") is None
+    monkeypatch.setenv("TPUCFN_OBS_PORT", "not-a-port")
+    assert obs_port_from_env() is None
+    monkeypatch.setenv("TPUCFN_OBS_PORT", "0")
+    srv = start_obs_server(MetricRegistry(), role="trainer",
+                           host="127.0.0.1")
+    try:
+        assert srv is not None and srv.port > 0
+        status, _, _ = _get(srv.url("/metrics"))
+        assert status == 200
+    finally:
+        srv.close()

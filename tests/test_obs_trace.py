@@ -1,0 +1,284 @@
+"""Span tracing + serve request lifecycle (ISSUE 2 tentpole).
+
+The acceptance pin: a serve run's trace JSONL reconstructs each
+request's TTFT decomposition (queue-wait + prefill + any decode-round
+time before the first token) that sums to the measured TTFT within
+5 ms.  Uses a duck-typed fake engine (Server only needs max_batch /
+cache_len / prefill / decode) so the timing is deterministic and the
+test runs in milliseconds, not compiles.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from tpucfn.obs import MetricRegistry, Tracer, read_trace_dir, read_trace_file
+from tpucfn.obs.aggregate import request_breakdown
+from tpucfn.serve import Server
+
+
+class FakeEngine:
+    """Deterministic delays instead of XLA programs."""
+
+    def __init__(self, max_batch=4, cache_len=64,
+                 prefill_delay=0.004, decode_delay=0.002):
+        self.max_batch = max_batch
+        self.cache_len = cache_len
+        self.prefill_delay = prefill_delay
+        self.decode_delay = decode_delay
+
+    def prefill(self, slot, prefix, bucket, temperature=0.0):
+        time.sleep(self.prefill_delay)
+        return 11
+
+    def decode(self, tokens_by_slot):
+        time.sleep(self.decode_delay)
+        return {s: 12 for s in tokens_by_slot}
+
+
+# ---- Tracer primitives --------------------------------------------------
+
+def test_span_nesting_and_parent_propagation(tmp_path):
+    tr = Tracer(tmp_path / "t.jsonl", host_id=3, role="trainer")
+    with tr.span("outer", trace_id=7, a=1) as s:
+        with tr.span("inner", trace_id=7):
+            time.sleep(0.001)
+        s["b"] = 2
+    tr.close()
+    events = read_trace_file(tmp_path / "t.jsonl")
+    inner = next(e for e in events if e["name"] == "inner")
+    outer = next(e for e in events if e["name"] == "outer")
+    assert inner["parent_id"] == outer["span_id"]
+    assert outer["parent_id"] is None
+    assert outer["attrs"] == {"a": 1, "b": 2}
+    assert outer["dur_s"] >= inner["dur_s"] >= 0.001
+    assert outer["host"] == 3 and outer["role"] == "trainer"
+
+
+def test_span_error_is_recorded(tmp_path):
+    tr = Tracer(tmp_path / "t.jsonl")
+    with pytest.raises(ValueError):
+        with tr.span("boom"):
+            raise ValueError("x")
+    tr.close()
+    [e] = read_trace_file(tmp_path / "t.jsonl")
+    assert e["attrs"]["error"] == "ValueError"
+
+
+def test_noop_tracer_writes_nothing_and_never_fails():
+    tr = Tracer(None)
+    assert not tr.enabled
+    with tr.span("x"):
+        pass
+    tr.event("y", trace_id=1)
+    tr.record("z", start=0.0, dur_s=1.0)
+    tr.close()
+
+
+def test_tracer_dir_derives_per_host_filename(tmp_path):
+    tr = Tracer(tmp_path, host_id=5, role="server")
+    tr.event("e")
+    tr.close()
+    assert (tmp_path / "trace-server-host005.jsonl").exists()
+
+
+def test_tracer_thread_safety(tmp_path):
+    tr = Tracer(tmp_path / "t.jsonl")
+
+    def work(i):
+        for j in range(50):
+            tr.event("e", trace_id=i, j=j)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    tr.close()
+    events = read_trace_file(tmp_path / "t.jsonl")
+    assert len(events) == 200  # no torn/interleaved lines
+    assert len({e["span_id"] for e in events}) == 200
+
+
+# ---- serve lifecycle ----------------------------------------------------
+
+def _run_traced_server(tmp_path, prompts, max_new=4, **server_kw):
+    tracer = Tracer(tmp_path / "trace", host_id=0, role="server")
+    server = Server(FakeEngine(), num_blocks=64, block_size=8,
+                    tracer=tracer, **server_kw)
+    reqs = [server.submit(p, max_new_tokens=max_new) for p in prompts]
+    server.run_until_idle()
+    tracer.close()
+    return server, reqs, read_trace_dir(tmp_path / "trace")
+
+
+def test_ttft_decomposition_sums_to_measured_ttft(tmp_path):
+    """ACCEPTANCE: queue-wait + prefill + first-token-window decode time
+    from the trace JSONL reconstructs each request's measured TTFT
+    within 5 ms."""
+    server, reqs, events = _run_traced_server(
+        tmp_path, [[1] * n for n in (3, 5, 9, 17, 2)])
+    rows, _ = request_breakdown(events)
+    by_id = {r["request"]: r for r in rows}
+    spans = [e for e in events if e["kind"] == "span"]
+    assert all(r.error is None for r in reqs)
+    for req in reqs:
+        measured_ttft = req.t_first_token - req.t_submit
+        row = by_id[req.req_id]
+        # decode-round time that falls before this request's first token
+        # (zero for fresh sequences — the first token IS the prefill's —
+        # but summed explicitly so the reconstruction is general):
+        first_tok_mono = req.t_submit + row["ttft_s"]
+        decode_before = sum(
+            min(e["start"] + e["dur_s"], first_tok_mono) - e["start"]
+            for e in spans
+            if e["name"] == "decode_round"
+            and req.req_id in e["attrs"]["seqs"]
+            and e["start"] < first_tok_mono)
+        decomposed = row["queue_wait_s"] + row["prefill_s"] + decode_before
+        assert decomposed == pytest.approx(measured_ttft, abs=0.005)
+        # and the trace's own ttft matches the request object's
+        assert row["ttft_s"] == pytest.approx(measured_ttft, abs=1e-6)
+
+
+def test_lifecycle_events_cover_queue_prefill_decode_done(tmp_path):
+    server, reqs, events = _run_traced_server(tmp_path, [[1, 2, 3]],
+                                              max_new=3)
+    names = [e["name"] for e in events]
+    assert names.count("request_submitted") == 1
+    assert names.count("queue_wait") == 1
+    assert names.count("prefill") == 1
+    assert names.count("decode_round") == 2  # tokens 2 and 3
+    assert names.count("request_done") == 1
+    done = next(e for e in events if e["name"] == "request_done")
+    assert done["attrs"]["outcome"] == "ok"
+    assert done["attrs"]["generated"] == 3
+    pf = next(e for e in events if e["name"] == "prefill")
+    assert pf["attrs"]["resumed"] is False
+    assert pf["attrs"]["bucket"] == 16
+
+
+def test_queue_wait_reflects_head_of_line_blocking(tmp_path):
+    """With a 1-slot engine the second request's queue wait covers the
+    whole first request — the 'why was it slow' answer the spans exist
+    to give."""
+    eng = FakeEngine(max_batch=1, prefill_delay=0.01, decode_delay=0.005)
+    tracer = Tracer(tmp_path / "trace", host_id=0, role="server")
+    server = Server(eng, num_blocks=64, block_size=8, tracer=tracer)
+    r1 = server.submit([1, 2], max_new_tokens=3)
+    r2 = server.submit([3, 4], max_new_tokens=1)
+    server.run_until_idle()
+    tracer.close()
+    rows, _ = request_breakdown(read_trace_dir(tmp_path / "trace"))
+    by_id = {r["request"]: r for r in rows}
+    # r2 waited at least r1's full occupancy (prefill + 2 decode rounds)
+    assert by_id[r2.req_id]["queue_wait_s"] >= 0.01 + 2 * 0.005 - 0.001
+    assert by_id[r1.req_id]["queue_wait_s"] < by_id[r2.req_id]["queue_wait_s"]
+    assert r1.error is None and r2.error is None
+
+
+def test_expired_request_done_event_keeps_partial_generated(tmp_path):
+    """A request that dies mid-decode is not zero-output work: the
+    request_done event carries the tokens it generated before the
+    deadline (what the error message already said)."""
+    from tpucfn.serve import DeadlineExceeded
+
+    eng = FakeEngine(max_batch=2, prefill_delay=0.0, decode_delay=0.03)
+    tracer = Tracer(tmp_path / "trace", host_id=0, role="server")
+    server = Server(eng, num_blocks=64, block_size=8, tracer=tracer)
+    req = server.submit([1, 2, 3], max_new_tokens=50, deadline_s=0.08)
+    server.run_until_idle()
+    tracer.close()
+    assert isinstance(req.error, DeadlineExceeded)
+    done = next(e for e in read_trace_dir(tmp_path / "trace")
+                if e["name"] == "request_done")
+    assert done["attrs"]["outcome"] == "expired"
+    # prefill gave token 1 instantly; 0.03s decode rounds against a
+    # 0.08s deadline leave at least one more token behind
+    assert done["attrs"]["generated"] >= 1
+
+
+def test_request_breakdown_aggregate(tmp_path):
+    server, reqs, events = _run_traced_server(
+        tmp_path, [[1] * 4, [2] * 6, [3] * 8], max_new=2)
+    rows, agg = request_breakdown(events)
+    assert agg["requests"] == 3 and agg["completed"] == 3
+    assert agg["ttft_s"]["p50"] is not None
+    assert agg["total_s"]["max"] >= agg["total_s"]["p50"]
+    for r in rows:
+        assert r["decode_rounds"] == 1  # max_new=2: prefill token + 1 round
+        assert r["outcome"] == "ok"
+
+
+def test_trainer_obs_phases_feed_registry_and_trace(tmp_path):
+    from tpucfn.train.trainer import TrainerObs
+
+    registry = MetricRegistry()
+    tracer = Tracer(tmp_path / "t.jsonl", host_id=1, role="trainer")
+    obs = TrainerObs(registry, tracer)
+    obs.record_data_wait(1, time.monotonic(), 0.02)
+    with obs.step(1):
+        time.sleep(0.001)
+    with obs.ckpt(1):
+        pass
+    tracer.close()
+    events = read_trace_file(tmp_path / "t.jsonl")
+    assert {e["name"] for e in events} == {"data_wait", "step", "ckpt"}
+    assert all(e["trace_id"] == 1 for e in events)
+    v = registry.varz()["metrics"]
+    assert v["train_steps_total"] == 1.0 and v["train_last_step"] == 1.0
+    assert v["train_data_wait_seconds"]["count"] == 1
+    assert v["train_step_seconds"]["count"] == 1
+
+
+# ---- the /metrics acceptance scrape ------------------------------------
+
+def test_metrics_endpoint_on_running_server_covers_serving_and_training(
+        tmp_path):
+    """ACCEPTANCE: GET /metrics on a serving process returns valid
+    Prometheus exposition covering the serving counters (TTFT,
+    tokens, preemptions, KV occupancy) AND registry-registered
+    training metrics — one registry, one scrape surface per host."""
+    import urllib.request
+
+    from tpucfn.obs import ObsServer
+
+    registry = MetricRegistry(labels={"host": "0"})
+    # a training-side metric registered into the same per-process registry
+    registry.histogram("train_step_seconds",
+                       "host-observed step wall time").observe(0.125)
+    server = Server(FakeEngine(), num_blocks=64, block_size=8,
+                    registry=registry)
+    for n in (3, 5):
+        server.submit([1] * n, max_new_tokens=2)
+    server.run_until_idle()
+    srv = ObsServer(registry, port=0, host="127.0.0.1", role="server")
+    try:
+        with urllib.request.urlopen(srv.url("/metrics"), timeout=5) as r:
+            assert r.status == 200
+            body = r.read().decode()
+    finally:
+        srv.close()
+    for needle in (
+        "serve_ttft_seconds_count",          # TTFT summary
+        "serve_generated_tokens_total",      # tokens/sec numerator
+        "serve_preemptions_total",           # preemptions
+        "serve_kv_cache_occupancy",          # KV occupancy
+        "serve_request_latency_seconds_bucket",  # the new Histogram
+        "train_step_seconds_bucket",         # training metric, same scrape
+    ):
+        assert needle in body, f"{needle} missing from exposition"
+    # structural validity, line by line (same rule as test_obs_server)
+    import re
+    LINE_RE = re.compile(
+        r"^(?:# (?:HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+"
+        r"|[a-zA-Z_:][a-zA-Z0-9_:]*(?:\{[^{}]*\})? "
+        r"(?:[+-]?(?:\d+\.?\d*(?:e[+-]?\d+)?|Inf)|NaN))$")
+    for line in body.rstrip("\n").splitlines():
+        assert LINE_RE.match(line), f"invalid exposition line: {line!r}"
+    # and the snapshot dict still carries the dashboard
+    snap = server.metrics.snapshot()
+    assert snap["completed"] == 2 and snap["generated_tokens"] == 4
+    assert json.dumps(snap)  # JSON-able end to end

@@ -116,7 +116,8 @@ def cmd_launch(args) -> int:
         return 1
     contract = converge(rec, _run_dir(args, args.name))
     transport = SSHTransport() if args.transport == "ssh" else LocalTransport()
-    launcher = Launcher(contract, transport)
+    launcher = Launcher(contract, transport,
+                        obs_base_port=args.obs_port or None)
     argv = list(args.cmd)
     if argv and argv[0] == "--":
         argv = argv[1:]
@@ -139,8 +140,27 @@ def cmd_launch(args) -> int:
             print(f"error: --kill-host-after host {inject[0]} out of range "
                   f"(cluster has {len(contract.hosts())} hosts)", file=sys.stderr)
             return 2
-    rc = run_with_restarts(launcher, argv, max_restarts=args.restarts,
-                           kill_host_after=inject)
+    obs_srv, registry = None, None
+    if args.obs_port:
+        # The supervisor is a fleet role too: it owns the base port, the
+        # per-host ranks get base+1+host_id (launcher.host_env).
+        from tpucfn.obs import MetricRegistry, start_obs_server
+
+        registry = MetricRegistry(labels={"role": "supervisor"})
+        obs_srv = start_obs_server(registry, port=args.obs_port,
+                                   role="supervisor")
+        # The launched gang is hosts()[:workers_count] (Launcher.launch's
+        # precedence rule) — only those ports will actually serve.
+        n_launched = len(contract.hosts()[:contract.workers_count])
+        print(f"supervisor obs endpoint: {obs_srv.url()} "
+              f"(hosts at ports {args.obs_port + 1}..."
+              f"{args.obs_port + n_launched})", file=sys.stderr)
+    try:
+        rc = run_with_restarts(launcher, argv, max_restarts=args.restarts,
+                               kill_host_after=inject, registry=registry)
+    finally:
+        if obs_srv is not None:
+            obs_srv.close()
     print(f"launch finished rc={rc}")
     return rc
 
@@ -243,19 +263,51 @@ def cmd_serve(args) -> int:
               file=sys.stderr)
         return 2
 
-    server = Server(engine, num_blocks=args.num_blocks,
-                    block_size=args.block_size,
-                    max_queued_tokens=args.max_queued_tokens)
-    reqs = []
-    for p in prompts:
-        try:
-            reqs.append(server.submit(
-                p, max_new_tokens=args.max_new,
-                temperature=args.temperature,
-                deadline_s=args.deadline_s))
-        except AdmissionError as e:
-            print(f"rejected ({e.status}): {e}", file=sys.stderr)
-    server.run_until_idle()
+    from tpucfn.obs import MetricRegistry, Tracer, start_obs_server
+
+    # Host identity: under `tpucfn launch` every rank carries
+    # TPUCFN_HOST_ID — without it a serve gang's trace files collide on
+    # one name and the hosts' /metrics label sets are indistinguishable.
+    host_id = int(os.environ.get("TPUCFN_HOST_ID", "0") or 0)
+    registry = MetricRegistry(labels={"role": "server",
+                                      "host": str(host_id)})
+    tracer = obs_srv = None
+    try:
+        # Inside the try from the first resource on: a failed port bind
+        # must not leak the tracer it was preceded by (and the tracer
+        # truncates the per-run trace file — open it only once the run
+        # is actually going to happen).
+        tracer = Tracer(args.trace_dir, host_id=host_id, role="server",
+                        truncate=True) if args.trace_dir else Tracer(None)
+        # --obs-port wins; otherwise the launcher-assigned
+        # TPUCFN_OBS_PORT applies (a serve gang under `tpucfn launch
+        # --obs-port` must bind the ports the supervisor printed);
+        # neither -> no endpoint.
+        obs_srv = start_obs_server(registry, port=args.obs_port,
+                                   role="server", host_id=host_id)
+        if obs_srv is not None:
+            print(f"obs endpoint: {obs_srv.url()}", file=sys.stderr)
+        server = Server(engine, num_blocks=args.num_blocks,
+                        block_size=args.block_size,
+                        max_queued_tokens=args.max_queued_tokens,
+                        registry=registry, tracer=tracer)
+        reqs = []
+        for p in prompts:
+            try:
+                reqs.append(server.submit(
+                    p, max_new_tokens=args.max_new,
+                    temperature=args.temperature,
+                    deadline_s=args.deadline_s))
+            except AdmissionError as e:
+                print(f"rejected ({e.status}): {e}", file=sys.stderr)
+        server.run_until_idle()
+    finally:
+        # Same contract as cmd_launch/run_train_loop: a failing run must
+        # still release the bound obs port and the open trace file.
+        if tracer is not None:
+            tracer.close()
+        if obs_srv is not None:
+            obs_srv.close()
     ok = sum(1 for r in reqs if r.error is None)
     print(f"served {ok}/{len(prompts)} requests "
           f"({len(prompts) - len(reqs)} rejected at submit)",
@@ -264,6 +316,99 @@ def cmd_serve(args) -> int:
     # Partial failure is failure: scripts wrapping this must see expired/
     # rejected requests in the exit code, not just in the JSON.
     return 0 if ok == len(prompts) else 1
+
+
+def cmd_obs(args) -> int:
+    """Aggregate per-host metrics JSONL + trace JSONL into one fleet
+    view: merged step timeline, per-host straggler report, request
+    latency breakdown.  The read side of the observability plane — the
+    answer to "which of my 64 hosts is slow and why" without tailing 64
+    files (ISSUE 2)."""
+    import json as _json
+    import time as _time
+
+    from tpucfn.obs import read_trace_dir
+    from tpucfn.obs.aggregate import (
+        host_straggler_report,
+        merge_step_timeline,
+        read_metrics_dir,
+        render_table,
+        request_breakdown,
+        step_spans_by_host,
+    )
+
+    run_dir = Path(args.run_dir).expanduser()
+    logs_dir = Path(args.logs_dir) if args.logs_dir else run_dir / "logs"
+    trace_dir = Path(args.trace_dir) if args.trace_dir else run_dir / "trace"
+
+    def one_pass() -> dict:
+        by_host = read_metrics_dir(logs_dir) if logs_dir.is_dir() else {}
+        events = read_trace_dir(trace_dir) if trace_dir.is_dir() else []
+        # Trainer trace spans feed the same views when the metrics JSONL
+        # is absent (span-only runs); with both present the metrics JSONL
+        # wins for the timeline (same host under two labels must not be
+        # counted as two hosts) and the spans add a second report.
+        span_hosts = step_spans_by_host(events)
+        timeline_src = by_host or span_hosts
+        report = {
+            "logs_dir": str(logs_dir),
+            "trace_dir": str(trace_dir),
+            "hosts": sorted(timeline_src),
+            "timeline": merge_step_timeline(timeline_src, key="step_time",
+                                            last=args.steps),
+            "stragglers": host_straggler_report(
+                timeline_src, keys=("step_time", "data_wait_time")),
+        }
+        if span_hosts and by_host:
+            report["trace_stragglers"] = host_straggler_report(
+                span_hosts, keys=("step_time", "data_wait_time"))
+        rows, agg = request_breakdown(events)
+        report["requests"], report["request_aggregate"] = rows, agg
+        return report
+
+    def show(report: dict) -> None:
+        if args.json:
+            print(_json.dumps(report))
+            return
+        print(f"# fleet view  logs={report['logs_dir']} "
+              f"trace={report['trace_dir']}")
+        if report["timeline"]:
+            print(f"\n== merged step timeline (last {args.steps}) ==")
+            print(render_table(report["timeline"],
+                               ["step", "hosts", "min", "median", "max",
+                                "straggler"]))
+        straggler_cols = ["host", "records", "mean_step_time",
+                          "mean_data_wait_time", "vs_fleet_median", "slow"]
+        if report["stragglers"]:
+            print("\n== per-host stragglers ==")
+            print(render_table(report["stragglers"], straggler_cols))
+        if report.get("trace_stragglers"):
+            print("\n== per-host stragglers (trace spans) ==")
+            print(render_table(report["trace_stragglers"], straggler_cols))
+        if report["requests"]:
+            print("\n== request latency breakdown ==")
+            print(render_table(
+                report["requests"],
+                ["host", "request", "queue_wait_s", "prefill_s", "decode_s",
+                 "ttft_s", "total_s", "generated", "outcome"]))
+            agg = report["request_aggregate"]
+            print(f"\n{agg['completed']}/{agg['requests']} completed; "
+                  "p50/p95 (s): " + "  ".join(
+                      f"{k.removesuffix('_s')}="
+                      f"{(agg[k]['p50'] or 0):.4f}/{(agg[k]['p95'] or 0):.4f}"
+                      for k in ("queue_wait_s", "prefill_s", "decode_s",
+                                "ttft_s", "total_s")))
+        if not (report["timeline"] or report["stragglers"]
+                or report["requests"]):
+            print("no metrics or trace JSONL found "
+                  f"under {report['logs_dir']} / {report['trace_dir']}")
+
+    show(one_pass())
+    while args.watch:
+        _time.sleep(args.watch)
+        print()
+        show(one_pass())
+    return 0
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -315,6 +460,10 @@ def build_parser() -> argparse.ArgumentParser:
     l.add_argument("--kill-host-after", metavar="HOST:SECONDS",
                    help="fault injection: SIGKILL host's rank after N "
                         "seconds on the first attempt (recovery drill)")
+    l.add_argument("--obs-port", type=int, default=0, metavar="BASE",
+                   help="observability plane: supervisor /metrics on BASE, "
+                        "each host's process on BASE+1+host_id via "
+                        "TPUCFN_OBS_PORT (0 = off)")
     l.add_argument("cmd", nargs=argparse.REMAINDER)
     l.set_defaults(fn=cmd_launch)
 
@@ -381,7 +530,30 @@ def build_parser() -> argparse.ArgumentParser:
                          "tokens before 429")
     sv.add_argument("--deadline-s", type=float, default=None)
     sv.add_argument("--seed", type=int, default=0)
+    sv.add_argument("--obs-port", type=int, default=None, metavar="PORT",
+                    help="serve /metrics, /healthz, /varz on PORT while the "
+                         "workload runs (0 = ephemeral port, printed)")
+    sv.add_argument("--trace-dir", metavar="DIR",
+                    help="write request-lifecycle trace spans (queue_wait/"
+                         "prefill/decode_round/request_done JSONL) to DIR")
     sv.set_defaults(fn=cmd_serve)
+
+    ob = sub.add_parser(
+        "obs",
+        help="aggregate per-host metrics/trace JSONL into one fleet view "
+             "(merged step timeline, stragglers, request latency breakdown)")
+    ob.add_argument("--run-dir", required=True,
+                    help="the training/serving --run-dir (expects logs/ "
+                         "and trace/ beneath unless overridden)")
+    ob.add_argument("--logs-dir", help="metrics JSONL dir (default RUN/logs)")
+    ob.add_argument("--trace-dir", help="trace JSONL dir (default RUN/trace)")
+    ob.add_argument("--steps", type=int, default=20,
+                    help="timeline rows to show (most recent N steps)")
+    ob.add_argument("--json", action="store_true",
+                    help="emit the full report as one JSON object")
+    ob.add_argument("--watch", type=float, default=0, metavar="SECONDS",
+                    help="re-read and re-render every N seconds (tail mode)")
+    ob.set_defaults(fn=cmd_obs)
 
     return p
 

@@ -71,10 +71,18 @@ class SSHTransport(Transport):
 class Launcher:
     contract: EnvContract
     transport: Transport
+    # Observability-plane port fan-out: when set, the supervisor keeps
+    # ``obs_base_port`` for its own /metrics endpoint and each host's
+    # process gets ``base + 1 + host_id`` via TPUCFN_OBS_PORT — every
+    # role in the job becomes scrapeable at a predictable address
+    # (tpucfn/obs/server.py documents the endpoint surface).
+    obs_base_port: int | None = None
 
     def host_env(self, host_id: int) -> dict[str, str]:
         env = self.contract.to_env()
         env["TPUCFN_HOST_ID"] = str(host_id)
+        if self.obs_base_port is not None:
+            env["TPUCFN_OBS_PORT"] = str(self.obs_base_port + 1 + host_id)
         return env
 
     def launch(
@@ -159,6 +167,7 @@ def run_with_restarts(
     max_restarts: int = 0,
     backoff_s: float = 0.0,
     kill_host_after: tuple[int, float] | None = None,
+    registry=None,
 ) -> int:
     """Supervise a job: relaunch the whole gang after a failure.
 
@@ -168,19 +177,42 @@ def run_with_restarts(
     their latest step (the examples' ``--resume`` path). The reference's
     answer here was "the training job dies and is re-run by hand"; this
     automates the re-run.
+
+    ``registry`` (a ``tpucfn.obs.MetricRegistry``) makes the supervisor
+    itself a scrapeable role: attempts, restarts, gang size, and the
+    last exit code are published so a dashboard can tell "training is
+    slow" apart from "training is crash-looping".
     """
     import time
 
+    if registry is None:
+        # Throwaway registry: identical flow, nothing exported — keeps
+        # the loop free of per-metric None guards.
+        from tpucfn.obs.registry import MetricRegistry
+
+        registry = MetricRegistry()
+    attempts_c = registry.counter(
+        "supervisor_launch_attempts_total", "gang launches (incl. first)")
+    restarts_c = registry.counter(
+        "supervisor_restarts_total", "relaunches after a failure")
+    hosts_g = registry.gauge(
+        "supervisor_gang_hosts", "hosts in the launched gang")
+    rc_g = registry.gauge(
+        "supervisor_last_exit_code", "exit code of the last finished gang")
     attempt = 0
     while True:
         # Fault injection fires on the first attempt only — the drill is
         # "die once, recover from checkpoint".
         inject = kill_host_after if attempt == 0 else None
         procs = launcher.launch(argv, kill_host_after=inject)
+        attempts_c.add()
+        hosts_g.set(len(procs))
         rc = launcher.wait(procs)
+        rc_g.set(rc)
         if rc == 0 or attempt >= max_restarts:
             return rc
         attempt += 1
+        restarts_c.add()
         if backoff_s:
             time.sleep(backoff_s)
 

@@ -10,3 +10,19 @@ from tpucfn.obs.profiler import (  # noqa: F401
     profile_steps,
     start_profiler_server,
 )
+from tpucfn.obs.registry import (  # noqa: F401
+    Histogram,
+    MetricRegistry,
+    default_registry,
+    set_default_labels,
+)
+from tpucfn.obs.server import (  # noqa: F401
+    ObsServer,
+    obs_port_from_env,
+    start_obs_server,
+)
+from tpucfn.obs.trace import (  # noqa: F401
+    Tracer,
+    read_trace_dir,
+    read_trace_file,
+)

@@ -1,0 +1,186 @@
+"""Fleet aggregation over per-host JSONL metrics and trace files.
+
+The write side (MetricLogger, Tracer) produces one file per host; this
+is the read side ``tpucfn obs`` uses to answer the three questions you
+otherwise tail 64 files for:
+
+* **merged step timeline** — for each global step, every host's wall
+  time fused into min/median/max + which host was slowest;
+* **per-host straggler report** — mean step/data-wait time per host
+  relative to the fleet median (the Podracer-style per-actor timing
+  decomposition: a 1.3x host is a hardware or input-pipeline problem,
+  not a model problem);
+* **request latency breakdown** — per-request queue-wait / prefill /
+  decode reconstructed from serve trace spans, with fleet aggregates.
+
+Everything here is pure functions over parsed dicts so the CLI, tests,
+and notebooks share one implementation.
+"""
+
+from __future__ import annotations
+
+import statistics
+from pathlib import Path
+from typing import Iterable
+
+from tpucfn.obs.trace import read_trace_file
+
+
+def read_metrics_dir(d: str | Path) -> dict[str, list[dict]]:
+    """``host label -> [records]`` for every ``*.jsonl`` under ``d``
+    (one file per host by MetricLogger convention; torn lines skipped —
+    same still-being-appended tolerance as the trace reader)."""
+    return {p.stem: read_trace_file(p)
+            for p in sorted(Path(d).glob("*.jsonl"))}
+
+
+def merge_step_timeline(by_host: dict[str, list[dict]],
+                        key: str = "step_time",
+                        last: int | None = None) -> list[dict]:
+    """One row per global step seen on any host: per-step fleet spread
+    of ``key`` plus the slowest host — the merged timeline view."""
+    per_step: dict[int, dict[str, float]] = {}
+    for host, rows in by_host.items():
+        for r in rows:
+            if key in r and "step" in r:
+                per_step.setdefault(int(r["step"]), {})[host] = float(r[key])
+    steps = sorted(per_step)
+    if last is not None:
+        steps = steps[-last:]
+    out = []
+    for s in steps:
+        vals = per_step[s]
+        straggler = max(vals, key=vals.get)
+        out.append({
+            "step": s,
+            "hosts": len(vals),
+            "min": min(vals.values()),
+            "median": statistics.median(vals.values()),
+            "max": vals[straggler],
+            "straggler": straggler,
+        })
+    return out
+
+
+def host_straggler_report(by_host: dict[str, list[dict]],
+                          keys: tuple[str, ...] = ("step_time",),
+                          slow_factor: float = 1.2) -> list[dict]:
+    """Per-host means of ``keys`` with each host's ratio to the fleet
+    median of the first key; ``slow`` flags ratios above
+    ``slow_factor`` (the "go look at that host" bit)."""
+    rows = []
+    for host, recs in sorted(by_host.items()):
+        row: dict = {"host": host, "records": len(recs)}
+        for k in keys:
+            vals = [float(r[k]) for r in recs if k in r]
+            row[f"mean_{k}"] = statistics.fmean(vals) if vals else None
+            row[f"n_{k}"] = len(vals)
+        rows.append(row)
+    primary = f"mean_{keys[0]}"
+    meds = [r[primary] for r in rows if r[primary] is not None]
+    fleet_median = statistics.median(meds) if meds else None
+    for r in rows:
+        if fleet_median and r[primary] is not None:
+            r["vs_fleet_median"] = r[primary] / fleet_median
+            r["slow"] = r["vs_fleet_median"] > slow_factor
+        else:
+            r["vs_fleet_median"], r["slow"] = None, False
+    return rows
+
+
+def request_breakdown(events: Iterable[dict]) -> tuple[list[dict], dict]:
+    """Per-request latency decomposition from serve trace events.
+
+    Returns ``(rows, aggregate)``: one row per request with queue_wait /
+    prefill (first, non-resumed) / decode (sum of the decode rounds
+    whose batch contained this sequence) / ttft / total and the
+    outcome; aggregate carries fleet percentiles of each part.
+
+    Requests are keyed by ``(host, trace_id)``: each server process
+    numbers its requests from 0, so in a multi-host serve gang the same
+    trace_id appears once per host and keying on it alone would fuse
+    different hosts' requests into one wrong row.
+    """
+    per_req: dict = {}
+    decode_rounds: list[dict] = []
+
+    def req(host, tid):
+        return per_req.setdefault((host, tid), {
+            "host": host, "request": tid,
+            "queue_wait_s": None, "prefill_s": None,
+            "re_prefill_s": 0.0, "decode_s": 0.0, "decode_rounds": 0,
+            "ttft_s": None, "total_s": None, "generated": None,
+            "outcome": None})
+
+    for e in events:
+        name, tid, host = e.get("name"), e.get("trace_id"), e.get("host")
+        attrs = e.get("attrs", {})
+        if name == "queue_wait" and tid is not None:
+            req(host, tid)["queue_wait_s"] = e["dur_s"]
+        elif name == "prefill" and tid is not None:
+            if attrs.get("resumed"):
+                req(host, tid)["re_prefill_s"] += e["dur_s"]
+            else:
+                req(host, tid)["prefill_s"] = e["dur_s"]
+        elif name == "decode_round":
+            decode_rounds.append(e)
+        elif name == "request_done" and tid is not None:
+            r = req(host, tid)
+            r["outcome"] = attrs.get("outcome")
+            r["total_s"] = attrs.get("latency_s")
+            r["ttft_s"] = attrs.get("ttft_s")
+            r["generated"] = attrs.get("generated")
+    for e in decode_rounds:
+        for sid in e.get("attrs", {}).get("seqs", ()):
+            key = (e.get("host"), sid)
+            if key in per_req:
+                per_req[key]["decode_s"] += e["dur_s"]
+                per_req[key]["decode_rounds"] += 1
+    rows = [per_req[k] for k in sorted(per_req,
+                                       key=lambda k: (str(k[0]), str(k[1])))]
+
+    from tpucfn.obs.metrics import nearest_rank
+
+    agg: dict = {"requests": len(rows),
+                 "completed": sum(1 for r in rows if r["outcome"] == "ok")}
+    for part in ("queue_wait_s", "prefill_s", "decode_s", "ttft_s", "total_s"):
+        xs = sorted(r[part] for r in rows if r[part] is not None)
+        agg[part] = {"p50": nearest_rank(xs, 50), "p95": nearest_rank(xs, 95),
+                     "max": xs[-1] if xs else None}
+    return rows, agg
+
+
+def step_spans_by_host(events: Iterable[dict]) -> dict[str, list[dict]]:
+    """Regroup trainer trace spans into the by-host record shape the
+    timeline/straggler views consume (span name -> ``<name>_time``
+    column, trace_id -> step) — so traces alone, without the metrics
+    JSONL, still feed the fleet views."""
+    by_host: dict[str, list[dict]] = {}
+    for e in events:
+        if e.get("kind") != "span" or e.get("name") not in (
+                "data_wait", "step", "ckpt"):
+            continue
+        host = f"host{e.get('host')}" if e.get("host") is not None else "host?"
+        rec: dict = {f"{e['name']}_time": e["dur_s"]}
+        if e.get("trace_id") is not None:
+            rec["step"] = e["trace_id"]
+        by_host.setdefault(host, []).append(rec)
+    return by_host
+
+
+def render_table(rows: list[dict], columns: list[str],
+                 float_fmt: str = "{:.4f}") -> str:
+    """Minimal fixed-width table (no external deps on the hosts)."""
+    def cell(v):
+        if isinstance(v, bool):
+            return "YES" if v else ""
+        if isinstance(v, float):
+            return float_fmt.format(v)
+        return "" if v is None else str(v)
+
+    grid = [columns] + [[cell(r.get(c)) for c in columns] for r in rows]
+    widths = [max(len(row[i]) for row in grid) for i in range(len(columns))]
+    lines = ["  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip()
+             for row in grid]
+    lines.insert(1, "  ".join("-" * w for w in widths))
+    return "\n".join(lines)

@@ -19,6 +19,16 @@ from typing import Any, Mapping
 import jax
 
 
+def nearest_rank(xs_sorted: list, p: float):
+    """Nearest-rank percentile over an already-sorted sample list (None
+    when empty) — the one formula Summary, and the fleet aggregator in
+    ``obs.aggregate``, must agree on."""
+    if not xs_sorted:
+        return None
+    last = len(xs_sorted) - 1
+    return xs_sorted[min(last, max(0, round(p / 100.0 * last)))]
+
+
 class Counter:
     """Monotonic, thread-safe counter (requests served, tokens emitted,
     rejections...).  Serving-side instrumentation shares the training
@@ -74,22 +84,33 @@ class Summary:
             if len(self._recent) > self._keep:
                 del self._recent[: len(self._recent) - self._keep]
 
-    def percentile(self, p: float) -> float | None:
+    def read(self) -> tuple[int, float, list[float]]:
+        """``(count, sum, sorted reservoir)`` under ONE lock acquisition
+        — exposition must not pair a count with a sum from a different
+        moment (rate(x_sum)/rate(x_count) assumes they move together)."""
         with self._lock:
-            if not self._recent:
-                return None
-            xs = sorted(self._recent)
-        i = min(len(xs) - 1, max(0, round(p / 100.0 * (len(xs) - 1))))
-        return xs[i]
+            return self.count, self.sum, sorted(self._recent)
+
+    def percentiles(self, ps: tuple[float, ...]) -> dict[float, float | None]:
+        """All requested percentiles from ONE sorted copy taken under ONE
+        lock acquisition — a snapshot is three percentiles, and sorting
+        the reservoir per percentile (re-taking the lock each time) both
+        triples the work and lets samples land between reads."""
+        _, _, xs = self.read()
+        return {p: nearest_rank(xs, p) for p in ps}
+
+    def percentile(self, p: float) -> float | None:
+        return self.percentiles((p,))[p]
 
     @property
     def mean(self) -> float | None:
         return self.sum / self.count if self.count else None
 
     def snapshot(self) -> dict:
-        return {"count": self.count, "mean": self.mean,
-                "p50": self.percentile(50), "p95": self.percentile(95),
-                "p99": self.percentile(99)}
+        count, total, xs = self.read()
+        return {"count": count, "mean": (total / count if count else None),
+                "p50": nearest_rank(xs, 50), "p95": nearest_rank(xs, 95),
+                "p99": nearest_rank(xs, 99)}
 
 
 class StepTimer:
@@ -164,6 +185,11 @@ class MetricLogger:
                     pass
         self.stdout_every = stdout_every
         self.name = name
+        self._closed = False
+        # log() and close() can race (serving thread vs shutdown path);
+        # the flag alone is check-then-act, so writes and the close both
+        # happen under this lock.
+        self._lock = threading.Lock()
 
     def log(self, step: int, metrics: Mapping[str, Any]) -> None:
         record = {"step": int(step), "time": time.time()}
@@ -172,15 +198,19 @@ class MetricLogger:
                 record[k] = float(v)
             except (TypeError, ValueError):
                 record[k] = str(v)
-        if self._f is not None:
-            self._f.write(json.dumps(record) + "\n")
-        if self._tb is not None:
-            import tensorflow as tf
+        with self._lock:
+            if self._closed:  # late log() after close() is a no-op
+                return
+            if self._f is not None:
+                self._f.write(json.dumps(record) + "\n")
+            if self._tb is not None:
+                import tensorflow as tf
 
-            with self._tb.as_default():
-                for k, v in record.items():
-                    if k not in ("step", "time") and isinstance(v, float):
-                        tf.summary.scalar(f"{self.name}/{k}", v, step=int(step))
+                with self._tb.as_default():
+                    for k, v in record.items():
+                        if k not in ("step", "time") and isinstance(v, float):
+                            tf.summary.scalar(f"{self.name}/{k}", v,
+                                              step=int(step))
         if jax.process_index() == 0 and self.stdout_every and step % self.stdout_every == 0:
             body = " ".join(
                 f"{k}={v:.5g}" if isinstance(v, float) else f"{k}={v}"
@@ -190,7 +220,14 @@ class MetricLogger:
             print(f"[{self.name}] {body}", flush=True)
 
     def close(self) -> None:
-        if self._f is not None:
-            self._f.close()
-        if self._tb is not None:
-            self._tb.close()
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            if self._f is not None:
+                self._f.close()
+            if self._tb is not None:
+                # Flush before close: tf's writer buffers events, and a
+                # close without flush can drop the tail of the run.
+                self._tb.flush()
+                self._tb.close()

@@ -1,0 +1,143 @@
+"""Per-host observability HTTP endpoint.
+
+Every role in the fan-out — trainer rank, serving frontend, restart
+supervisor — binds a tiny stdlib HTTP server so the whole fleet is
+scrapeable (ISSUE 2: the reference's answer was ssh + tail over
+``/var/log``; per-host JSONL fixed durability but not visibility):
+
+* ``GET /metrics`` — Prometheus text exposition of the host's registry.
+* ``GET /healthz`` — liveness: 200 ``{"status":"ok",...}`` while the
+  role's health callback agrees, 503 otherwise (the shape load
+  balancers and the restart supervisor probe).
+* ``GET /varz``    — the registry's full JSON snapshot (counters plus
+  summary/histogram decompositions), for humans and ``tpucfn obs``.
+
+Port convention: ``TPUCFN_OBS_PORT`` carries each process's assigned
+port (the launcher assigns ``base + 1 + host_id`` per host, keeping
+``base`` for its own supervisor endpoint — see launch/launcher.py).
+Port 0 binds an ephemeral port (tests; single-host ad hoc runs) — the
+bound port is on :attr:`ObsServer.port`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+from tpucfn.obs.registry import MetricRegistry, default_registry
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# health_fn() -> (healthy, detail_dict); detail is merged into the body.
+HealthFn = Callable[[], tuple[bool, dict]]
+
+
+class ObsServer:
+    """One registry behind /metrics, /healthz, /varz on a daemon thread."""
+
+    def __init__(self, registry: MetricRegistry | None = None, *,
+                 port: int = 0, host: str = "0.0.0.0", role: str = "",
+                 host_id: int | None = None, health_fn: HealthFn | None = None):
+        self.registry = registry if registry is not None else default_registry()
+        self.role = role
+        self.host_id = host_id
+        self.health_fn = health_fn
+        self._t0 = time.monotonic()
+        obs = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # no per-scrape stderr spam
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                if path == "/metrics":
+                    body = obs.registry.to_prometheus().encode()
+                    self._send(200, body, PROMETHEUS_CONTENT_TYPE)
+                elif path == "/healthz":
+                    code, payload = obs._health()
+                    self._send(code, json.dumps(payload).encode(),
+                               "application/json")
+                elif path == "/varz":
+                    self._send(200, json.dumps(obs.registry.varz()).encode(),
+                               "application/json")
+                elif path == "/":
+                    self._send(200, b"/metrics /healthz /varz\n", "text/plain")
+                else:
+                    self._send(404, b"not found\n", "text/plain")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"tpucfn-obs:{self._httpd.server_address[1]}")
+        self._thread.start()
+
+    def _health(self) -> tuple[int, dict]:
+        healthy, detail = True, {}
+        if self.health_fn is not None:
+            try:
+                healthy, detail = self.health_fn()
+            except Exception as e:  # a crashing probe IS unhealthy
+                healthy, detail = False, {"probe_error": repr(e)}
+        payload = {
+            "status": "ok" if healthy else "unhealthy",
+            "role": self.role,
+            "host_id": self.host_id,
+            "pid": os.getpid(),
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+            **detail,
+        }
+        return (200 if healthy else 503), payload
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def url(self, path: str = "/metrics") -> str:
+        host = self._httpd.server_address[0]
+        if host in ("0.0.0.0", ""):
+            host = "127.0.0.1"
+        return f"http://{host}:{self.port}{path}"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def obs_port_from_env(env: dict | None = None) -> int | None:
+    """The launcher-assigned port for this process, or None when the run
+    opted out of the obs plane (unset / empty / unparseable)."""
+    raw = (env or os.environ).get("TPUCFN_OBS_PORT", "").strip()
+    try:
+        return int(raw) if raw else None
+    except ValueError:
+        return None
+
+
+def start_obs_server(registry: MetricRegistry | None = None, *,
+                     port: int | None = None, role: str = "",
+                     host: str = "0.0.0.0",
+                     host_id: int | None = None,
+                     health_fn: HealthFn | None = None) -> ObsServer | None:
+    """Start the endpoint for this process; ``port=None`` consults
+    ``TPUCFN_OBS_PORT`` and returns None when the env opted out — the
+    one-liner every role calls unconditionally."""
+    if port is None:
+        port = obs_port_from_env()
+        if port is None:
+            return None
+    return ObsServer(registry, port=port, host=host, role=role,
+                     host_id=host_id, health_fn=health_fn)

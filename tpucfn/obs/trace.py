@@ -1,0 +1,191 @@
+"""Request/step span tracing: *why* was it slow, not just *that* it was.
+
+Counters say a request took 900 ms; spans say 700 ms of it was queue
+wait.  Each completed span is one JSONL line (append-only, per host —
+the same shippable-file contract as the metrics JSONL), carrying:
+
+    {"kind": "span", "name": "prefill", "trace_id": 7, "span_id": 3,
+     "parent_id": null, "start": <monotonic>, "dur_s": 0.012,
+     "ts": <wall clock>, "host": 0, "role": "server", "attrs": {...}}
+
+* ``trace_id`` groups one logical unit — a serve request (its req_id)
+  or a training step (the step number).
+* ``start`` is ``time.monotonic()`` so spans from one process compare
+  and sum exactly (the TTFT-decomposition acceptance check); ``ts`` is
+  wall clock so hosts can be merged approximately on one timeline.
+* Parent links propagate through a contextvar, so a span opened inside
+  another nests without any plumbing (within one thread — a new
+  ``threading.Thread`` starts with a fresh context, so hand it
+  ``contextvars.copy_context()`` if cross-thread nesting matters);
+  ``record()`` is the escape hatch for spans whose start was observed
+  before the tracer call (queue wait: the submit happened on a caller
+  thread, the admission happens on the serve loop).
+
+``Tracer(None)`` is a full no-op writer (spans still time, nothing is
+written) so instrumentation points can call unconditionally.
+
+Wired into the serve request lifecycle in ``serve/frontend.py``
+(queue_wait → prefill → decode_round → request_done) and into the
+trainer loop via ``train.trainer.TrainerObs`` (data_wait / step / ckpt).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import json
+import threading
+import time
+from pathlib import Path
+from typing import Any
+
+_current_span: contextvars.ContextVar[int | None] = contextvars.ContextVar(
+    "tpucfn_current_span", default=None)
+
+
+class Tracer:
+    """JSONL span writer for one process (one file per host+role)."""
+
+    def __init__(self, path: str | Path | None, *, host_id: int | None = None,
+                 role: str = "", truncate: bool = False):
+        """``truncate`` decides run scoping and must match how the
+        role's trace_ids behave across process restarts: a serving
+        process numbers requests from 0 every run, so appending would
+        fuse run 1's request 0 with run 2's into a row belonging to
+        neither — serve passes ``truncate=True``.  A trainer's trace_id
+        is the global step, monotonic across resume-from-checkpoint, so
+        the restart supervisor's relaunch must NOT erase the pre-crash
+        spans — append is the default."""
+        self.path: Path | None = None
+        self._f = None
+        self.host_id = host_id
+        self.role = role
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+        if path is not None:
+            p = Path(path)
+            if p.suffix != ".jsonl":  # a directory: derive the file name
+                p.mkdir(parents=True, exist_ok=True)
+                hid = 0 if host_id is None else host_id
+                p = p / f"trace-{role or 'proc'}-host{hid:03d}.jsonl"
+            else:
+                p.parent.mkdir(parents=True, exist_ok=True)
+            self.path = p
+            self._f = open(p, "w" if truncate else "a", buffering=1)
+
+    @property
+    def enabled(self) -> bool:
+        return self._f is not None
+
+    # -- low level ---------------------------------------------------------
+    def record(self, name: str, *, start: float, end: float | None = None,
+               dur_s: float | None = None, trace_id: int | str | None = None,
+               kind: str = "span", parent_id: int | None = None,
+               **attrs: Any) -> None:
+        """Write one already-timed span (``start``/``end`` in
+        ``time.monotonic()`` seconds; pass ``dur_s`` instead of ``end``
+        when that's what was measured)."""
+        if self._f is None:
+            return
+        if dur_s is None:
+            dur_s = 0.0 if end is None else end - start
+        if parent_id is None:
+            parent_id = _current_span.get()
+        line = json.dumps({
+            "kind": kind,
+            "name": name,
+            "trace_id": trace_id,
+            "span_id": next(self._ids),
+            "parent_id": parent_id,
+            "start": start,
+            "dur_s": dur_s,
+            "ts": time.time() - (time.monotonic() - start),
+            "host": self.host_id,
+            "role": self.role,
+            "attrs": attrs,
+        })
+        with self._lock:
+            if self._f is not None:
+                self._f.write(line + "\n")
+
+    def event(self, name: str, *, trace_id: int | str | None = None,
+              **attrs: Any) -> None:
+        """Zero-duration marker (request_submitted, request_done...)."""
+        self.record(name, start=time.monotonic(), dur_s=0.0,
+                    trace_id=trace_id, kind="event", **attrs)
+
+    # -- context-managed spans --------------------------------------------
+    @contextlib.contextmanager
+    def span(self, name: str, *, trace_id: int | str | None = None,
+             **attrs: Any):
+        """Time the enclosed block; children opened inside it get this
+        span as their parent.  Yields a dict whose entries are merged
+        into the span's attrs at close (fill in results as you learn
+        them, e.g. ``s["tokens"] = n``)."""
+        span_id = next(self._ids)
+        parent = _current_span.get()
+        token = _current_span.set(span_id)
+        extra: dict[str, Any] = {}
+        t0 = time.monotonic()
+        try:
+            yield extra
+        except BaseException as e:
+            extra.setdefault("error", type(e).__name__)
+            raise
+        finally:
+            end = time.monotonic()
+            _current_span.reset(token)
+            if self._f is not None:
+                # span_id was pre-drawn so children could have pointed at
+                # us; write with it rather than drawing a fresh one.
+                self._write_span(name, span_id, parent, t0, end, trace_id,
+                                 {**attrs, **extra})
+
+    def _write_span(self, name, span_id, parent_id, start, end, trace_id,
+                    attrs) -> None:
+        line = json.dumps({
+            "kind": "span", "name": name, "trace_id": trace_id,
+            "span_id": span_id, "parent_id": parent_id,
+            "start": start, "dur_s": end - start,
+            "ts": time.time() - (time.monotonic() - start),
+            "host": self.host_id, "role": self.role, "attrs": attrs,
+        })
+        with self._lock:
+            if self._f is not None:
+                self._f.write(line + "\n")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+def read_trace_file(path: str | Path) -> list[dict]:
+    """All events of one trace JSONL (skips torn/partial last lines —
+    the file may still be appended to while we read)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def read_trace_dir(d: str | Path) -> list[dict]:
+    """Merge every ``trace-*.jsonl`` under ``d`` (the Tracer's dir-mode
+    naming — a co-located metrics JSONL is not a trace and is not
+    ingested), each file's events sorted by monotonic start so
+    retroactively-recorded spans (queue_wait) land in timeline order;
+    cross-host order is approximate by design."""
+    events: list[dict] = []
+    for p in sorted(Path(d).glob("trace-*.jsonl")):
+        events.extend(sorted(read_trace_file(p),
+                             key=lambda e: e.get("start", 0.0)))
+    return events

@@ -18,10 +18,17 @@ reason with a different status:
   capacity goes back to live traffic instead of computing answers
   nobody is waiting for.
 
-Metrics ride on ``tpucfn.obs.metrics`` primitives (Counter/Gauge/
-Summary): TTFT, generated tokens/sec, queue depth, KV-cache occupancy,
-preemptions, rejections — ``ServingMetrics.snapshot()`` is the one dict
-the CLI, the bench, and tests all read.
+Metrics ride on ``tpucfn.obs`` primitives registered in a
+``MetricRegistry`` (Counter/Gauge/Summary/Histogram): TTFT, generated
+tokens/sec, queue depth, KV-cache occupancy, preemptions, rejections —
+``ServingMetrics.snapshot()`` is the one dict the CLI, the bench, and
+tests all read, and the registry is the scrape surface the per-host
+``/metrics`` endpoint exposes (tpucfn/obs/server.py).  A ``Tracer``
+(tpucfn/obs/trace.py) records the request lifecycle as spans —
+request_submitted → queue_wait → prefill → decode_round* →
+request_done (plus preemption events) — so TTFT decomposes into
+queue-wait vs prefill vs scheduling per request, reconstructable from
+the trace JSONL alone (``tpucfn obs`` renders the breakdown table).
 """
 
 from __future__ import annotations
@@ -30,7 +37,9 @@ import threading
 import time
 from collections import deque
 
-from tpucfn.obs.metrics import Counter, Gauge, Summary
+from tpucfn.obs.metrics import Summary
+from tpucfn.obs.registry import MetricRegistry
+from tpucfn.obs.trace import Tracer
 from tpucfn.serve.engine import ServeEngine
 from tpucfn.serve.kvcache import KVCacheManager
 from tpucfn.serve.scheduler import (
@@ -86,20 +95,47 @@ class ServeRequest:
 
 
 class ServingMetrics:
-    """The serving dashboard in one object (obs.metrics primitives)."""
+    """The serving dashboard in one object, owned by a
+    :class:`~tpucfn.obs.registry.MetricRegistry` so ``GET /metrics``
+    exposes every serving series in Prometheus text format alongside
+    whatever else the process registered (training metrics, supervisor
+    counters).  Default is a private registry (test/bench isolation);
+    the CLI passes its role-labelled registry so the per-host obs
+    endpoint covers serving too.
 
-    def __init__(self):
-        self.ttft_s = Summary("ttft_s")
+    ``request_latency_s`` is kept as an (unregistered) Summary for the
+    exact-percentile ``snapshot()`` dict; the registered cross-host-
+    aggregatable form is the ``serve_request_latency_seconds``
+    Histogram — both observe every completion.
+    """
+
+    def __init__(self, registry: MetricRegistry | None = None):
+        r = self.registry = (registry if registry is not None
+                             else MetricRegistry())
+        self.ttft_s = r.summary(
+            "serve_ttft_seconds", "time to first generated token")
         self.request_latency_s = Summary("request_latency_s")
-        self.generated_tokens = Counter("generated_tokens")
-        self.prompt_tokens = Counter("prompt_tokens")
-        self.completed = Counter("completed_requests")
-        self.rejected = Counter("rejected_requests")
-        self.expired = Counter("expired_requests")
-        self.preemptions = Counter("preemptions")
-        self.queue_depth = Gauge("queue_depth")
-        self.running = Gauge("running_sequences")
-        self.cache_occupancy = Gauge("kv_cache_occupancy")
+        self.request_latency_hist = r.histogram(
+            "serve_request_latency_seconds",
+            "end-to-end request latency (submit to done)")
+        self.generated_tokens = r.counter(
+            "serve_generated_tokens_total", "tokens sampled (rate = tokens/sec)")
+        self.prompt_tokens = r.counter(
+            "serve_prompt_tokens_total", "prompt tokens accepted at submit")
+        self.completed = r.counter(
+            "serve_completed_requests_total", "requests finished successfully")
+        self.rejected = r.counter(
+            "serve_rejected_requests_total", "requests refused (429/400)")
+        self.expired = r.counter(
+            "serve_expired_requests_total", "requests past their deadline")
+        self.preemptions = r.counter(
+            "serve_preemptions_total", "KV-pressure evictions")
+        self.queue_depth = r.gauge(
+            "serve_queue_depth", "requests waiting (frontend + scheduler)")
+        self.running = r.gauge(
+            "serve_running_sequences", "sequences in decode slots")
+        self.cache_occupancy = r.gauge(
+            "serve_kv_cache_occupancy", "fraction of KV blocks in use")
         self._t0 = time.monotonic()
 
     def snapshot(self) -> dict:
@@ -132,13 +168,16 @@ class Server:
 
     def __init__(self, engine: ServeEngine, *, num_blocks: int = 256,
                  block_size: int = 16, max_queued_tokens: int = 1 << 16,
-                 eos_id: int | None = None):
+                 eos_id: int | None = None,
+                 registry: MetricRegistry | None = None,
+                 tracer: Tracer | None = None):
         self.engine = engine
         self.kv = KVCacheManager(num_blocks, block_size)
         self.scheduler = ContinuousBatchingScheduler(
             self.kv, max_batch=engine.max_batch,
             cache_len=engine.cache_len, eos_id=eos_id)
-        self.metrics = ServingMetrics()
+        self.metrics = ServingMetrics(registry)
+        self.tracer = tracer if tracer is not None else Tracer(None)
         self.max_queued_tokens = max_queued_tokens
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
@@ -184,10 +223,18 @@ class Server:
         self.metrics.prompt_tokens.add(len(prompt))
         self.metrics.queue_depth.set(len(self._incoming)
                                      + self.scheduler.num_waiting)
+        if self.tracer.enabled:
+            self.tracer.event("request_submitted", trace_id=req.req_id,
+                              prompt_tokens=len(prompt),
+                              max_new=max_new_tokens)
         return req
 
     # -- completion --------------------------------------------------------
-    def _complete(self, req: ServeRequest, *, tokens=None, error=None):
+    def _complete(self, req: ServeRequest, *, tokens=None, error=None,
+                  partial_generated: int = 0):
+        """``partial_generated``: tokens produced before a failure
+        (deadline expiry mid-decode) — the trace must not report an
+        expired request that generated 30 tokens as zero-output work."""
         req.t_done = time.monotonic()
         req.tokens, req.error = tokens, error
         with self._lock:
@@ -195,10 +242,22 @@ class Server:
         if error is None:
             self.metrics.completed.add()
             self.metrics.request_latency_s.observe(req.t_done - req.t_submit)
+            self.metrics.request_latency_hist.observe(req.t_done - req.t_submit)
         elif isinstance(error, DeadlineExceeded):
             self.metrics.expired.add()
         else:
             self.metrics.rejected.add()
+        if self.tracer.enabled:
+            outcome = ("ok" if error is None else
+                       "expired" if isinstance(error, DeadlineExceeded)
+                       else "rejected")
+            self.tracer.event(
+                "request_done", trace_id=req.req_id, outcome=outcome,
+                latency_s=req.t_done - req.t_submit,
+                ttft_s=(None if req.t_first_token is None
+                        else req.t_first_token - req.t_submit),
+                generated=len(tokens) if tokens is not None
+                else partial_generated)
         req.done.set()
 
     # -- the step function (one scheduler decision + one engine call) ------
@@ -230,7 +289,8 @@ class Server:
             req = self._by_seq.pop(seq.seq_id)
             self._complete(req, error=DeadlineExceeded(
                 f"deadline passed after {len(seq.generated)}"
-                f"/{seq.max_new_tokens} tokens"))
+                f"/{seq.max_new_tokens} tokens"),
+                partial_generated=len(seq.generated))
         work = self.scheduler.next_work()
         if work is None:
             self._refresh_gauges()
@@ -241,21 +301,46 @@ class Server:
             # recomputed prefix already contains everything previously
             # emitted, so the last position's logits predict the next
             # unseen token.
+            req = self._by_seq[work.seq.seq_id]
+            first = req.t_first_token is None
+            t_pf0 = time.monotonic()
             tok = self.engine.prefill(work.slot, work.seq.prefix, work.bucket,
                                       work.seq.temperature)
-            req = self._by_seq[work.seq.seq_id]
-            if req.t_first_token is None:  # preempted reruns keep the first
-                req.t_first_token = time.monotonic()
+            t_pf1 = time.monotonic()
+            if self.tracer.enabled:
+                if first:
+                    # The span whose start nobody observed from the serve
+                    # loop: submit happened on the caller's thread, so it
+                    # is recorded retroactively from t_submit.  queue_wait
+                    # + prefill sums to the measured TTFT by construction.
+                    self.tracer.record("queue_wait", start=req.t_submit,
+                                       end=t_pf0, trace_id=req.req_id)
+                self.tracer.record("prefill", start=t_pf0, end=t_pf1,
+                                   trace_id=req.req_id, slot=work.slot,
+                                   bucket=work.bucket,
+                                   prefix_len=len(work.seq.prefix),
+                                   resumed=not first)
+            if first:  # preempted reruns keep the first
+                req.t_first_token = t_pf1
                 self.metrics.ttft_s.observe(req.t_first_token - req.t_submit)
             self.metrics.generated_tokens.add()
             self._finish(self.scheduler.record_prefill(work.slot, tok))
         else:
+            t_dec0 = time.monotonic()
             out = self.engine.decode(
                 {slot: seq.last_token for slot, seq in work.slots.items()})
+            if self.tracer.enabled:
+                self.tracer.record(
+                    "decode_round", start=t_dec0, end=time.monotonic(),
+                    batch=len(work.slots),
+                    seqs=sorted(s.seq_id for s in work.slots.values()))
             for slot, tok in out.items():
                 self.metrics.generated_tokens.add()
                 self._finish(self.scheduler.record_decode(slot, tok))
-        self.metrics.preemptions.add(self.kv.evictions - preempt0)
+        evicted = self.kv.evictions - preempt0
+        if evicted and self.tracer.enabled:
+            self.tracer.event("preemption", count=evicted)
+        self.metrics.preemptions.add(evicted)
         self._refresh_gauges()
         return True
 

@@ -12,7 +12,9 @@ wire protocol: the compiler owns the data path (SURVEY.md §5 last row).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import time
 from typing import Any, Callable
 
 import jax
@@ -251,3 +253,89 @@ class Trainer:
     def param_spec(self) -> Any:
         return make_partition_spec(self._state_rules(), self._abstract(),
                                    self._opt_rank_mismatch)
+
+
+class TrainerObs:
+    """Observability for the canonical train loop phases.
+
+    The loop a host actually lives in is ``data_wait → step → ckpt``
+    repeated; this binds each phase to both planes at once — registry
+    metrics (scrapeable via the per-host ``/metrics`` endpoint) and
+    trace spans (one JSONL line per phase occurrence, host id attached,
+    ``trace_id`` = the global step so ``tpucfn obs`` can line hosts up
+    per step and name the straggler).  Phase timings are host-observed
+    wall times: ``step`` includes the device dispatch AND the block on
+    the result, which is the honest per-step number on an async runtime
+    (same rule as StepTimer).
+
+    Usage (what examples/common.py's run_train_loop does)::
+
+        obs = TrainerObs(registry, tracer)
+        with obs.data_wait():   batch = next(it)
+        with obs.step(step_no): state, m = trainer.step(state, batch); ...
+        with obs.ckpt(step_no): ckpt.save(step_no, state)
+    """
+
+    def __init__(self, registry=None, tracer=None, *, prefix: str = "train"):
+        from tpucfn.obs.registry import default_registry
+        from tpucfn.obs.trace import Tracer
+
+        r = self.registry = (registry if registry is not None
+                             else default_registry())
+        self.tracer = tracer if tracer is not None else Tracer(None)
+        self.step_time = r.histogram(
+            f"{prefix}_step_seconds", "host-observed step wall time")
+        self.data_wait_time = r.histogram(
+            f"{prefix}_data_wait_seconds",
+            "time the step loop blocked on the input pipeline")
+        self.ckpt_time = r.summary(
+            f"{prefix}_ckpt_seconds", "checkpoint save-call time")
+        self.steps_total = r.counter(
+            f"{prefix}_steps_total", "completed optimizer steps")
+        self.last_step = r.gauge(
+            f"{prefix}_last_step", "most recent global step")
+
+    @contextlib.contextmanager
+    def _phase(self, name: str, metric, step: int | None):
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            dt = time.monotonic() - t0
+            metric.observe(dt)
+            self.tracer.record(name, start=t0, dur_s=dt, trace_id=step)
+
+    def data_wait(self, step: int | None = None):
+        return self._phase("data_wait", self.data_wait_time, step)
+
+    def record_data_wait(self, step: int | None, start: float,
+                         dur_s: float) -> None:
+        """Post-hoc form of :meth:`data_wait` (``start`` in
+        ``time.monotonic()`` seconds) for loops that must first decide
+        whether the fetched batch starts a real step — the end-of-data
+        drain wait must not be recorded as a phantom step's data wait."""
+        self.data_wait_time.observe(dur_s)
+        self.tracer.record("data_wait", start=start, dur_s=dur_s,
+                           trace_id=step)
+
+    def step(self, step: int | None = None):
+        @contextlib.contextmanager
+        def _span():
+            with self._phase("step", self.step_time, step):
+                yield
+            self.steps_total.add()
+            if step is not None:
+                self.last_step.set(step)
+        return _span()
+
+    def ckpt(self, step: int | None = None):
+        return self._phase("ckpt", self.ckpt_time, step)
+
+    def record_ckpt(self, step: int | None, start: float,
+                    dur_s: float) -> None:
+        """Post-hoc form of :meth:`ckpt` for interval-gated save calls:
+        record only saves that actually happened, or the percentiles
+        measure no-op call overhead and read ~0 while real saves take
+        seconds."""
+        self.ckpt_time.observe(dur_s)
+        self.tracer.record("ckpt", start=start, dur_s=dur_s, trace_id=step)
