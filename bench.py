@@ -106,12 +106,18 @@ def _git_commit() -> str | None:
 
 def _probe_once(timeout_s: float) -> dict:
     """One killable TPU liveness probe (a hung PJRT client creation must
-    not hang the benchmark)."""
+    not hang the benchmark).  The probe must verify the backend is NOT
+    cpu: when the axon plugin fails to register (or the pool IP is
+    unreachable on an image where jax falls back silently), the matmul
+    happily runs on CPU and a naive probe would green-light an 1800s
+    "TPU" worker that is really a CPU run."""
     t0 = time.perf_counter()
     try:
         r = subprocess.run(
             [sys.executable, "-c",
              "import jax, jax.numpy as jnp;"
+             "assert jax.default_backend() != 'cpu', "
+             "    'cpu backend only — no TPU attached';"
              "print(float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum()))"],
             timeout=timeout_s, capture_output=True, text=True,
         )
@@ -398,6 +404,9 @@ def _measure_trainer(trainer, state, batch, *, steps, warmup):
     try:
         cost = (trainer._jit_step.lower(trainer.abstract_state(), batch)
                 .compile().cost_analysis())
+        # jax <= 0.4.x returns a per-device LIST of dicts; >= 0.5 a dict.
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else None
         if cost and cost.get("flops"):
             flops_per_dev_step = float(cost["flops"])
         if cost and cost.get("bytes accessed"):

@@ -1,24 +1,32 @@
 #!/usr/bin/env python
 """Continuous-batching serving benchmark (tpucfn.serve).
 
-Drives a synthetic mixed prefill/decode workload — Zipf-ish spread of
-prompt lengths, Poisson-ish arrival jitter is deliberately OMITTED
-(open-loop arrivals would measure the queue, not the engine; every
-request is submitted up front so the scheduler stays saturated) —
-through the full Server → scheduler → engine path and prints ONE JSON
-line in the standard BENCH row schema:
+Two workloads through the full Server → scheduler → engine path, ONE
+JSON line out in the standard BENCH row schema:
 
-    {"metric": "serve_tokens_per_sec", "value": N,
-     "unit": "generated tokens/sec", "vs_baseline": 0.0, "detail": {...}}
+* **Mixed** (the headline): Zipf-ish spread of prompt lengths,
+  Poisson-ish arrival jitter deliberately OMITTED (open-loop arrivals
+  would measure the queue, not the engine; every request is submitted
+  up front so the scheduler stays saturated).  Produces
+  ``serve_tokens_per_sec``.
+* **Shared-prefix** (ISSUE 3 acceptance): every request opens with the
+  same ``--shared-prefix-len`` system prompt.  Run once with the prefix
+  cache OFF (and prefill batching at 1) and once ON (batching at
+  ``--max-prefill-batch``), same engine, same prompts — the
+  ``detail.shared_prefix`` block reports prefix hit rate, prefill calls
+  per request, prefilled tokens per request, and TTFT for both, plus
+  ``prefilled_tokens_reduction`` (the >= 2x acceptance number) and the
+  ``ceil(requests / K)`` call ceiling batching is held to.
+
+Compile warmup is excluded from every timed window: each phase's
+buckets (and the copy_prefix program) are compiled by throwaway servers
+on the SAME engine first, mirroring bench.py's warmup-exclusion rule
+for training steps.
 
 ``vs_baseline`` is 0.0: the reference repo was a training-only harness
 with no serving number to compare against (detail.baseline_note says
-so).  ``detail`` carries TTFT p50/p95, per-request latency, decode-slot
-utilization, KV occupancy/preemptions, and the compile-count-relevant
-knobs (buckets, max_batch), so rows are comparable across runs.
-
-Meaningful throughput needs the real chip; on CPU this is a correctness
-and scheduling-overhead bench.
+so).  Meaningful throughput needs the real chip; on CPU this is a
+correctness and scheduling-overhead bench.
 
 Usage: python benches/serve_bench.py [--preset tiny --requests 32 ...]
 """
@@ -27,11 +35,45 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import sys
 import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _run_workload(engine, args, prompts, *, prefix_cache, max_prefill_batch,
+                  max_new):
+    """One timed pass over ``prompts`` through a fresh Server (fresh
+    metrics + KV pool; jit caches ride on the shared engine)."""
+    from tpucfn.serve import Server
+
+    server = Server(engine, num_blocks=args.num_blocks,
+                    block_size=args.block_size, prefix_cache=prefix_cache,
+                    max_prefill_batch=max_prefill_batch)
+    t0 = time.perf_counter()
+    reqs = [server.submit(q, max_new_tokens=max_new) for q in prompts]
+    server.run_until_idle()
+    wall = time.perf_counter() - t0
+    snap = server.metrics.snapshot()
+    n = len(prompts)
+    return {
+        "wall_s": round(wall, 3),
+        "failed": sum(1 for r in reqs if r.error is not None),
+        "kv_blocks_leaked": server.kv.allocator.num_used,
+        "kv_blocks_high_water": server.kv.allocator.high_water,
+        "prefill_calls": int(snap["prefill_calls"]),
+        "prefill_calls_per_request": round(snap["prefill_calls"] / n, 3),
+        "prefilled_tokens_per_request": round(snap["prefilled_tokens"] / n, 3),
+        "prefix_hit_rate": round(snap["prefix_hit_requests"] / n, 3),
+        "prefix_hit_tokens_per_request": round(
+            snap["prefix_hit_tokens"] / n, 3),
+        "ttft_p50_s": snap["ttft_s"]["p50"],
+        "ttft_p95_s": snap["ttft_s"]["p95"],
+        "tokens_per_sec": round(snap["generated_tokens"] / wall, 3),
+        "snapshot": snap,
+    }
 
 
 def main() -> int:
@@ -46,6 +88,10 @@ def main() -> int:
     p.add_argument("--cache-len", type=int, default=256)
     p.add_argument("--num-blocks", type=int, default=512)
     p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--shared-prefix-len", type=int, default=64,
+                   help="common system-prompt length of the shared-prefix "
+                        "workload")
+    p.add_argument("--max-prefill-batch", type=int, default=4)
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 
@@ -54,45 +100,64 @@ def main() -> int:
 
     from tpucfn.serve import Server
     from tpucfn.serve.engine import demo_llama_engine
+    from tpucfn.serve.scheduler import prefill_bucket
 
     print(f"# backend={jax.default_backend()} preset={args.preset} "
           f"requests={args.requests}", file=sys.stderr)
     cfg, engine = demo_llama_engine(args.preset, seed=args.seed,
                                     max_batch=args.max_batch,
-                                    cache_len=args.cache_len)
-    server = Server(engine, num_blocks=args.num_blocks,
-                    block_size=args.block_size)
+                                    cache_len=args.cache_len,
+                                    prefill_width=args.max_prefill_batch)
 
     rs = np.random.RandomState(args.seed)
-    prompts = [rs.randint(0, cfg.vocab_size,
-                          rs.randint(args.prompt_len_lo,
-                                     args.prompt_len_hi + 1)).tolist()
-               for _ in range(args.requests)]
+    mixed = [rs.randint(0, cfg.vocab_size,
+                        rs.randint(args.prompt_len_lo,
+                                   args.prompt_len_hi + 1)).tolist()
+             for _ in range(args.requests)]
+    # Shared-prefix workload: one system prompt, per-request tails sized
+    # to land in ONE suffix bucket (tail in (block_size, 2*block_size])
+    # so batched-prefill call counts are deterministic.
+    sys_prompt = rs.randint(0, cfg.vocab_size,
+                            args.shared_prefix_len).tolist()
+    shared = [sys_prompt + rs.randint(
+        0, cfg.vocab_size,
+        rs.randint(args.block_size + 1, 2 * args.block_size + 1)).tolist()
+        for _ in range(args.requests)]
 
-    # Warm the compile caches outside the timed window (one decode
-    # program + every prefill bucket this workload will hit), mirroring
-    # bench.py's warmup-exclusion rule for training steps.  Same server
-    # (jit caches are per engine instance); metrics are reset after.
-    from tpucfn.serve import ServingMetrics
-    from tpucfn.serve.scheduler import prefill_bucket
-
+    # -- compile warmup (excluded from every timed window) -----------------
+    # prefix_cache OFF here: the warm prompts all share a [1]*n prefix,
+    # and a hit would prefill a short suffix in a SMALLER bucket —
+    # leaving the large buckets uncompiled for the timed phases.
+    warm = Server(engine, num_blocks=args.num_blocks,
+                  block_size=args.block_size, prefix_cache=False,
+                  max_prefill_batch=args.max_prefill_batch)
     for b in sorted({prefill_bucket(len(q), args.cache_len)
-                     for q in prompts}):
-        server.submit([1] * min(b, args.cache_len - 2), max_new_tokens=2)
-    server.run_until_idle()
-    server.metrics = ServingMetrics()
+                     for q in mixed}):
+        warm.submit([1] * min(b, args.cache_len - 2), max_new_tokens=2)
+    warm.run_until_idle()
+    # the shared-prefix phase's programs: full bucket, suffix bucket,
+    # copy_prefix (two identical-prefix requests back to back).
+    _run_workload(engine, args, shared[: 2 * args.max_prefill_batch],
+                  prefix_cache=True,
+                  max_prefill_batch=args.max_prefill_batch, max_new=2)
 
-    t0 = time.perf_counter()
-    reqs = [server.submit(q, max_new_tokens=args.max_new) for q in prompts]
-    server.run_until_idle()
-    wall = time.perf_counter() - t0
+    # -- timed: mixed headline ---------------------------------------------
+    head = _run_workload(engine, args, mixed, prefix_cache=True,
+                         max_prefill_batch=args.max_prefill_batch,
+                         max_new=args.max_new)
+    # -- timed: shared-prefix, cache off vs on, same run -------------------
+    off = _run_workload(engine, args, shared, prefix_cache=False,
+                        max_prefill_batch=1, max_new=args.max_new)
+    on = _run_workload(engine, args, shared, prefix_cache=True,
+                       max_prefill_batch=args.max_prefill_batch,
+                       max_new=args.max_new)
+    reduction = (off["prefilled_tokens_per_request"]
+                 / max(on["prefilled_tokens_per_request"], 1e-9))
 
-    failed = [r for r in reqs if r.error is not None]
-    snap = server.metrics.snapshot()
-    generated = snap["generated_tokens"]
+    strip = lambda d: {k: v for k, v in d.items() if k != "snapshot"}  # noqa: E731
     row = {
         "metric": "serve_tokens_per_sec",
-        "value": round(generated / wall, 3),
+        "value": head["tokens_per_sec"],
         "unit": "generated tokens/sec",
         "vs_baseline": 0.0,
         "detail": {
@@ -101,27 +166,44 @@ def main() -> int:
             "backend": jax.default_backend(),
             "preset": args.preset,
             "requests": args.requests,
-            "failed": len(failed),
-            "wall_s": round(wall, 3),
+            "failed": head["failed"],
+            "wall_s": head["wall_s"],
             "max_batch": args.max_batch,
             "cache_len": args.cache_len,
             "block_size": args.block_size,
             "num_blocks": args.num_blocks,
             "max_new": args.max_new,
-            "ttft_s": snap["ttft_s"],
-            "request_latency_s": snap["request_latency_s"],
-            "preemptions": snap["preemptions"],
-            "kv_blocks_high_water": server.kv.allocator.high_water,
-            "kv_blocks_leaked": server.kv.allocator.num_used,
+            "max_prefill_batch": args.max_prefill_batch,
+            "ttft_s": head["snapshot"]["ttft_s"],
+            "request_latency_s": head["snapshot"]["request_latency_s"],
+            "preemptions": head["snapshot"]["preemptions"],
+            "kv_blocks_high_water": head["kv_blocks_high_water"],
+            "kv_blocks_leaked": head["kv_blocks_leaked"],
             # The full ServingMetrics snapshot rides on every row so a
             # perf regression carries its own latency decomposition
             # (queue depth, occupancy, token counts) instead of just the
             # headline number (ISSUE 2 satellite).
-            "serving_metrics": snap,
+            "serving_metrics": head["snapshot"],
+            # ISSUE 3 acceptance: prefix caching's prefilled-token
+            # reduction and batched prefill's call ceiling, cache off vs
+            # on over identical prompts in the same run.
+            "shared_prefix": {
+                "prefix_len": args.shared_prefix_len,
+                "requests": args.requests,
+                "max_prefill_batch": args.max_prefill_batch,
+                "prefill_calls_ceiling": math.ceil(
+                    args.requests / args.max_prefill_batch),
+                "off": strip(off),
+                "on": strip(on),
+                "prefilled_tokens_reduction": round(reduction, 3),
+            },
         },
     }
     print(json.dumps(row))
-    return 0 if not failed and server.kv.allocator.num_used == 0 else 1
+    leaked = (head["kv_blocks_leaked"] or off["kv_blocks_leaked"]
+              or on["kv_blocks_leaked"])
+    failed = head["failed"] or off["failed"] or on["failed"]
+    return 0 if not failed and not leaked else 1
 
 
 if __name__ == "__main__":

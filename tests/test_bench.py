@@ -231,3 +231,46 @@ time.sleep(30)  # stay alive so pgrep keeps matching while bench polls
     assert d["backend_mode"] == "tpu-recorded"
     assert d["recorded"]["stale"] is True
     assert any("demoted" in n for n in d["fallback_notes"])
+
+
+def test_serve_bench_row_carries_prefix_and_batch_stats():
+    """ISSUE 3 CI satellite: the serve_bench BENCH row must carry the
+    shared-prefix block (hit rate, prefill calls per request, TTFT, the
+    cache-off/on comparison) with sane values — a row missing them fails
+    here instead of producing unreadable trajectory files.  Small run on
+    CPU; the count-based numbers are deterministic."""
+    import math
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, str(REPO / "benches" / "serve_bench.py"),
+         "--requests", "24", "--max-new", "6", "--max-batch", "8",
+         "--cache-len", "256", "--shared-prefix-len", "64",
+         "--max-prefill-batch", "4"],
+        env=env, capture_output=True, text=True, timeout=1200)
+    assert r.returncode == 0, f"stderr:\n{r.stderr[-2000:]}"
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    for key in ("metric", "value", "unit", "vs_baseline", "detail"):
+        assert key in rec, rec
+    assert rec["metric"] == "serve_tokens_per_sec"
+    sp = rec["detail"]["shared_prefix"]
+    for key in ("prefix_len", "requests", "max_prefill_batch",
+                "prefill_calls_ceiling", "off", "on",
+                "prefilled_tokens_reduction"):
+        assert key in sp, sp
+    for side in ("off", "on"):
+        for key in ("prefill_calls", "prefill_calls_per_request",
+                    "prefilled_tokens_per_request", "prefix_hit_rate",
+                    "prefix_hit_tokens_per_request", "ttft_p50_s",
+                    "ttft_p95_s", "kv_blocks_leaked"):
+            assert key in sp[side], (side, key)
+        assert sp[side]["kv_blocks_leaked"] == 0
+    # The acceptance numbers themselves (token counts are deterministic).
+    assert sp["off"]["prefix_hit_rate"] == 0.0
+    assert sp["on"]["prefix_hit_rate"] > 0.5
+    assert sp["prefilled_tokens_reduction"] >= 2.0
+    assert sp["on"]["prefill_calls"] <= math.ceil(
+        sp["requests"] / sp["max_prefill_batch"])
+    assert sp["off"]["prefill_calls"] == sp["requests"]

@@ -233,3 +233,130 @@ def test_cli_serve_smoke(tmp_path, capsys):
     snap = json.loads(out[-1])
     assert snap["completed"] == 3
     assert snap["generated_tokens"] == 12
+
+
+# ---- ISSUE 3: prefix caching + batched prefill through the real engine --
+
+def test_engine_copy_prefix_then_suffix_prefill_parity(tiny, eng8):
+    """copy_prefix + a start-offset suffix prefill must equal one full
+    prefill: the copied KV plus recomputed suffix is the same cache a
+    scratch prefill builds."""
+    cfg, params = tiny
+    rs = np.random.RandomState(7)
+    prompt = rs.randint(0, cfg.vocab_size, 12).tolist()
+    # Backer: slot 5 prefills the full prompt.
+    a = [eng8.prefill(slot=5, prefix=prompt, bucket=16)]
+    # Hit: slot 6 copies the first 8 tokens, prefills only the last 4.
+    eng8.copy_prefix(5, 6, 8)
+    b = [eng8.prefill(slot=6, prefix=prompt[8:], bucket=16, start=8)]
+    for _ in range(4):
+        out = eng8.decode({5: a[-1], 6: b[-1]})
+        a.append(out[5])
+        b.append(out[6])
+    ref = _ref_tokens(cfg, params, [prompt], 5)[0]
+    assert a == ref
+    assert b == ref
+
+
+def test_engine_prefill_batch_matches_singles(tiny, eng8):
+    """One vmapped width-K call == K single calls: per-lane buckets,
+    starts, and sampling positions are lane-local."""
+    cfg, params = tiny
+    rs = np.random.RandomState(11)
+    prompts = [rs.randint(0, cfg.vocab_size, n).tolist()
+               for n in (5, 9, 13)]
+    toks = eng8.prefill_batch(
+        [(0, prompts[0], 0, 0.0), (1, prompts[1], 0, 0.0),
+         (2, prompts[2], 0, 0.0)], bucket=16)
+    outs = {s: [toks[s]] for s in (0, 1, 2)}
+    for _ in range(3):
+        nxt = eng8.decode({s: outs[s][-1] for s in outs})
+        for s in outs:
+            outs[s].append(nxt[s])
+    for slot, p in zip((0, 1, 2), prompts):
+        assert outs[slot] == _ref_tokens(cfg, params, [p], 4)[0]
+
+
+def test_server_prefix_cache_parity_and_hits(tiny, eng8):
+    """The satellite pin: greedy outputs with the prefix cache ON are
+    token-identical to models/generate.py, and the shared system prompt
+    actually hits (fewer prefilled tokens than total prompt tokens)."""
+    cfg, params = tiny
+    rs = np.random.RandomState(13)
+    system = rs.randint(0, cfg.vocab_size, 16).tolist()
+    prompts = [system + rs.randint(0, cfg.vocab_size, 3 + i % 4).tolist()
+               for i in range(12)]
+    server = Server(eng8, num_blocks=48, block_size=8)
+    reqs = [server.submit(p, max_new_tokens=4) for p in prompts]
+    server.run_until_idle()
+    by_len = {}
+    for p in prompts:
+        by_len.setdefault(len(p), []).append(p)
+    refs = {}
+    for same in by_len.values():
+        refs.update(zip(map(tuple, same), _ref_tokens(cfg, params, same, 4)))
+    for p, r in zip(prompts, reqs):
+        assert r.result(timeout=0) == refs[tuple(p)]
+    snap = server.metrics.snapshot()
+    assert snap["prefix_hit_requests"] > 0
+    assert snap["prefix_hit_tokens"] > 0
+    assert snap["prefilled_tokens"] < snap["prompt_tokens"]
+    assert snap["prefill_calls"] < len(prompts)  # batching collapsed calls
+    assert snap["prefill_batch_size"]["count"] == snap["prefill_calls"]
+    assert server.kv.allocator.num_used == 0
+
+
+def test_server_acceptance_mix_zero_leaks(tiny, eng8):
+    """ISSUE 3 acceptance: an end-to-end run mixing shared-prefix hits,
+    misses, preemptions (tight pool), and deadline expiries ends with
+    num_used == 0."""
+    cfg, params = tiny
+    rs = np.random.RandomState(17)
+    system = rs.randint(0, cfg.vocab_size, 8).tolist()
+    # Tight pool: 12 blocks x 4 = 48 token slots for up to 8 concurrent
+    # sequences -> decode reservations must preempt.
+    server = Server(eng8, num_blocks=12, block_size=4)
+    reqs = []
+    for i in range(10):
+        shared = i % 2 == 0
+        p = (system if shared else
+             rs.randint(0, cfg.vocab_size, 8).tolist()) \
+            + rs.randint(0, cfg.vocab_size, 1 + i % 3).tolist()
+        reqs.append(server.submit(
+            p, max_new_tokens=4,
+            deadline_s=(-1.0 if i in (3, 7) else None)))
+    server.run_until_idle()
+    snap = server.metrics.snapshot()
+    assert snap["expired"] == 2
+    assert snap["completed"] == 8
+    assert snap["preemptions"] > 0
+    assert snap["prefix_hit_requests"] > 0
+    for r in reqs:
+        if r.error is None:
+            p = r.prompt
+            assert r.result(timeout=0) == _ref_tokens(cfg, params, [p], 4)[0]
+    assert server.kv.allocator.num_used == 0
+    assert server.kv.allocator.num_free == 12
+
+
+def test_engine_compile_counts_stay_bucketed(tiny):
+    """The compile-budget contract: a workload spanning two prefill
+    buckets with prefix hits and batched prefills compiles exactly
+    len(buckets) prefill programs + 1 decode + 1 copy_prefix."""
+    cfg, params = tiny
+    eng = ServeEngine.from_llama(cfg, params, max_batch=4, cache_len=64,
+                                 prefill_width=3)
+    rs = np.random.RandomState(19)
+    system = rs.randint(0, cfg.vocab_size, 8).tolist()
+    server = Server(eng, num_blocks=32, block_size=4)
+    prompts = [system + rs.randint(0, cfg.vocab_size, 2 + i % 3).tolist()
+               for i in range(8)]
+    prompts.append(rs.randint(0, cfg.vocab_size, 20).tolist())  # bucket 32
+    reqs = [server.submit(p, max_new_tokens=3) for p in prompts]
+    server.run_until_idle()
+    assert all(r.error is None for r in reqs)
+    snap = server.metrics.snapshot()
+    assert snap["prefix_hit_requests"] > 0   # copy_prefix really ran
+    counts = eng.compile_counts()
+    assert counts == {"prefill": 2, "decode": 1, "copy_prefix": 1}, counts
+    assert server.kv.allocator.num_used == 0

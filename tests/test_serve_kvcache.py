@@ -1,6 +1,8 @@
 """Paged KV-cache allocator/manager invariants (tpucfn.serve.kvcache):
 atomic allocation, validated frees, leak-free lifecycle, fragmentation
-and eviction accounting."""
+and eviction accounting, plus the ISSUE-3 prefix cache: ref-counted
+sharing, COW on the divergent write, eviction refusal on shared blocks,
+and index survival across holder turnover."""
 
 import pytest
 
@@ -109,3 +111,173 @@ def test_manager_interleaved_sequences_restore_free_count():
     assert m.allocator.num_free == 32
     assert m.allocator.num_used == 0
     assert m.internal_fragmentation() == 0
+
+
+# ---- ref-counted prefix cache (ISSUE 3) ---------------------------------
+
+def _pm(num_blocks=16, block_size=4):
+    return KVCacheManager(num_blocks, block_size, prefix_cache=True)
+
+
+def test_refcount_share_then_release_cycles_to_zero():
+    """Shared-prefix admit/release cycles end at zero used blocks: N
+    sequences share one prompt's blocks via incref and the pool is whole
+    after the LAST holder releases."""
+    m = _pm()
+    prompt = list(range(8))          # 2 full blocks
+    a = m.admit("a", tokens=prompt + [99, 98])   # 3 blocks, registers index
+    assert a.cached_len == 0 and a.suffix == prompt + [99, 98]
+    match = m.match_prefix(prompt + [50])
+    assert match.cached_len == 8 and match.holders == {"a"}
+    b = m.admit("b", tokens=prompt + [50], match=match)
+    assert b.cached_len == 8 and b.suffix == [50]
+    # 3 (a) + 1 fresh (b's tail): the two prefix blocks are shared.
+    assert m.allocator.num_used == 4
+    assert m.table("b").blocks[:2] == m.table("a").blocks[:2]
+    assert m.allocator.ref(m.table("a").blocks[0]) == 2
+    assert m.prefix_hits == 1 and m.prefix_hit_tokens == 8
+    m.release("a")
+    assert m.allocator.num_used == 3  # shared blocks survive a's release
+    m.release("b")
+    assert m.allocator.num_used == 0
+    assert m.prefix_cache_stats()["indexed_blocks"] == 0
+
+
+def test_cow_triggers_on_divergent_write_of_aligned_match():
+    """A prompt whose full-block match covers the WHOLE prompt must
+    still prefill >= 1 token — that write diverges into the last matched
+    block, so the match drops it (a private copy) and counts a COW."""
+    m = _pm()
+    prompt = list(range(8))          # exactly 2 full blocks
+    m.admit("a", tokens=prompt)
+    match = m.match_prefix(prompt)   # both blocks indexed...
+    assert match.cow is True         # ...but the write-target is dropped
+    assert match.cached_len == 4 and match.num_blocks == 1
+    b = m.admit("b", tokens=prompt, match=match)
+    assert b.cached_len == 4
+    assert m.cow_copies == 1
+    # b's second block is PRIVATE, not a's.
+    assert m.table("b").blocks[0] == m.table("a").blocks[0]
+    assert m.table("b").blocks[1] != m.table("a").blocks[1]
+    m.release("a")
+    m.release("b")
+    assert m.allocator.num_used == 0
+
+
+def test_eviction_of_shared_block_refused_until_refcount_one():
+    """Evicting one holder of a shared block must NOT free it: the block
+    returns to the free list only when the last reference drops."""
+    m = _pm(num_blocks=8)
+    prompt = list(range(4))          # 1 full block
+    m.admit("a", tokens=prompt + [7])
+    match = m.match_prefix(prompt + [8])
+    b_blocks = m.admit("b", tokens=prompt + [8], match=match).table.blocks
+    shared = b_blocks[0]
+    assert m.allocator.ref(shared) == 2
+    m.release("b", evicted=True)     # eviction refused for the shared block
+    assert m.allocator.ref(shared) == 1
+    assert m.blocks_evicted == 1     # only b's private tail block freed
+    assert m.evictions == 1
+    m.release("a", evicted=True)     # last holder: now it frees
+    assert m.allocator.ref(shared) == 0
+    assert m.blocks_evicted == 3
+    assert m.allocator.num_used == 0
+
+
+def test_index_repoints_to_surviving_holder():
+    """When the index-registered holder releases, entries re-point to a
+    live sharer's block instead of dangling at a freed id."""
+    m = _pm()
+    prompt = list(range(8))
+    m.admit("a", tokens=prompt + [1])
+    ma = m.match_prefix(prompt + [2])
+    m.admit("b", tokens=prompt + [2], match=ma)
+    m.release("a")
+    mc = m.match_prefix(prompt + [3])
+    assert mc.cached_len == 8 and mc.holders == {"b"}
+    assert all(blk in m.table("b").blocks for blk in mc.blocks)
+    c = m.admit("c", tokens=prompt + [3], match=mc)
+    assert c.cached_len == 8
+    m.release("b")
+    m.release("c")
+    assert m.allocator.num_used == 0
+
+
+def test_generated_tokens_extend_the_chain():
+    """commit_token(token=...) registers full GENERATED blocks, so a
+    later prompt can hit on prompt + generated history."""
+    m = _pm(block_size=2)
+    m.admit("a", tokens=[5, 6])      # 1 full block
+    for tok in (7, 8):
+        m.reserve_next("a")
+        m.commit_token("a", token=tok)
+    # a's cache now holds [5, 6, 7, 8] = 2 full blocks.
+    match = m.match_prefix([5, 6, 7, 8, 9])
+    assert match.cached_len == 4 and match.holders == {"a"}
+    m.release("a")
+    assert m.allocator.num_used == 0
+    assert m.match_prefix([5, 6, 7, 8, 9]).cached_len == 0
+
+
+def test_disabled_prefix_cache_never_matches():
+    m = KVCacheManager(8, 4, prefix_cache=False)
+    m.admit("a", tokens=list(range(8)))
+    assert m.match_prefix(list(range(8))).cached_len == 0
+    m.release("a")
+    assert m.allocator.num_used == 0
+
+
+def test_admit_with_match_is_atomic_when_pool_dry():
+    """A failed shared admit must not half-apply: no increfs survive an
+    OutOfBlocksError on the fresh-suffix allocation."""
+    m = _pm(num_blocks=3, block_size=4)
+    m.admit("a", tokens=list(range(8)))          # 2 of 3 blocks
+    match = m.match_prefix(list(range(8)) + [1] * 8)  # needs 2 fresh
+    assert match.cached_len == 8
+    ref0 = m.allocator.ref(m.table("a").blocks[0])
+    with pytest.raises(OutOfBlocksError):
+        m.admit("b", tokens=list(range(8)) + [1] * 8, match=match)
+    assert m.allocator.ref(m.table("a").blocks[0]) == ref0
+    m.release("a")
+    assert m.allocator.num_used == 0
+
+
+def test_shared_mixed_lifecycle_zero_leaks():
+    """Hits, misses, growth, evictions interleaved: the pool must return
+    exactly to empty and the index must drain with its holders."""
+    m = _pm(num_blocks=32, block_size=4)
+    base = list(range(12))           # 3 full blocks
+    live = []
+    for i in range(9):
+        toks = base + [100 + i, 200 + i ** 2]
+        match = m.match_prefix(toks)
+        m.admit(i, tokens=toks, match=match if match.cached_len else None)
+        live.append(i)
+        for j in list(live):
+            m.reserve_next(j)
+            m.commit_token(j, token=300 + j)
+        if i % 3 == 2:
+            m.release(live.pop(0), evicted=(i % 2 == 0))
+    for j in live:
+        m.release(j)
+    assert m.allocator.num_used == 0
+    assert m.allocator.num_free == 32
+    assert m.prefix_cache_stats()["indexed_blocks"] == 0
+    assert m.prefix_hits > 0
+
+
+def test_hash_collision_degrades_to_miss(monkeypatch):
+    """_block_hash is the fast builtin, so lookups re-verify token
+    content: a colliding hash must read as a MISS, never share a
+    stranger's KV.  Forced by stubbing the hash to a constant."""
+    import tpucfn.serve.kvcache as kvmod
+
+    monkeypatch.setattr(kvmod, "_block_hash", lambda prev, toks: 42)
+    m = kvmod.KVCacheManager(16, 4, prefix_cache=True)
+    m.admit("a", tokens=list(range(8)))
+    # Different content under the same hash: no match.
+    assert m.match_prefix([9, 9, 9, 9, 9]).cached_len == 0
+    # Identical content still matches through the content check.
+    assert m.match_prefix(list(range(8)) + [1]).cached_len == 4
+    m.release("a")
+    assert m.allocator.num_used == 0

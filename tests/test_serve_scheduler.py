@@ -36,8 +36,9 @@ def _drive(s, token=7):
         if work is None:
             break
         if isinstance(work, PrefillWork):
-            fin = s.record_prefill(work.slot, token)
-            done += [fin] if fin else []
+            for it in work.items:
+                fin = s.record_prefill(it.slot, token)
+                done += [fin] if fin else []
         else:
             for slot in list(work.slots):
                 fin = s.record_decode(slot, token)
@@ -167,3 +168,137 @@ def test_mixed_workload_zero_leaks():
     assert s.kv.allocator.num_free == 24
     assert s.kv.allocator.num_used == 0
     assert not s.has_work()
+
+
+# ---- batched prefill + prefix-hit planning (ISSUE 3) --------------------
+
+def _psched(num_blocks=32, block_size=4, max_batch=4, cache_len=64, **kw):
+    return ContinuousBatchingScheduler(
+        KVCacheManager(num_blocks, block_size, prefix_cache=True),
+        max_batch=max_batch, cache_len=cache_len, **kw)
+
+
+def test_batched_prefill_admits_same_bucket_only():
+    """One PrefillWork carries every same-bucket waiter up to K; a
+    different-bucket sequence stays queued (and runs next)."""
+    s = _psched(max_prefill_batch=3)
+    s.add(_seq(0, prompt_len=4))
+    # Disjoint tokens: no shared first block, so no prefix hit can
+    # shrink this one into the 16 bucket.
+    s.add(Sequence(seq_id=1, prompt=list(range(100, 120)),
+                   max_new_tokens=4, arrival=1.0))  # bucket 32, not 16
+    s.add(_seq(2, prompt_len=5))
+    s.add(_seq(3, prompt_len=6))
+    w = s.next_work()
+    assert isinstance(w, PrefillWork) and w.bucket == 16
+    assert [it.seq.seq_id for it in w.items] == [0, 2, 3]
+    assert len({it.slot for it in w.items}) == 3
+    for it in w.items:
+        s.record_prefill(it.slot, 5)
+    w2 = s.next_work()
+    assert isinstance(w2, PrefillWork) and w2.bucket == 32
+    assert w2.seq.seq_id == 1
+    s.record_prefill(w2.slot, 5)
+    done = _drive(s)
+    assert {q.seq_id for q in done} == {0, 1, 2, 3}
+    assert s.kv.allocator.num_used == 0
+
+
+def test_batched_prefill_respects_slot_and_block_limits():
+    # 2 slots, K=4: the batch stops at the slot budget.
+    s = _psched(max_batch=2, max_prefill_batch=4)
+    for i in range(4):
+        s.add(_seq(i))
+    w = s.next_work()
+    assert len(w.items) == 2
+    assert s.num_waiting == 2
+
+
+def test_prefix_hit_plans_copy_from_prefilled_backer():
+    """Sequence B sharing A's first full blocks prefills only its
+    suffix: cached_len set, src_slot = A's slot, bucket from the
+    suffix."""
+    s = _psched(max_prefill_batch=1)
+    base = list(range(1, 17))        # 4 full blocks of 4
+    s.add(Sequence(seq_id=0, prompt=base + [77], max_new_tokens=2,
+                   arrival=0.0))
+    w0 = s.next_work()
+    assert w0.items[0].cached_len == 0
+    s.record_prefill(w0.slot, 5)     # A is now a valid backer
+    s.add(Sequence(seq_id=1, prompt=base + [88, 89], max_new_tokens=2,
+                   arrival=1.0))
+    w1 = s.next_work()
+    it = w1.items[0]
+    assert it.cached_len == 16 and it.src_slot == w0.slot
+    assert w1.bucket == 16           # suffix of 2, not the full 32 bucket
+    s.record_prefill(it.slot, 5)
+    done = _drive(s)
+    assert {q.seq_id for q in done} == {0, 1}
+    assert s.kv.allocator.num_used == 0
+
+
+def test_no_hit_from_unprefilled_backer():
+    """An admitted-but-not-yet-prefilled holder has no device bytes to
+    copy: the second identical prompt in the SAME wave must plan a full
+    prefill."""
+    s = _psched(max_prefill_batch=1)
+    base = list(range(1, 9))
+    s.add(Sequence(seq_id=0, prompt=base + [1], max_new_tokens=2,
+                   arrival=0.0))
+    s.add(Sequence(seq_id=1, prompt=base + [2], max_new_tokens=2,
+                   arrival=1.0))
+    w0 = s.next_work()               # admits 0; NOT prefilled yet
+    w1_plan = s._plan(s.waiting[0])
+    assert w1_plan.cached_len == 0
+    s.record_prefill(w0.slot, 5)
+    assert s._plan(s.waiting[0]).cached_len == 8
+
+
+def test_retired_slot_backs_hits_until_reassigned():
+    """After every sharer finishes, the retired slot's residue still
+    backs a hit (zero-copy: the new sequence lands ON the slot)."""
+    s = _psched(max_prefill_batch=1)
+    base = list(range(1, 9))         # 2 full blocks
+    s.add(Sequence(seq_id=0, prompt=base + [7], max_new_tokens=2,
+                   arrival=0.0))
+    done = _drive(s)                 # seq 0 fully finished, slot free
+    assert done and s.num_running == 0
+    s.add(Sequence(seq_id=1, prompt=base + [8, 9], max_new_tokens=2,
+                   arrival=1.0))
+    w = s.next_work()
+    it = w.items[0]
+    assert it.cached_len == 8
+    assert it.src_slot == it.slot    # zero-copy reuse of the residue
+    s.record_prefill(it.slot, 5)
+    _drive(s)
+    assert s.kv.allocator.num_used == 0
+
+
+def test_expire_rebuilds_deep_queue_in_order():
+    """Deadline storm on a deep queue: every expired waiter drops, the
+    survivors keep FCFS order (the O(n) rebuild satellite)."""
+    s = _sched(max_batch=1)
+    for i in range(200):
+        s.add(_seq(i, deadline=(10.0 if i % 2 else 1000.0)))
+    dead = s.expire(now=50.0)
+    assert len(dead) == 100
+    assert all(q.state is SequenceState.EXPIRED for q in dead)
+    assert [q.seq_id for q in s.waiting] == [i for i in range(200)
+                                             if i % 2 == 0]
+
+
+def test_mixed_workload_with_prefix_cache_zero_leaks():
+    """Hits, misses, shared evictions, batched prefills interleaved
+    through a tight pool: the zero-leak invariant with sharing on."""
+    s = _psched(num_blocks=20, block_size=4, max_batch=4, cache_len=64,
+                max_prefill_batch=3)
+    base = list(range(1, 13))
+    for i in range(12):
+        tail = [100 + i, 200 + i, 300 + i][: 1 + i % 3]
+        s.add(Sequence(seq_id=i, prompt=base + tail,
+                       max_new_tokens=1 + (i * 3) % 5, arrival=float(i)))
+    done = _drive(s)
+    assert len(done) == 12
+    assert all(q.state is SequenceState.FINISHED for q in done)
+    assert s.kv.allocator.num_used == 0
+    assert s.kv.allocator.num_free == 20

@@ -242,7 +242,8 @@ def cmd_serve(args) -> int:
 
     cfg, engine = demo_llama_engine(args.preset, seed=args.seed,
                                     max_batch=args.max_batch,
-                                    cache_len=args.cache_len)
+                                    cache_len=args.cache_len,
+                                    prefill_width=args.max_prefill_batch)
 
     rs = np.random.RandomState(args.seed)
     if args.prompts:
@@ -290,7 +291,9 @@ def cmd_serve(args) -> int:
         server = Server(engine, num_blocks=args.num_blocks,
                         block_size=args.block_size,
                         max_queued_tokens=args.max_queued_tokens,
-                        registry=registry, tracer=tracer)
+                        registry=registry, tracer=tracer,
+                        prefix_cache=args.prefix_cache,
+                        max_prefill_batch=args.max_prefill_batch)
         reqs = []
         for p in prompts:
             try:
@@ -528,6 +531,14 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--max-queued-tokens", type=int, default=1 << 16,
                     help="backpressure cap: outstanding prompt+budget "
                          "tokens before 429")
+    sv.add_argument("--prefix-cache", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="block-level prompt-prefix caching: shared "
+                         "prefixes are copied device-side instead of "
+                         "re-prefilled (--no-prefix-cache disables)")
+    sv.add_argument("--max-prefill-batch", type=int, default=4,
+                    help="same-bucket prefills fused into one jitted call "
+                         "(the engine's fixed lane count; 1 disables)")
     sv.add_argument("--deadline-s", type=float, default=None)
     sv.add_argument("--seed", type=int, default=0)
     sv.add_argument("--obs-port", type=int, default=None, metavar="PORT",

@@ -25,14 +25,17 @@ from tpucfn.serve.frontend import (  # noqa: F401
     ServingMetrics,
 )
 from tpucfn.serve.kvcache import (  # noqa: F401
+    AdmitResult,
     BlockAllocator,
     BlockTable,
     KVCacheManager,
     OutOfBlocksError,
+    PrefixMatch,
 )
 from tpucfn.serve.scheduler import (  # noqa: F401
     ContinuousBatchingScheduler,
     DecodeWork,
+    PrefillItem,
     PrefillWork,
     Sequence,
     prefill_bucket,

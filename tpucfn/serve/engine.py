@@ -9,20 +9,35 @@ vmap for free, which is exactly what continuous batching needs (every
 slot sits at a different sequence position) and what the training-style
 shared-scalar cache cannot express.
 
-Two compiled entry points, both with the slot cache DONATED (the
+Three compiled entry points, each with the big slot cache DONATED (the
 multi-hundred-MB buffer is updated in place, never double-buffered):
 
-* ``prefill``: one sequence, padded to its length bucket, run through
-  the decode-mode model in a single pass; its per-layer ``cache_index``
-  is then rewound to the TRUE prefix length, so the pad garbage beyond
-  it is overwritten by the next decode step before causality could ever
-  expose it; the fresh cache row is scattered into the donated slot
-  cache and the first token is sampled from the last REAL position's
-  logits.  Compiles once per (bucket) — the scheduler's pow-2 buckets
-  keep that set small.
+* ``prefill_batch``: up to ``prefill_width`` sequences, each padded to
+  the SAME length bucket, run through the decode-mode model as one
+  vmapped pass.  Each lane carries its own cache START offset: a lane
+  with ``start > 0`` continues from a prefix that ``copy_prefix``
+  already planted in its slot (positions ``[0, start)``), so a prefix
+  cache hit prefills only the suffix.  After the pass each lane's
+  per-layer ``cache_index`` is set to its TRUE total length, so bucket
+  pad garbage beyond it is overwritten by the next decode step before
+  causality could ever expose it; the fresh rows are scattered into the
+  donated slot cache and each first token is sampled from the last REAL
+  position's logits.  Partial batches pad by repeating lane 0 (the
+  duplicate writes the same row twice — idempotent), so the program
+  compiles once per (bucket), never per batch size.
 * ``decode``: one token for EVERY slot (fixed shape, compiles once).
   Vacant slots compute garbage lanes that are never read — the standard
   static-shape trade.
+* ``copy_prefix``: whole-row KV copy from a backer slot plus a
+  ``cache_index`` set to the shared prefix length (compiles once; the
+  length is a traced scalar).  Bytes past the prefix are stale backer
+  state, dead by the same write-before-read causality argument as the
+  bucket padding.
+
+Sampling temperatures live in a DEVICE-resident ``(max_batch,)`` array
+updated inside the prefill program, so the steady-state decode loop
+transfers one token per active slot and nothing else (ISSUE 3
+satellite: no more per-step host->device temps upload).
 
 Greedy decode here is token-identical to ``models/generate.py`` (the
 parity test in ``tests/test_serve_engine.py`` pins it): same model code,
@@ -32,7 +47,6 @@ same cache math, same argmax.
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Any
 
 import jax
@@ -52,16 +66,17 @@ def _sample(logits: jax.Array, temps: jax.Array, key: jax.Array) -> jax.Array:
     return jnp.where(temps > 0.0, sampled, greedy)
 
 
-def _rewind_cache_index(cache, true_len):
-    """Post-prefill surgery: every ``cache_index`` leaf (shape (L,) under
-    nn.scan, () unrolled) is set to the TRUE prefix length, un-counting
-    the bucket padding.  Pad K/V beyond ``true_len`` stays in the buffer
-    but is dead: the next decode step overwrites position ``true_len``
-    before attending, and causality masks everything past the query."""
+def _set_cache_index(cache, length):
+    """Set every ``cache_index`` leaf (shape (L,) under nn.scan, ()
+    unrolled) to ``length``.  Used both to START a pass at a prefix
+    offset and to REWIND after a bucketed pass, un-counting the pad:
+    K/V beyond ``length`` stays in the buffer but is dead — the next
+    step overwrites position ``length`` before attending, and causality
+    masks everything past the query."""
 
     def fix(path, leaf):
         if _path_str(path).endswith("cache_index"):
-            return jnp.full(leaf.shape, true_len, leaf.dtype)
+            return jnp.full(leaf.shape, length, leaf.dtype)
         return leaf
 
     return jax.tree_util.tree_map_with_path(fix, cache)
@@ -69,16 +84,22 @@ def _rewind_cache_index(cache, true_len):
 
 class ServeEngine:
     """Wraps any decode-protocol flax model (init/apply with a ``cache``
-    collection, ``(B, S) int32 -> (B, S, V)`` logits) behind the two
-    jitted serving steps.  Use :meth:`from_llama` for the model zoo's
-    decoder (optionally LoRA-merged via ``train/lora.py``)."""
+    collection, ``(B, S) int32 -> (B, S, V)`` logits) behind the jitted
+    serving steps.  Use :meth:`from_llama` for the model zoo's decoder
+    (optionally LoRA-merged via ``train/lora.py``)."""
 
     def __init__(self, model: Any, params: Any, *, max_batch: int,
-                 cache_len: int, rng: jax.Array | None = None):
+                 cache_len: int, rng: jax.Array | None = None,
+                 prefill_width: int = 4):
         self.model = model
         self.params = params
         self.max_batch = max_batch
         self.cache_len = cache_len
+        # Fixed lane count of the batched prefill program.  Width-K
+        # prefill wastes (K - n)/K of the pass on partial batches (lanes
+        # duplicate lane 0), the same trade as vacant decode lanes —
+        # size it to the workload's admission burstiness.
+        self.prefill_width = max(1, int(prefill_width))
         self._base_key = jax.random.key(0) if rng is None else rng
         self._step_count = 0
 
@@ -90,16 +111,21 @@ class ServeEngine:
         # Slot-batched cache: every leaf gains a leading (max_batch,) axis.
         self.cache = jax.tree.map(
             lambda s: jnp.zeros((max_batch,) + s.shape, s.dtype), row_shapes)
-        # Host-side per-slot sampling temperature (set at prefill time).
-        self._temps = np.zeros((max_batch,), np.float32)
+        # Device-resident per-slot sampling temperature, written only by
+        # the prefill program (decode reads it in place).
+        self._temps = jnp.zeros((max_batch,), jnp.float32)
 
-        self._prefill_jit = jax.jit(self._prefill_impl, donate_argnums=(0,))
+        self._prefill_jit = jax.jit(self._prefill_many_impl,
+                                    donate_argnums=(0, 1))
         self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(0,))
+        self._copy_prefix_jit = jax.jit(self._copy_prefix_impl,
+                                        donate_argnums=(0,))
 
     @classmethod
     def from_llama(cls, cfg, params, *, max_batch: int = 8,
                    cache_len: int | None = None, lora_adapters=None,
-                   lora_scale: float = 1.0, rng: jax.Array | None = None):
+                   lora_scale: float = 1.0, rng: jax.Array | None = None,
+                   prefill_width: int = 4):
         """Engine over the flagship decoder.  ``cache_len`` sizes every
         slot's KV buffer (default ``cfg.max_seq``); ``lora_adapters``
         (from ``train.lora.lora_init``-shaped trees) are merged into the
@@ -118,7 +144,7 @@ class ServeEngine:
         model = Llama(dcfg, decode=True,
                       attention_fn=serve_decode_attention_fn(cache_len))
         return cls(model, params, max_batch=max_batch, cache_len=cache_len,
-                   rng=rng)
+                   rng=rng, prefill_width=prefill_width)
 
     # -- jitted bodies -----------------------------------------------------
     def _apply_one(self, params, cache_row, tokens_row):
@@ -128,18 +154,28 @@ class ServeEngine:
             mutable=["cache"])
         return logits, muts["cache"]
 
-    def _prefill_impl(self, cache, params, prompt, true_len, slot, temp, key):
-        """prompt (bucket,) int32, true_len/slot () int32, temp () f32."""
-        row0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
-                            self._row_shapes)
-        logits, row = self._apply_one(params, row0, prompt[None])
-        row = _rewind_cache_index(row, true_len)
-        last = jax.lax.dynamic_index_in_dim(
-            logits[0], true_len - 1, axis=0, keepdims=False)  # (V,)
-        tok = _sample(last[None], temp[None], key)[0]
-        new_cache = jax.tree.map(lambda full, r: full.at[slot].set(r),
-                                 cache, row)
-        return tok, new_cache
+    def _prefill_many_impl(self, cache, temps, params, prompts, true_lens,
+                           starts, slots, new_temps, key):
+        """prompts (K, bucket) int32; true_lens/starts/slots (K,) int32;
+        new_temps (K,) f32.  Lane k runs its tokens at cache positions
+        [starts[k], starts[k] + bucket) of slot slots[k]'s row and ends
+        with cache_index = true_lens[k]."""
+        rows = jax.tree.map(lambda leaf: leaf[slots], cache)
+
+        def one(row, prompt, true_len, start):
+            row = _set_cache_index(row, start)
+            logits, row = self._apply_one(params, row, prompt[None])
+            row = _set_cache_index(row, true_len)
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0], true_len - start - 1, axis=0, keepdims=False)
+            return row, last.astype(jnp.float32)
+
+        rows, lasts = jax.vmap(one)(rows, prompts, true_lens, starts)
+        toks = _sample(lasts, new_temps, key)
+        # Duplicate pad lanes scatter identical rows — order-independent.
+        new_cache = jax.tree.map(lambda full, r: full.at[slots].set(r),
+                                 cache, rows)
+        return toks, new_cache, temps.at[slots].set(new_temps)
 
     def _decode_impl(self, cache, params, tokens, temps, key):
         """tokens (B,) int32 -> (next (B,), cache).  Every slot steps."""
@@ -151,28 +187,96 @@ class ServeEngine:
         logits, new_cache = jax.vmap(one)(cache, tokens)
         return _sample(logits.astype(jnp.float32), temps, key), new_cache
 
+    def _copy_prefix_impl(self, cache, src, dst, n):
+        """Plant slot ``src``'s row into slot ``dst`` with cache_index
+        ``n``: the whole K/V row is copied (cheap contiguous gather/
+        scatter, no length-dependent shapes -> one compile), and every
+        byte past position ``n`` is dead on arrival — the suffix prefill
+        or the next decode step overwrites position ``n`` before any
+        query could attend past it."""
+
+        def fix(path, leaf):
+            if _path_str(path).endswith("cache_index"):
+                return leaf.at[dst].set(
+                    jnp.full(leaf.shape[1:], n, leaf.dtype))
+            return leaf.at[dst].set(leaf[src])
+
+        return jax.tree_util.tree_map_with_path(fix, cache)
+
     # -- host API (the scheduler loop calls these) -------------------------
     def _next_key(self) -> jax.Array:
         self._step_count += 1
         return jax.random.fold_in(self._base_key, self._step_count)
 
     def prefill(self, slot: int, prefix: list[int], bucket: int,
-                temperature: float = 0.0) -> int:
+                temperature: float = 0.0, start: int = 0) -> int:
         """Run one bucketed prefill into ``slot``; returns the sequence's
-        first sampled token."""
-        n = len(prefix)
-        if not 1 <= n <= bucket <= self.cache_len:
+        first sampled token.  ``start > 0`` continues from a prefix that
+        :meth:`copy_prefix` already planted (``prefix`` is then the
+        SUFFIX tokens only)."""
+        return self.prefill_batch([(slot, prefix, start, temperature)],
+                                  bucket)[slot]
+
+    def prefill_batch(self, items, bucket: int) -> dict[int, int]:
+        """One vmapped prefill over up to ``prefill_width`` sequences
+        sharing ``bucket``.  ``items`` is a list of ``(slot, tokens,
+        start, temperature)`` — ``tokens`` are the tokens to run (the
+        suffix when ``start > 0``).  Returns {slot: first token}."""
+        k = self.prefill_width
+        if not 1 <= len(items) <= k:
             raise ValueError(
-                f"prefix len {n} / bucket {bucket} / cache_len "
-                f"{self.cache_len} violate 1 <= len <= bucket <= cache_len")
-        padded = np.zeros((bucket,), np.int32)
-        padded[:n] = np.asarray(prefix, np.int32)
-        self._temps[slot] = temperature
-        tok, self.cache = self._prefill_jit(
-            self.cache, self.params, jnp.asarray(padded),
-            jnp.int32(n), jnp.int32(slot), jnp.float32(temperature),
-            self._next_key())
-        return int(tok)
+                f"{len(items)} prefill items vs prefill_width {k}")
+        slots = [it[0] for it in items]
+        if len(set(slots)) != len(slots):
+            raise ValueError(f"duplicate slots in prefill batch: {slots}")
+        padded = list(items) + [items[0]] * (k - len(items))
+        prompts = np.zeros((k, bucket), np.int32)
+        true_lens = np.zeros((k,), np.int32)
+        starts = np.zeros((k,), np.int32)
+        slot_arr = np.zeros((k,), np.int32)
+        temps = np.zeros((k,), np.float32)
+        for i, (slot, toks, start, temp) in enumerate(padded):
+            n = len(toks)
+            if not 1 <= n <= bucket:
+                raise ValueError(
+                    f"suffix len {n} / bucket {bucket} violate "
+                    "1 <= len <= bucket")
+            if start < 0 or start + bucket > self.cache_len:
+                raise ValueError(
+                    f"start {start} + bucket {bucket} exceeds cache_len "
+                    f"{self.cache_len}")
+            if not 0 <= slot < self.max_batch:
+                raise ValueError(f"slot {slot} out of range")
+            prompts[i, :n] = np.asarray(toks, np.int32)
+            true_lens[i] = start + n
+            starts[i] = start
+            slot_arr[i] = slot
+            temps[i] = temp
+        toks_out, self.cache, self._temps = self._prefill_jit(
+            self.cache, self._temps, self.params, jnp.asarray(prompts),
+            jnp.asarray(true_lens), jnp.asarray(starts),
+            jnp.asarray(slot_arr), jnp.asarray(temps), self._next_key())
+        toks_out = np.asarray(toks_out)
+        return {slot: int(toks_out[i]) for i, slot in enumerate(slots)}
+
+    def copy_prefix(self, src_slot: int, dst_slot: int,
+                    n_tokens: int) -> None:
+        """Device-side prefix reuse: make slot ``dst_slot`` start life
+        with the first ``n_tokens`` of slot ``src_slot``'s cache (a
+        prefix-cache hit's replacement for re-prefilling those tokens)."""
+        if not 0 <= src_slot < self.max_batch \
+                or not 0 <= dst_slot < self.max_batch:
+            raise ValueError(
+                f"slots {src_slot}->{dst_slot} out of range "
+                f"[0, {self.max_batch})")
+        if src_slot == dst_slot:
+            raise ValueError(f"copy_prefix onto itself (slot {src_slot})")
+        if not 1 <= n_tokens <= self.cache_len:
+            raise ValueError(
+                f"n_tokens {n_tokens} outside [1, {self.cache_len}]")
+        self.cache = self._copy_prefix_jit(
+            self.cache, jnp.int32(src_slot), jnp.int32(dst_slot),
+            jnp.int32(n_tokens))
 
     def decode(self, tokens_by_slot: dict[int, int]) -> dict[int, int]:
         """One decode iteration.  ``tokens_by_slot`` maps ACTIVE slots to
@@ -183,9 +287,24 @@ class ServeEngine:
             toks[slot] = tok
         nxt, self.cache = self._decode_jit(
             self.cache, self.params, jnp.asarray(toks),
-            jnp.asarray(self._temps), self._next_key())
+            self._temps, self._next_key())
         nxt = np.asarray(nxt)
         return {slot: int(nxt[slot]) for slot in tokens_by_slot}
+
+    def compile_counts(self) -> dict[str, int]:
+        """Compiled-program counts per entry point — the compile-budget
+        contract (len(prefill buckets) + 1 decode + 1 copy_prefix) a
+        test asserts instead of trusting the docstring."""
+
+        def n(f) -> int:
+            try:
+                return int(f._cache_size())
+            except Exception:  # pragma: no cover - jax internals moved
+                return -1
+
+        return {"prefill": n(self._prefill_jit),
+                "decode": n(self._decode_jit),
+                "copy_prefix": n(self._copy_prefix_jit)}
 
 
 # Named Llama configs for the demo/bench surfaces (one source of truth
@@ -194,7 +313,7 @@ LLAMA_PRESETS = ("tiny", "llama3-1b", "llama3-8b")
 
 
 def demo_llama_engine(preset: str, *, seed: int = 0, max_batch: int = 8,
-                      cache_len: int | None = None):
+                      cache_len: int | None = None, prefill_width: int = 4):
     """(cfg, ServeEngine) over a RANDOM-init Llama preset — the shared
     bring-up for the CLI demo workload and the serving bench (real
     deployments construct the engine from checkpointed params
@@ -209,4 +328,5 @@ def demo_llama_engine(preset: str, *, seed: int = 0, max_batch: int = 8,
     params = Llama(cfg).init(jax.random.key(seed),
                              jnp.zeros((1, 8), jnp.int32))["params"]
     return cfg, ServeEngine.from_llama(cfg, params, max_batch=max_batch,
-                                       cache_len=cache_len)
+                                       cache_len=cache_len,
+                                       prefill_width=prefill_width)

@@ -130,6 +130,21 @@ class ServingMetrics:
             "serve_expired_requests_total", "requests past their deadline")
         self.preemptions = r.counter(
             "serve_preemptions_total", "KV-pressure evictions")
+        self.prefill_calls = r.counter(
+            "serve_prefill_calls_total",
+            "jitted prefill programs dispatched (batched: one per batch)")
+        self.prefilled_tokens = r.counter(
+            "serve_prefilled_tokens_total",
+            "real (unpadded) tokens run through prefill — suffix only on "
+            "prefix-cache hits")
+        self.prefix_hit_requests = r.counter(
+            "serve_prefix_hit_requests_total",
+            "prefills that reused cached prefix blocks")
+        self.prefix_hit_tokens = r.counter(
+            "serve_prefix_hit_tokens_total",
+            "prompt tokens served by copy_prefix instead of prefill")
+        self.prefill_batch_size = r.summary(
+            "serve_prefill_batch_size", "sequences per prefill call")
         self.queue_depth = r.gauge(
             "serve_queue_depth", "requests waiting (frontend + scheduler)")
         self.running = r.gauge(
@@ -151,6 +166,11 @@ class ServingMetrics:
             "tokens_per_sec": self.generated_tokens.value / elapsed,
             "ttft_s": self.ttft_s.snapshot(),
             "request_latency_s": self.request_latency_s.snapshot(),
+            "prefill_calls": self.prefill_calls.value,
+            "prefilled_tokens": self.prefilled_tokens.value,
+            "prefix_hit_requests": self.prefix_hit_requests.value,
+            "prefix_hit_tokens": self.prefix_hit_tokens.value,
+            "prefill_batch_size": self.prefill_batch_size.snapshot(),
             "queue_depth": self.queue_depth.value,
             "running_sequences": self.running.value,
             "kv_cache_occupancy": self.cache_occupancy.value,
@@ -170,12 +190,30 @@ class Server:
                  block_size: int = 16, max_queued_tokens: int = 1 << 16,
                  eos_id: int | None = None,
                  registry: MetricRegistry | None = None,
-                 tracer: Tracer | None = None):
+                 tracer: Tracer | None = None,
+                 prefix_cache: bool = True,
+                 max_prefill_batch: int | None = None):
         self.engine = engine
-        self.kv = KVCacheManager(num_blocks, block_size)
+        # Both ISSUE-3 fast paths are duck-typed off the engine so fakes
+        # (and any decode-protocol engine without the batched entry
+        # points) degrade to the classic one-prefill-per-call behavior.
+        # Prefix hits need BOTH entry points: the hit executes as
+        # copy_prefix + a start-offset prefill_batch call, so an engine
+        # with only one of them must run fully cache-off.
+        self._can_copy_prefix = (hasattr(engine, "copy_prefix")
+                                 and hasattr(engine, "prefill_batch"))
+        k = (max_prefill_batch if max_prefill_batch is not None
+             else getattr(engine, "prefill_width", 1))
+        if not hasattr(engine, "prefill_batch"):
+            k = 1
+        k = max(1, min(k, getattr(engine, "prefill_width", k)))
+        self.kv = KVCacheManager(
+            num_blocks, block_size,
+            prefix_cache=prefix_cache and self._can_copy_prefix)
         self.scheduler = ContinuousBatchingScheduler(
             self.kv, max_batch=engine.max_batch,
-            cache_len=engine.cache_len, eos_id=eos_id)
+            cache_len=engine.cache_len, eos_id=eos_id,
+            max_prefill_batch=k)
         self.metrics = ServingMetrics(registry)
         self.tracer = tracer if tracer is not None else Tracer(None)
         self.max_queued_tokens = max_queued_tokens
@@ -301,30 +339,61 @@ class Server:
             # recomputed prefix already contains everything previously
             # emitted, so the last position's logits predict the next
             # unseen token.
-            req = self._by_seq[work.seq.seq_id]
-            first = req.t_first_token is None
+            items = work.items
             t_pf0 = time.monotonic()
-            tok = self.engine.prefill(work.slot, work.seq.prefix, work.bucket,
-                                      work.seq.temperature)
+            if hasattr(self.engine, "prefill_batch") and (
+                    len(items) > 1 or items[0].cached_len):
+                # Prefix hits plant the shared run first; then ONE
+                # bucketed program prefills every item's suffix.
+                for it in items:
+                    # src == slot is the zero-copy hit: the sequence was
+                    # landed on the retired slot that already holds its
+                    # prefix, so there is nothing to move.
+                    if it.cached_len and it.src_slot != it.slot:
+                        self.engine.copy_prefix(it.src_slot, it.slot,
+                                                it.cached_len)
+                toks = self.engine.prefill_batch(
+                    [(it.slot, it.seq.prefix[it.cached_len:], it.cached_len,
+                      it.seq.temperature) for it in items], work.bucket)
+            else:
+                it = items[0]
+                toks = {it.slot: self.engine.prefill(
+                    it.slot, it.seq.prefix, work.bucket,
+                    it.seq.temperature)}
             t_pf1 = time.monotonic()
-            if self.tracer.enabled:
-                if first:
-                    # The span whose start nobody observed from the serve
-                    # loop: submit happened on the caller's thread, so it
-                    # is recorded retroactively from t_submit.  queue_wait
-                    # + prefill sums to the measured TTFT by construction.
-                    self.tracer.record("queue_wait", start=req.t_submit,
-                                       end=t_pf0, trace_id=req.req_id)
-                self.tracer.record("prefill", start=t_pf0, end=t_pf1,
-                                   trace_id=req.req_id, slot=work.slot,
-                                   bucket=work.bucket,
-                                   prefix_len=len(work.seq.prefix),
-                                   resumed=not first)
-            if first:  # preempted reruns keep the first
-                req.t_first_token = t_pf1
-                self.metrics.ttft_s.observe(req.t_first_token - req.t_submit)
-            self.metrics.generated_tokens.add()
-            self._finish(self.scheduler.record_prefill(work.slot, tok))
+            self.metrics.prefill_calls.add()
+            self.metrics.prefill_batch_size.observe(len(items))
+            for it in items:
+                req = self._by_seq[it.seq.seq_id]
+                first = req.t_first_token is None
+                self.metrics.prefilled_tokens.add(
+                    len(it.seq.prefix) - it.cached_len)
+                if it.cached_len:
+                    self.metrics.prefix_hit_requests.add()
+                    self.metrics.prefix_hit_tokens.add(it.cached_len)
+                if self.tracer.enabled:
+                    if first:
+                        # The span whose start nobody observed from the
+                        # serve loop: submit happened on the caller's
+                        # thread, so it is recorded retroactively from
+                        # t_submit.  queue_wait + prefill sums to the
+                        # measured TTFT by construction.
+                        self.tracer.record("queue_wait", start=req.t_submit,
+                                           end=t_pf0, trace_id=req.req_id)
+                    self.tracer.record("prefill", start=t_pf0, end=t_pf1,
+                                       trace_id=req.req_id, slot=it.slot,
+                                       bucket=work.bucket,
+                                       prefix_len=len(it.seq.prefix),
+                                       cached_len=it.cached_len,
+                                       batch=len(items),
+                                       resumed=not first)
+                if first:  # preempted reruns keep the first
+                    req.t_first_token = t_pf1
+                    self.metrics.ttft_s.observe(
+                        req.t_first_token - req.t_submit)
+                self.metrics.generated_tokens.add()
+                self._finish(
+                    self.scheduler.record_prefill(it.slot, toks[it.slot]))
         else:
             t_dec0 = time.monotonic()
             out = self.engine.decode(

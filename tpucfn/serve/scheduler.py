@@ -17,11 +17,37 @@ capacity (the same next-pow2 family rule as
 (queue caps, deadlines, 429s) lives one layer up in
 ``serve/frontend.py``; this module decides only WHAT RUNS NEXT.
 
+Prefill is cheap in two dimensions (ISSUE 3 tentpole):
+
+* **Prefix caching.**  ``_plan`` asks the KV manager for the longest
+  indexed full-block run of the prompt and picks a BACKER — a running,
+  already-prefilled holder whose device slot contains those tokens at
+  positions ``[0, cached_len)``.  A hit replaces the full bucketed
+  prefill with a device-side slot copy (``ServeEngine.copy_prefix``)
+  plus a much shorter SUFFIX prefill; the bucket is computed from the
+  suffix, so a 64-token shared system prompt turns a 128-bucket prefill
+  into a 16- or 32-bucket one.  The scheduler also keeps a host-side
+  map of what each FREE slot still holds (``_slot_tokens``): a retired
+  sequence's KV stays physically intact until its slot is reassigned,
+  so the next wave of requests hits even after every live sharer
+  finished (block accounting is NOT shared on this path — the blocks
+  were freed at retirement, so the hit allocates a full table and only
+  the device copy is saved; when the matched slot itself is chosen as
+  the destination the copy is skipped entirely).  No valid backer ->
+  plain miss (the scheduler never promises device bytes it cannot
+  point at).
+* **Batched prefill.**  ``next_work`` admits up to ``max_prefill_batch``
+  waiting sequences that share the head-of-line BUCKET (hits and misses
+  mix freely — the engine takes a per-lane cache start offset) while
+  slots and blocks last; the engine runs them as one vmapped program,
+  so compile count stays keyed by bucket alone.
+
 Preemption: when the block pool runs dry mid-decode, the youngest
-running sequence is evicted (blocks freed, sequence re-queued at the
-front of the waiting line) and later recomputed from its full prefix —
-prompt plus everything it had generated.  Greedy decode makes the
-recompute token-identical; sampled requests resume from a fresh rng fold
+running sequence is evicted (its references dropped — blocks shared
+with other sequences survive — and the sequence re-queued at the front
+of the waiting line) and later recomputed from its full prefix — prompt
+plus everything it had generated.  Greedy decode makes the recompute
+token-identical; sampled requests resume from a fresh rng fold
 (documented, not hidden).
 """
 
@@ -32,10 +58,17 @@ import enum
 import time
 from collections import deque
 
-from tpucfn.serve.kvcache import KVCacheManager, OutOfBlocksError
+from tpucfn.serve.kvcache import KVCacheManager, OutOfBlocksError, PrefixMatch
 
 # Smallest prefill bucket: below this, padding waste beats recompiles.
 MIN_PREFILL_BUCKET = 16
+
+# How deep next_work() scans the waiting queue for same-bucket batch
+# mates.  Unbounded, a deep queue would pay O(queue * prompt) host
+# hashing per admitted wave — the same O(n^2) class expire() was cured
+# of.  A bounded window keeps the scan O(1) per wave; mates deeper than
+# this simply ride a later wave.
+PREFILL_SCAN_WINDOW = 64
 
 
 def prefill_bucket(n: int, cache_len: int,
@@ -64,7 +97,9 @@ class SequenceState(enum.Enum):
 class Sequence:
     """One in-flight generation.  ``prompt`` is immutable; ``generated``
     grows one token per decode step.  After a preemption the re-prefill
-    prefix is ``prompt + generated`` (recompute, not cache migration)."""
+    prefix is ``prompt + generated`` (recompute, not cache migration —
+    though the recompute itself may hit the prefix cache through any
+    surviving sharer)."""
 
     seq_id: int
     prompt: list[int]
@@ -75,6 +110,9 @@ class Sequence:
     generated: list[int] = dataclasses.field(default_factory=list)
     state: SequenceState = SequenceState.WAITING
     preemptions: int = 0
+    # True once the engine has materialized this sequence's KV in its
+    # slot — the gate for serving as a copy_prefix backer.
+    prefilled: bool = False
 
     @property
     def prefix(self) -> list[int]:
@@ -90,11 +128,42 @@ class Sequence:
 
 
 @dataclasses.dataclass
-class PrefillWork:
-    """Run one bucketed prefill and sample the sequence's first token."""
+class PrefillItem:
+    """One sequence's share of a (possibly batched) prefill call.
+    ``cached_len > 0`` means positions ``[0, cached_len)`` are served by
+    copying from ``src_slot``'s device cache before the suffix runs."""
     seq: Sequence
     slot: int
+    cached_len: int = 0
+    src_slot: int | None = None
+
+
+@dataclasses.dataclass
+class PrefillWork:
+    """Run ONE bucketed prefill program over up to K same-bucket
+    sequences and sample each one's first token."""
+    items: list[PrefillItem]
     bucket: int
+
+    # Single-item compatibility views (most tests and the K=1 path).
+    @property
+    def seq(self) -> Sequence:
+        return self.items[0].seq
+
+    @property
+    def slot(self) -> int:
+        return self.items[0].slot
+
+
+@dataclasses.dataclass
+class _PrefillPlan:
+    """Host-side decision for one candidate admit: how much of the
+    prompt the cache serves, who backs it, and what it still costs."""
+    match: PrefixMatch | None   # passed to admit() iff a backer exists
+    cached_len: int
+    src_slot: int | None
+    bucket: int
+    blocks_needed: int
 
 
 @dataclasses.dataclass
@@ -115,17 +184,31 @@ class ContinuousBatchingScheduler:
 
     def __init__(self, kv: KVCacheManager, *, max_batch: int, cache_len: int,
                  eos_id: int | None = None,
-                 min_bucket: int = MIN_PREFILL_BUCKET):
+                 min_bucket: int = MIN_PREFILL_BUCKET,
+                 max_prefill_batch: int = 1):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if max_prefill_batch < 1:
+            raise ValueError(
+                f"max_prefill_batch must be >= 1, got {max_prefill_batch}")
         self.kv = kv
         self.max_batch = max_batch
         self.cache_len = cache_len
         self.eos_id = eos_id
         self.min_bucket = min_bucket
+        self.max_prefill_batch = max_prefill_batch
         self.waiting: deque[Sequence] = deque()
         self.running: dict[int, Sequence] = {}
         self._free_slots = list(range(max_batch - 1, -1, -1))
+        # Free-slot residue: slot -> tokens whose KV its rows still hold
+        # (the last occupant's written history).  Valid until the slot
+        # is reassigned; lets a retired sequence keep backing prefix
+        # hits after every live sharer finished.
+        self._slot_tokens: dict[int, list[int]] = {}
+        # (head seq_id, num_free) of the last head-of-line plan that did
+        # NOT fit: while neither changes, every decode round would
+        # re-derive the same verdict, so skip the O(prompt) re-hash.
+        self._stalled_plan: tuple | None = None
 
     # -- intake ------------------------------------------------------------
     def add(self, seq: Sequence) -> None:
@@ -156,13 +239,18 @@ class ContinuousBatchingScheduler:
         """Drop every waiting AND running sequence whose deadline has
         passed (a running one frees its slot and blocks — capacity back
         to live traffic immediately).  Returns the casualties; the
-        caller completes their requests with a timeout error."""
+        caller completes their requests with a timeout error.  The
+        waiting queue is rebuilt in ONE pass — a deadline storm on a
+        deep queue must cost O(n), not O(n^2) of deque.remove()."""
         now = time.monotonic() if now is None else now
         dead = [s for s in self.waiting
                 if s.deadline is not None and now > s.deadline]
-        for s in dead:
-            self.waiting.remove(s)
-            s.state = SequenceState.EXPIRED
+        if dead:
+            dead_ids = {s.seq_id for s in dead}
+            self.waiting = deque(s for s in self.waiting
+                                 if s.seq_id not in dead_ids)
+            for s in dead:
+                s.state = SequenceState.EXPIRED
         for slot, s in list(self.running.items()):
             if s.deadline is not None and now > s.deadline:
                 self._vacate(slot)
@@ -172,25 +260,104 @@ class ContinuousBatchingScheduler:
 
     # -- the core decision -------------------------------------------------
     def next_work(self) -> PrefillWork | DecodeWork | None:
-        """Prefill if a waiting sequence fits (slot + blocks), else one
-        decode iteration, else None (idle)."""
+        """Prefill if the head-of-line sequence fits (slot + blocks) —
+        batched with every later waiter that shares its bucket while
+        slots, blocks, and ``max_prefill_batch`` last — else one decode
+        iteration, else None (idle)."""
         if self._free_slots and self.waiting:
-            seq = self.waiting[0]
-            if self.kv.can_admit(len(seq.prefix)):
-                self.waiting.popleft()
-                slot = self._free_slots.pop()
-                self.kv.admit(seq.seq_id, len(seq.prefix))
-                seq.state = SequenceState.RUNNING
-                self.running[slot] = seq
-                return PrefillWork(
-                    seq, slot,
-                    prefill_bucket(len(seq.prefix), self.cache_len,
-                                   self.min_bucket))
+            stall_key = (self.waiting[0].seq_id, self.kv.allocator.num_free)
+            plan = (None if self._stalled_plan == stall_key
+                    else self._plan(self.waiting[0]))
+            if plan is not None \
+                    and plan.blocks_needed <= self.kv.allocator.num_free:
+                self._stalled_plan = None
+                head = self.waiting.popleft()
+                items = [self._admit(head, plan)]
+                if self.max_prefill_batch > 1 and self.waiting:
+                    taken: set[int] = set()
+                    for scanned, seq in enumerate(self.waiting):
+                        if (scanned >= PREFILL_SCAN_WINDOW
+                                or len(items) >= self.max_prefill_batch
+                                or not self._free_slots):
+                            break
+                        p = self._plan(seq)
+                        if (p.bucket != plan.bucket or
+                                p.blocks_needed > self.kv.allocator.num_free):
+                            continue
+                        items.append(self._admit(seq, p))
+                        taken.add(seq.seq_id)
+                    if taken:
+                        self.waiting = deque(
+                            s for s in self.waiting
+                            if s.seq_id not in taken)
+                return PrefillWork(items, plan.bucket)
             # else: blocks are tied up in running sequences; decode below
             # makes progress and will free them (add() guaranteed fit).
+            self._stalled_plan = stall_key
         if self.running:
             return DecodeWork(self._reserve_all())
         return None
+
+    def _plan(self, seq: Sequence) -> _PrefillPlan:
+        """Price one admit: prefix-cache the longest matched run a
+        backer can serve — a running, prefilled holder of the indexed
+        blocks (accounting shared by incref) or a FREE slot whose
+        retired occupant's KV still covers the prefix (device-only hit,
+        full block allocation) — whichever caches more; bucket the
+        (suffix) length; fall back to a full prefill when the hit would
+        not fit the bucket family (cached_len + bucket > cache_len)."""
+        tokens = seq.prefix
+        cached_len, src_slot, match_used = 0, None, None
+        match = self.kv.match_prefix(tokens)
+        if match.cached_len:
+            slot = next(
+                (slot for slot, s in self.running.items()
+                 if s.prefilled and s.seq_id in match.holders), None)
+            if slot is not None:
+                cached_len, src_slot, match_used = \
+                    match.cached_len, slot, match
+        if self.kv.prefix_cache_enabled:
+            bs = self.kv.block_size
+            for slot in self._free_slots:
+                held = self._slot_tokens.get(slot)
+                if held is None:
+                    continue
+                n = 0
+                for a, b in zip(held, tokens):
+                    if a != b:
+                        break
+                    n += 1
+                n = min(n, len(tokens) - 1) // bs * bs
+                if n > cached_len:
+                    cached_len, src_slot, match_used = n, slot, None
+        if cached_len:
+            bucket = prefill_bucket(len(tokens) - cached_len,
+                                    self.cache_len, self.min_bucket)
+            if cached_len + bucket <= self.cache_len:
+                shared = match_used.num_blocks if match_used else 0
+                return _PrefillPlan(
+                    match_used, cached_len, src_slot, bucket,
+                    self.kv.blocks_for(len(tokens)) - shared)
+        return _PrefillPlan(
+            None, 0, None,
+            prefill_bucket(len(tokens), self.cache_len, self.min_bucket),
+            self.kv.blocks_for(len(tokens)))
+
+    def _admit(self, seq: Sequence, plan: _PrefillPlan) -> PrefillItem:
+        if (plan.src_slot is not None and plan.match is None
+                and plan.src_slot in self._free_slots):
+            # The backer is a retired slot: land the new sequence ON it,
+            # making the device copy a no-op (frontend skips src == dst).
+            self._free_slots.remove(plan.src_slot)
+            slot = plan.src_slot
+        else:
+            slot = self._free_slots.pop()
+        self._slot_tokens.pop(slot, None)
+        self.kv.admit(seq.seq_id, tokens=seq.prefix, match=plan.match)
+        seq.state = SequenceState.RUNNING
+        seq.prefilled = False
+        self.running[slot] = seq
+        return PrefillItem(seq, slot, plan.cached_len, plan.src_slot)
 
     def _reserve_all(self) -> dict[int, Sequence]:
         """Reserve the block slot every decode step is about to write
@@ -198,7 +365,10 @@ class ContinuousBatchingScheduler:
         step, last step included), preempting youngest-first whenever
         the pool runs dry.  Oldest sequences reserve first so preemption
         converges: the oldest sequence alone always fits, because add()
-        checked the whole pool.  Returns the surviving running map."""
+        checked the whole pool (a preempted sharer's blocks free only
+        when their LAST holder goes, but every preemption removes a
+        holder, so the loop still terminates).  Returns the surviving
+        running map."""
         by_age = sorted(self.running.items(), key=lambda kv_: kv_[1].arrival)
         for slot, seq in by_age:
             if self.running.get(slot) is not seq:
@@ -221,6 +391,7 @@ class ContinuousBatchingScheduler:
         """First sampled token for a just-prefilled slot.  Returns the
         sequence if it is already finished (max_new=1 or instant EOS)."""
         seq = self.running[slot]
+        seq.prefilled = True
         seq.generated.append(token)
         return self._maybe_retire(slot, token)
 
@@ -230,7 +401,7 @@ class ContinuousBatchingScheduler:
         append, retire in place when done.  Returns the sequence iff
         finished."""
         seq = self.running[slot]
-        self.kv.commit_token(seq.seq_id)
+        self.kv.commit_token(seq.seq_id, token=seq.last_token)
         seq.generated.append(token)
         return self._maybe_retire(slot, token)
 
@@ -244,9 +415,11 @@ class ContinuousBatchingScheduler:
         return None
 
     def preempt(self, slot: int) -> Sequence:
-        """Evict a running sequence: blocks freed (counted as eviction),
-        slot returned, sequence re-queued FIRST so it is recomputed as
-        soon as capacity returns (no starvation of preempted work)."""
+        """Evict a running sequence: its block references dropped
+        (counted as eviction; blocks shared with other sequences
+        survive), slot returned, sequence re-queued FIRST so it is
+        recomputed as soon as capacity returns (no starvation of
+        preempted work)."""
         seq = self.running[slot]
         self._vacate(slot, evicted=True)
         seq.state = SequenceState.WAITING
@@ -258,6 +431,14 @@ class ContinuousBatchingScheduler:
         seq = self.running.pop(slot)
         self.kv.release(seq.seq_id, evicted=evicted)
         self._free_slots.append(slot)
+        if seq.prefilled and self.kv.prefix_cache_enabled:
+            # The slot's rows hold prompt + generated[:-1] (each decode
+            # step writes its INPUT token's K/V; the last sampled token
+            # was never written) — usable residue until reassignment.
+            self._slot_tokens[slot] = seq.prefix[:-1]
+        else:
+            self._slot_tokens.pop(slot, None)
+        seq.prefilled = False
 
     # -- observability -----------------------------------------------------
     @property
