@@ -10,6 +10,7 @@ runtime initialization no-ops outside a cluster.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 from pathlib import Path
@@ -194,7 +195,7 @@ def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=(),
     # or a bind error from the obs endpoint itself must still release
     # the bound port and the open log/trace files — a retry in the same
     # process would otherwise hit "Address already in use".
-    logger = tracer = obs_srv = None
+    logger = tracer = obs_srv = hb = None
     try:
         logger = MetricLogger(run_dir / "logs", stdout_every=args.log_every)
         # The observability plane (ISSUE 2): registry metrics + trace
@@ -208,11 +209,27 @@ def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=(),
         obs_srv = start_obs_server(
             registry, role="trainer", host_id=host,
             health_fn=lambda: (True, {"step": obs.last_step.value}))
+        # The fault-tolerance plane (ISSUE 4): when the gang coordinator
+        # assigned a heartbeat dir, a daemon thread beats liveness every
+        # interval and the loop keeps the step fresh (update_step) so
+        # the monitor can tell DEAD from STRAGGLER.
+        ft_dir = os.environ.get("TPUCFN_FT_DIR", "").strip()
+        if ft_dir:
+            from tpucfn.ft import HeartbeatWriter
+
+            try:
+                hb_s = float(os.environ.get("TPUCFN_FT_HEARTBEAT_S", "") or 1.0)
+            except ValueError:
+                hb_s = 1.0
+            hb = HeartbeatWriter(ft_dir, host_id=host, interval_s=hb_s,
+                                 role="trainer").start()
         t_start = time.perf_counter()
         return _train_loop_body(
             trainer, ds, mesh, args, items_per_step, extra_axes, run_eval,
-            logger, timer, obs, t_start, run_dir)
+            logger, timer, obs, t_start, run_dir, hb)
     finally:
+        if hb is not None:
+            hb.stop()
         if logger is not None:
             logger.close()
         if tracer is not None:
@@ -222,7 +239,8 @@ def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=(),
 
 
 def _train_loop_body(trainer, ds, mesh, args, items_per_step, extra_axes,
-                     run_eval, logger, timer, obs, t_start, run_dir):
+                     run_eval, logger, timer, obs, t_start, run_dir,
+                     hb=None):
     import jax
 
     from tpucfn.ckpt import CheckpointManager
@@ -235,11 +253,10 @@ def _train_loop_body(trainer, ds, mesh, args, items_per_step, extra_axes,
         # operator re-run) picks up at its latest checkpoint without the
         # caller remembering --resume; --fresh opts out (SURVEY.md §5
         # failure row — recovery must not silently retrain from step 0).
-        if not args.fresh and ckpt.latest_step() is not None:
-            state = ckpt.restore(trainer.abstract_state())
+        state, resumed = trainer.init_or_resume(
+            jax.random.key(args.seed), ckpt, fresh=args.fresh)
+        if resumed is not None:
             print(f"resumed from step {int(state.step)}", flush=True)
-        else:
-            state = trainer.init(jax.random.key(args.seed))
 
         total = args.steps or len(ds) * args.num_epochs
         halt = min(total, args.stop_after) if args.stop_after else total
@@ -265,6 +282,8 @@ def _train_loop_body(trainer, ds, mesh, args, items_per_step, extra_axes,
                 with obs.step(step + 1):
                     state, metrics = trainer.step(state, batch)
                     step = int(state.step)  # blocks -> honest step timing
+                if hb is not None:
+                    hb.update_step(step)  # step-lag signal for the monitor
                 timer.tick()
                 if t_start is not None:
                     # data staging + init/restore + first compile+step

@@ -1,0 +1,106 @@
+"""Deterministic toy trainer for the end-to-end ft chaos drill.
+
+Behaves like a real tpucfn job from the recovery plane's point of view:
+heartbeats via HeartbeatWriter (TPUCFN_FT_DIR fan-out), checkpoints via
+CheckpointManager every FT_E2E_CKPT_EVERY steps (host 0 saves, everyone
+restores), resume-from-latest on startup, and a per-step loss trajectory
+appended to a JSONL so the test can compare an interrupted run against
+an uninterrupted one step by step.  The math is pure numpy and exactly
+deterministic: w ← 0.9·w + 0.1, loss = (w − 1)², so any two runs agree
+bit-for-bit wherever their step ranges overlap.
+"""
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+from tpucfn.ckpt import CheckpointManager  # noqa: E402  (imports jax/orbax)
+from tpucfn.ft import HeartbeatWriter  # noqa: E402
+
+
+def _latest_finalized_step(ckpt_dir: Path) -> int:
+    """Latest finalized checkpoint step by scanning the directory.
+
+    Orbax's ``CheckpointManager.latest_step()`` serves a step list cached
+    at init and updated only by that manager's own saves, so host 1
+    polling its manager would never see host 0's new checkpoints.
+    Finalized step dirs are bare numbers; in-flight saves carry an
+    ``.orbax-checkpoint-tmp-*`` suffix and are excluded.
+    """
+    try:
+        return max((int(p.name) for p in ckpt_dir.iterdir()
+                    if p.is_dir() and p.name.isdigit()), default=0)
+    except OSError:
+        return 0
+
+
+def main() -> int:
+    host = int(os.environ.get("TPUCFN_HOST_ID", "0"))
+    run_dir = Path(os.environ["FT_E2E_RUN_DIR"])
+    total = int(os.environ["FT_E2E_TOTAL_STEPS"])
+    ckpt_every = int(os.environ.get("FT_E2E_CKPT_EVERY", "10"))
+    step_sleep = float(os.environ.get("FT_E2E_STEP_SLEEP", "0.05"))
+    ft_dir = os.environ.get("TPUCFN_FT_DIR", "").strip()
+    hb_s = float(os.environ.get("TPUCFN_FT_HEARTBEAT_S", "0.2") or 0.2)
+
+    hb = None
+    if ft_dir:
+        hb = HeartbeatWriter(ft_dir, host_id=host, interval_s=hb_s,
+                             role="e2e").start()
+    template = {"step": np.zeros((), np.int64),
+                "w": np.asarray(10.0, np.float64)}
+    losses = run_dir / f"losses-host{host:03d}.jsonl"
+    try:
+        with CheckpointManager(run_dir / "ckpt", async_save=False,
+                               save_interval_steps=ckpt_every) as ckpt:
+            latest = ckpt.latest_step()
+            if latest is not None:
+                state = ckpt.restore(template)
+                print(f"resumed from step {int(state['step'])}", flush=True)
+            else:
+                state = {k: v.copy() for k, v in template.items()}
+            step = int(state["step"])
+            w = float(state["w"])
+            sync_deadline = time.monotonic() + 120.0
+            with open(losses, "a") as f:
+                while step < total:
+                    if host != 0:
+                        # Bound drift to one checkpoint interval, the way a
+                        # real SPMD gang's collectives would: host 0 pays
+                        # every orbax save, and an unbounded-drift host 1
+                        # can drag the fleet max step (the chaos at_step
+                        # trigger) past the kill point before host 0 has
+                        # written the checkpoint the drill resumes from.
+                        while (step + 1 - _latest_finalized_step(
+                                   run_dir / "ckpt") > ckpt_every
+                               and time.monotonic() < sync_deadline):
+                            time.sleep(0.01)
+                    w = 0.9 * w + 0.1
+                    step += 1
+                    f.write(json.dumps({
+                        "step": step, "w": w, "loss": (w - 1.0) ** 2,
+                        "pid": os.getpid()}) + "\n")
+                    f.flush()
+                    if hb is not None:
+                        hb.update_step(step)
+                    if host == 0:
+                        ckpt.save(step, {"step": np.asarray(step, np.int64),
+                                         "w": np.asarray(w, np.float64)})
+                    time.sleep(step_sleep)
+            if host == 0:
+                ckpt.save(step, {"step": np.asarray(step, np.int64),
+                                 "w": np.asarray(w, np.float64)}, force=True)
+    finally:
+        if hb is not None:
+            hb.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,139 @@
+"""Deterministic chaos harness (tpucfn.ft.chaos): spec parsing, seeded
+replay, firing semantics against a recording target, the
+FakeControlPlane target, and checkpoint corruption."""
+
+import json
+import random
+
+import pytest
+
+from tpucfn.ft import (
+    ChaosEngine,
+    ChaosEvent,
+    ChaosSpec,
+    ChaosTarget,
+    ControlPlaneChaosTarget,
+    corrupt_latest_checkpoint,
+)
+from tpucfn.provision.control_plane import FakeControlPlane
+from tpucfn.spec import ClusterSpec
+
+
+class Recorder(ChaosTarget):
+    def __init__(self, n=4):
+        self.n = n
+        self.calls = []
+
+    def num_hosts(self):
+        return self.n
+
+    def kill_host(self, host_id):
+        self.calls.append(("kill", host_id))
+
+    def hang_host(self, host_id):
+        self.calls.append(("hang", host_id))
+
+    def resume_host(self, host_id):
+        self.calls.append(("resume", host_id))
+
+    def delay_heartbeats(self, host_id, duration_s):
+        self.calls.append(("delay", host_id, duration_s))
+
+    def corrupt_latest_checkpoint(self, rng):
+        self.calls.append(("corrupt",))
+
+
+def test_spec_json_roundtrip_and_validation():
+    spec = ChaosSpec(events=(
+        ChaosEvent(action="kill", at_s=1.5, host=2),
+        ChaosEvent(action="hang", at_step=100, duration_s=3.0),
+        ChaosEvent(action="corrupt_ckpt", at_s=9.0),
+    ), seed=42)
+    again = ChaosSpec.from_json(json.dumps(spec.to_json()))
+    assert again == spec
+    with pytest.raises(ValueError):
+        ChaosEvent(action="explode", at_s=1.0)
+    with pytest.raises(ValueError):
+        ChaosEvent(action="kill")  # no trigger at all
+
+
+def test_engine_fires_on_elapsed_and_step_triggers():
+    t = Recorder()
+    spec = ChaosSpec(events=(
+        ChaosEvent(action="kill", at_s=2.0, host=1),
+        ChaosEvent(action="delay_heartbeats", at_step=50, host=0,
+                   duration_s=4.0),
+    ))
+    eng = ChaosEngine(spec, t)
+    assert eng.tick(0.5, fleet_step=10) == [] and not t.calls
+    eng.tick(2.1, fleet_step=20)
+    assert t.calls == [("kill", 1)]
+    assert not eng.done()
+    eng.tick(2.2, fleet_step=50)  # step trigger independent of time
+    assert t.calls[-1] == ("delay", 0, 4.0)
+    assert eng.done()
+    assert [f.event.action for f in eng.fired] == ["kill",
+                                                   "delay_heartbeats"]
+
+
+def test_engine_hang_schedules_resume_after_duration():
+    t = Recorder()
+    eng = ChaosEngine(ChaosSpec(events=(
+        ChaosEvent(action="hang", at_s=1.0, host=2, duration_s=2.0),)), t)
+    eng.tick(1.0)
+    assert t.calls == [("hang", 2)] and not eng.done()
+    eng.tick(2.5)
+    assert t.calls == [("hang", 2)]  # not yet
+    eng.tick(3.0)
+    assert t.calls == [("hang", 2), ("resume", 2)]
+    assert eng.done()
+
+
+def test_unpinned_victim_comes_from_seeded_rng():
+    spec = ChaosSpec(events=tuple(
+        ChaosEvent(action="kill", at_s=float(i)) for i in range(6)), seed=9)
+    t1, t2 = Recorder(4), Recorder(4)
+    ChaosEngine(ChaosSpec.from_json(spec.to_json()), t1).tick(100.0)
+    ChaosEngine(spec, t2).tick(100.0)
+    assert t1.calls == t2.calls  # same seed → same victims
+    ref = random.Random(9)
+    assert [c[1] for c in t1.calls] == [ref.randrange(4) for _ in range(6)]
+
+
+def test_control_plane_target_kills_fake_host():
+    cp = FakeControlPlane(steps_to_provision=1)
+    cp.create(ClusterSpec(name="chaos", accelerator="v4-16"))
+    cp.tick()
+    target = ControlPlaneChaosTarget(cp, "chaos")
+    assert target.num_hosts() == 2
+    eng = ChaosEngine(ChaosSpec(events=(
+        ChaosEvent(action="kill", at_s=0.5, host=1),)), target)
+    eng.tick(1.0)
+    rec = cp.describe("chaos")
+    assert not rec.hosts[1].healthy and rec.hosts[0].healthy
+    assert ("chaos", "host1-died") in cp.events
+
+
+def test_corrupt_latest_checkpoint_targets_latest_step(tmp_path):
+    d = tmp_path / "ckpt"
+    for step in (5, 10):
+        sub = d / str(step) / "default"
+        sub.mkdir(parents=True)
+        (sub / "data.bin").write_bytes(b"A" * 4096)
+        (d / str(step) / "_METADATA").write_text("{}")
+    victim = corrupt_latest_checkpoint(d, random.Random(0))
+    assert victim is not None and victim.parts[-3] == "10"
+    blob = victim.read_bytes()
+    assert blob != b"A" * 4096 and len(blob) == 256  # garbage + truncate
+    # step 5 untouched
+    assert (d / "5" / "default" / "data.bin").read_bytes() == b"A" * 4096
+    # replayed RNG produces identical garbage (determinism)
+    for p in d.rglob("data.bin"):
+        p.write_bytes(b"A" * 4096)
+    assert corrupt_latest_checkpoint(d, random.Random(0)).read_bytes() == blob
+
+
+def test_corrupt_latest_checkpoint_empty_dirs(tmp_path):
+    assert corrupt_latest_checkpoint(tmp_path / "nope", random.Random(0)) is None
+    (tmp_path / "ckpt").mkdir()
+    assert corrupt_latest_checkpoint(tmp_path / "ckpt", random.Random(0)) is None

@@ -1,0 +1,80 @@
+"""CLI surface of the ft plane: `tpucfn launch --ft` runs the gang
+coordinator with heartbeat fan-out, and `tpucfn ft status` renders the
+fleet view + recovery metrics from the supervisor's on-disk snapshot."""
+
+import json
+import sys
+
+from tpucfn.cli.main import main
+
+
+def _cli(tmp_path, *argv):
+    return main(["--state-dir", str(tmp_path / "state"), *argv])
+
+
+# Beats once via stdlib (no tpucfn import: fast interpreter startup),
+# fails the first gang attempt, succeeds the second.
+WORKER = """
+import json, os, pathlib, sys, time
+d = os.environ['TPUCFN_FT_DIR']; h = int(os.environ['TPUCFN_HOST_ID'])
+os.makedirs(d, exist_ok=True)
+with open(f'{d}/hb-host{h:03d}.jsonl', 'a') as f:
+    f.write(json.dumps({'host_id': h, 'pid': os.getpid(), 'step': 5,
+                        't': time.time(), 'seq': 1}) + '\\n')
+storage = pathlib.Path(os.environ['TPUCFN_STORAGE'])
+storage.mkdir(parents=True, exist_ok=True)
+flag = storage / f'ran_once_{h}'  # per-host: no cross-host flag races
+if flag.exists():
+    sys.exit(0)
+flag.write_text('x')
+sys.exit(3 if h == 0 else 0)
+"""
+
+
+def test_launch_ft_then_status_json(tmp_path, capsys):
+    assert _cli(tmp_path, "create-stack", "--name", "drill",
+                "--accelerator", "v4-16") == 0
+    rc = _cli(tmp_path, "launch", "--name", "drill", "--ft",
+              "--ft-restart-budget", "1", "--ft-backoff", "0",
+              "--ft-heartbeat-interval", "0.2", "--",
+              sys.executable, "-c", WORKER)
+    assert rc == 0
+    capsys.readouterr()
+
+    assert _cli(tmp_path, "ft", "status", "--name", "drill", "--json") == 0
+    report = json.loads(capsys.readouterr().out)
+    # acceptance: ft_* metrics visible in `tpucfn ft status --json`
+    m = report["metrics"]
+    assert m["ft_restarts_total"] == 1
+    assert m["ft_failures_detected_total"] >= 1
+    assert m["ft_mttr_seconds"]["count"] == 1
+    assert report["policy"] == "gang"
+    assert report["budget"] == {"max_restarts": 1, "used": 1}
+    assert {h["host"] for h in report["hosts"]} == {0, 1}
+    kinds = [e["kind"] for e in report["events"]]
+    assert "detect" in kinds and "recovered" in kinds and "done" in kinds
+
+    # human rendering mentions the fleet + restart counters
+    assert _cli(tmp_path, "ft", "status", "--name", "drill") == 0
+    out = capsys.readouterr().out
+    assert "ft fleet view" in out and "restarts=1" in out
+
+
+def test_ft_status_without_target_errors(tmp_path, capsys):
+    assert _cli(tmp_path, "ft", "status") == 2
+    assert "ft status needs" in capsys.readouterr().err
+
+
+def test_ft_status_missing_dir_errors(tmp_path, capsys):
+    assert _cli(tmp_path, "ft", "status", "--dir",
+                str(tmp_path / "nope")) == 1
+    assert "no ft dir" in capsys.readouterr().err
+
+
+def test_launch_without_ft_has_no_ft_dir(tmp_path, capsys):
+    assert _cli(tmp_path, "create-stack", "--name", "plain",
+                "--accelerator", "cpu-8") == 0
+    code = ("import os, sys; "
+            "sys.exit(1 if 'TPUCFN_FT_DIR' in os.environ else 0)")
+    assert _cli(tmp_path, "launch", "--name", "plain", "--",
+                sys.executable, "-c", code) == 0

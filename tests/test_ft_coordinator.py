@@ -1,0 +1,340 @@
+"""Gang coordination (tpucfn.ft.coordinator) over real subprocesses —
+tiny ``python -c`` workers (no jax), sub-second timings, every incident
+audited through the events JSONL and the ft_* registry metrics."""
+
+import json
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from tpucfn.bootstrap import EnvContract
+from tpucfn.ft import (
+    ChaosEvent,
+    ChaosSpec,
+    GangCoordinator,
+    GangRestart,
+    HeartbeatMonitor,
+    MonitorConfig,
+    RestartBudget,
+    SoloRestart,
+)
+from tpucfn.launch import Launcher, LocalTransport
+from tpucfn.obs import MetricRegistry
+
+
+def _contract(tmp_path, n=2) -> EnvContract:
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("".join("127.0.0.1:0\n" for _ in range(n)))
+    return EnvContract(
+        workers_path=str(hostfile), workers_count=n, worker_chip_count=1,
+        coordinator="127.0.0.1:1234", host_id=0, storage=str(tmp_path),
+        generation=1)
+
+
+def _launcher(tmp_path, n=2, **kw) -> Launcher:
+    return Launcher(_contract(tmp_path, n), LocalTransport(), **kw)
+
+
+def _events(ft_dir) -> list[dict]:
+    p = Path(ft_dir) / "events.jsonl"
+    if not p.is_file():
+        return []
+    return [json.loads(s) for s in p.read_text().splitlines() if s.strip()]
+
+
+def _kinds(ft_dir) -> list[str]:
+    return [e["kind"] for e in _events(ft_dir)]
+
+
+FAIL_ONCE = (
+    "import pathlib,sys,os\n"
+    "p = pathlib.Path(os.environ['FLAG'])\n"
+    "sys.exit(0) if p.exists() else (p.write_text('x'), sys.exit(3))\n")
+
+
+def test_crash_gang_restart_recovers_and_audits(tmp_path):
+    ft_dir = tmp_path / "ft"
+    launcher = _launcher(tmp_path, n=2)
+    registry = MetricRegistry()
+    import os
+
+    os.environ["FLAG"] = str(tmp_path / "ran_once")
+    try:
+        coord = GangCoordinator(
+            launcher, [sys.executable, "-c", FAIL_ONCE],
+            policy=GangRestart(RestartBudget(2)), registry=registry,
+            ft_dir=ft_dir, poll_interval=0.01, term_grace_s=0.5)
+        assert coord.run() == 0
+    finally:
+        del os.environ["FLAG"]
+    v = registry.varz()["metrics"]
+    # supervisor_* compat surface (the run_with_restarts contract)
+    assert v["supervisor_launch_attempts_total"] == 2
+    assert v["supervisor_restarts_total"] == 1
+    assert v["supervisor_failures_total"] == 1
+    assert v["supervisor_last_exit_code"] == 0
+    # ft_* recovery surface (ISSUE 4 acceptance metrics)
+    assert v["ft_failures_detected_total"] >= 1
+    assert v["ft_restarts_total"] == 1
+    assert v["ft_gang_restarts_total"] == 1
+    assert v["ft_mttr_seconds"]["count"] == 1
+    # the audit trail: detect → decide → act(relaunch) → recovered
+    kinds = _kinds(ft_dir)
+    i = kinds.index("detect")
+    assert kinds[:2] == ["launch", "launch"] or kinds[0] == "launch"
+    assert kinds[i:i + 2] == ["detect", "decide"]
+    assert "launch" in kinds[i:] and "recovered" in kinds[i:]
+    assert kinds[-1] == "done"
+    detect = next(e for e in _events(ft_dir) if e["kind"] == "detect")
+    assert detect["failures"][0]["kind"] == "crash"
+    assert detect["failures"][0]["rc"] == 3
+    # supervisor.json snapshot for `tpucfn ft status`
+    snap = json.loads((ft_dir / "supervisor.json").read_text())
+    assert snap["policy"] == "gang"
+    assert snap["metrics"]["ft_restarts_total"] == 1
+
+
+def test_budget_exhaustion_gives_up_with_failing_rc(tmp_path):
+    ft_dir = tmp_path / "ft"
+    registry = MetricRegistry()
+    coord = GangCoordinator(
+        _launcher(tmp_path, n=1),
+        [sys.executable, "-c", "import sys; sys.exit(7)"],
+        policy=GangRestart(RestartBudget(1)), registry=registry,
+        ft_dir=ft_dir, poll_interval=0.01, term_grace_s=0.5)
+    assert coord.run() == 7
+    v = registry.varz()["metrics"]
+    assert v["supervisor_launch_attempts_total"] == 2  # first + 1 retry
+    assert v["supervisor_restarts_total"] == 1
+    assert v["supervisor_failures_total"] == 2
+    assert v["supervisor_last_exit_code"] == 7
+    assert v["ft_give_ups_total"] == 1
+    assert _kinds(ft_dir)[-1] == "give_up"
+    assert _events(ft_dir)[-1]["reason"].startswith("restart budget")
+
+
+def test_clean_success_publishes_zero_failures(tmp_path):
+    registry = MetricRegistry()
+    coord = GangCoordinator(
+        _launcher(tmp_path, n=2), [sys.executable, "-c", "pass"],
+        registry=registry, poll_interval=0.01)
+    assert coord.run() == 0
+    v = registry.varz()["metrics"]
+    assert v["supervisor_launch_attempts_total"] == 1
+    assert v["supervisor_restarts_total"] == 0
+    assert v["supervisor_failures_total"] == 0
+    assert v["supervisor_last_exit_code"] == 0
+
+
+def test_solo_restart_replaces_only_dead_host(tmp_path):
+    """Host 1 dies once; SoloRestart relaunches ONLY host 1, host 0's
+    process survives the incident (its pid never changes)."""
+    ft_dir = tmp_path / "ft"
+    flag = tmp_path / "h1_ran"
+    ok = tmp_path / "h1_ok"
+    # host0: wait for host1's second run; host1: fail once, then succeed
+    worker = (
+        "import os, pathlib, sys, time\n"
+        f"flag = pathlib.Path(r'{flag}'); ok = pathlib.Path(r'{ok}')\n"
+        "h = int(os.environ['TPUCFN_HOST_ID'])\n"
+        "if h == 1:\n"
+        "    if flag.exists(): ok.write_text('x'); sys.exit(0)\n"
+        "    flag.write_text('x'); sys.exit(5)\n"
+        "deadline = time.time() + 20\n"
+        "while not ok.exists():\n"
+        "    time.sleep(0.01)\n"
+        "    assert time.time() < deadline\n")
+    registry = MetricRegistry()
+    coord = GangCoordinator(
+        _launcher(tmp_path, n=2), [sys.executable, "-c", worker],
+        policy=SoloRestart(RestartBudget(2)), registry=registry,
+        ft_dir=ft_dir, poll_interval=0.01, term_grace_s=0.5)
+    launches = []
+    orig = coord.launcher.launch_host
+
+    def spy(argv, host_id):
+        launches.append(host_id)
+        return orig(argv, host_id)
+
+    coord.launcher.launch_host = spy
+    assert coord.run() == 0
+    assert launches == [1]
+    v = registry.varz()["metrics"]
+    assert v["ft_solo_restarts_total"] == 1
+    assert v["ft_gang_restarts_total"] == 0
+    assert v["supervisor_launch_attempts_total"] == 1  # one gang launch
+    assert v["supervisor_restarts_total"] == 1
+    decide = next(e for e in _events(ft_dir) if e["kind"] == "decide")
+    assert decide["action"] == "solo_restart" and decide["hosts"] == [1]
+    solo = next(e for e in _events(ft_dir) if e["kind"] == "solo_launch")
+    assert solo["host"] == 1
+
+
+@pytest.mark.slow
+def test_hang_detected_via_heartbeat_monitor(tmp_path):
+    """A process that stops heartbeating but stays alive is a HANG: the
+    monitor condemns it, the coordinator kills + gang-restarts."""
+    ft_dir = tmp_path / "ft"
+    flag = tmp_path / "hung_once"
+    worker = (
+        "import json, os, pathlib, sys, time\n"
+        f"flag = pathlib.Path(r'{flag}')\n"
+        "if flag.exists(): sys.exit(0)\n"
+        "flag.write_text('x')\n"
+        "d = os.environ['TPUCFN_FT_DIR']; h = int(os.environ['TPUCFN_HOST_ID'])\n"
+        "os.makedirs(d, exist_ok=True)\n"
+        "with open(f'{d}/hb-host{h:03d}.jsonl', 'a') as f:\n"
+        "    f.write(json.dumps({'host_id': h, 'pid': os.getpid(),"
+        " 'step': 1, 't': time.time(), 'seq': 1}) + '\\n')\n"
+        "time.sleep(60)\n")  # one beat, then silence: a hang
+    # dead at 0.3s; explicit startup grace: interpreter start on a
+    # loaded box can exceed the default 10x-interval window, and a
+    # phantom no-heartbeat-yet incident here would burn the budget
+    cfg = MonitorConfig(interval_s=0.05, startup_grace_s=3.0)
+    registry = MetricRegistry()
+    launcher = _launcher(tmp_path, n=1, ft_dir=str(ft_dir),
+                         ft_heartbeat_s=0.05)
+    coord = GangCoordinator(
+        launcher, [sys.executable, "-c", worker],
+        policy=GangRestart(RestartBudget(1)),
+        monitor=HeartbeatMonitor(ft_dir, expected_hosts=1, config=cfg),
+        registry=registry, ft_dir=ft_dir, poll_interval=0.01,
+        term_grace_s=0.2)
+    t0 = time.monotonic()
+    assert coord.run() == 0
+    assert time.monotonic() - t0 < 20
+    detect = next(e for e in _events(ft_dir) if e["kind"] == "detect")
+    assert detect["failures"][0]["kind"] == "hang"
+    v = registry.varz()["metrics"]
+    assert v["ft_gang_restarts_total"] == 1
+    assert v["ft_failures_detected_total"] >= 1
+
+
+@pytest.mark.slow
+def test_chaos_kill_drives_detection_and_recovery(tmp_path):
+    """A ChaosSpec kill against the coordinator's own process table:
+    fired event audited, crash detected, gang restarted."""
+    ft_dir = tmp_path / "ft"
+    flag = tmp_path / "killed_once"
+    # Only host 0 (the scripted victim) arms the flag and sleeps; host 1
+    # exits clean immediately.  A shared flag would race: if host 1 won
+    # the write, host 0 would exit before the kill ever fired.
+    worker = (
+        "import os, pathlib, sys, time\n"
+        f"flag = pathlib.Path(r'{flag}')\n"
+        "if int(os.environ['TPUCFN_HOST_ID']) != 0 or flag.exists():\n"
+        "    sys.exit(0)\n"
+        "flag.write_text('x')\n"
+        "time.sleep(30)\n")  # first run: sit there until chaos kills us
+    registry = MetricRegistry()
+    coord = GangCoordinator(
+        _launcher(tmp_path, n=2), [sys.executable, "-c", worker],
+        policy=GangRestart(RestartBudget(1)), registry=registry,
+        ft_dir=ft_dir, poll_interval=0.01, term_grace_s=0.3,
+        # fire well after interpreter startup: the first-run workers
+        # must have written their ran-once flag before the kill lands,
+        # or the relaunched gang sleeps the full 30s
+        chaos=ChaosSpec(events=(ChaosEvent(action="kill", at_s=2.0,
+                                           host=0),)))
+    t0 = time.monotonic()
+    assert coord.run() == 0
+    elapsed = time.monotonic() - t0
+    assert elapsed < 20
+    assert coord.chaos.done()
+    assert [f.event.action for f in coord.chaos.fired] == ["kill"]
+    detect = next(e for e in _events(ft_dir) if e["kind"] == "detect")
+    assert detect["failures"][0]["host"] == 0
+    assert detect["failures"][0]["kind"] == "crash"
+    assert registry.varz()["metrics"]["ft_gang_restarts_total"] == 1
+
+
+def test_observe_only_table_reaps_crash_and_returns_rc(tmp_path):
+    """A decision table that declares CRASH non-actionable must still
+    reap the dead rank and surface its rc — not re-detect it forever."""
+    from tpucfn.ft import Action, FailureKind
+
+    registry = MetricRegistry()
+    coord = GangCoordinator(
+        _launcher(tmp_path, n=1),
+        [sys.executable, "-c", "import sys; sys.exit(5)"],
+        policy=GangRestart(RestartBudget(3),
+                           table={FailureKind.CRASH: Action.NONE}),
+        registry=registry, ft_dir=tmp_path / "ft", poll_interval=0.01)
+    assert coord.run() == 5
+    v = registry.varz()["metrics"]
+    assert v["ft_restarts_total"] == 0
+    assert v["ft_incidents_total"] == 1  # detected once, not every tick
+
+
+def test_at_step_chaos_without_monitor_is_rejected(tmp_path):
+    """Fleet step comes from heartbeats; an at_step-only chaos event
+    with no monitor would silently never fire and the drill would pass
+    vacuously — constructing that coordinator must raise."""
+    with pytest.raises(ValueError, match="at_step"):
+        GangCoordinator(
+            _launcher(tmp_path, n=1), [sys.executable, "-c", "pass"],
+            chaos=ChaosSpec(events=(
+                ChaosEvent(action="kill", at_step=10, host=0),)))
+    # an at_s trigger needs no monitor
+    GangCoordinator(
+        _launcher(tmp_path, n=1), [sys.executable, "-c", "pass"],
+        chaos=ChaosSpec(events=(
+            ChaosEvent(action="kill", at_s=1.0, host=0),)))
+
+
+@pytest.mark.slow
+def test_observe_only_hang_is_one_incident(tmp_path):
+    """A HANG the table declines to act on is suppressed after the
+    first incident — not re-detected every poll tick for the rest of
+    the run."""
+    from tpucfn.ft import Action, FailureKind
+
+    ft_dir = tmp_path / "ft"
+    # one beat, then silence long past the dead threshold, then clean exit
+    worker = (
+        "import json, os, time\n"
+        "d = os.environ['TPUCFN_FT_DIR']; h = int(os.environ['TPUCFN_HOST_ID'])\n"
+        "os.makedirs(d, exist_ok=True)\n"
+        "with open(f'{d}/hb-host{h:03d}.jsonl', 'a') as f:\n"
+        "    f.write(json.dumps({'host_id': h, 'pid': os.getpid(),"
+        " 'step': 1, 't': time.time(), 'seq': 1}) + '\\n')\n"
+        "time.sleep(2.5)\n")
+    registry = MetricRegistry()
+    launcher = _launcher(tmp_path, n=1, ft_dir=str(ft_dir),
+                         ft_heartbeat_s=0.05)
+    coord = GangCoordinator(
+        launcher, [sys.executable, "-c", worker],
+        policy=GangRestart(RestartBudget(3),
+                           table={FailureKind.HANG: Action.NONE}),
+        monitor=HeartbeatMonitor(
+            ft_dir, expected_hosts=1,
+            config=MonitorConfig(interval_s=0.05, startup_grace_s=1.5)),
+        registry=registry, ft_dir=ft_dir, poll_interval=0.01,
+        term_grace_s=0.2)
+    assert coord.run() == 0  # the sleeping host eventually exits clean
+    v = registry.varz()["metrics"]
+    assert v["ft_incidents_total"] == 1  # suppressed, not per-tick spam
+    assert v["ft_restarts_total"] == 0
+
+
+def test_dead_process_detection_latency(tmp_path):
+    """Kill-victim path under the coordinator: the built-in fault
+    injection SIGKILLs host 0 at t=0.2s and the supervision loop must
+    notice within a handful of poll intervals, not seconds."""
+    registry = MetricRegistry()
+    coord = GangCoordinator(
+        _launcher(tmp_path, n=1),
+        [sys.executable, "-c", "import time; time.sleep(30)"],
+        policy=GangRestart(RestartBudget(0)), registry=registry,
+        ft_dir=tmp_path / "ft", poll_interval=0.01, term_grace_s=0.2,
+        kill_host_after=(0, 0.2))
+    t0 = time.monotonic()
+    rc = coord.run()
+    elapsed = time.monotonic() - t0
+    assert rc == -9  # SIGKILL'd, budget 0 → give up with the real rc
+    # 0.2s until the kill fires + detection + teardown; anything near a
+    # second of detection latency is a polling bug
+    assert elapsed < 3.0
+    assert registry.varz()["metrics"]["supervisor_last_exit_code"] == -9

@@ -1,0 +1,218 @@
+"""Heartbeat writing + fleet classification (tpucfn.ft.heartbeat) —
+every timing input is a fake clock, so the classifier thresholds are
+pinned exactly with zero sleeps."""
+
+import json
+import urllib.request
+
+import pytest
+
+from tpucfn.ft import (
+    HeartbeatMonitor,
+    HeartbeatWriter,
+    HostState,
+    MonitorConfig,
+    heartbeat_path,
+    read_heartbeats,
+)
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def _writer(tmp_path, host_id, clock, **kw):
+    return HeartbeatWriter(tmp_path / "ft", host_id, clock=clock, **kw)
+
+
+def test_writer_appends_schema_lines(tmp_path):
+    clock = Clock()
+    w = _writer(tmp_path, 3, clock, role="trainer", pid=42)
+    w.beat(step=7)
+    clock.advance(1.0)
+    w.beat(step=9)
+    w.stop()
+    lines = [json.loads(s) for s in
+             heartbeat_path(tmp_path / "ft", 3).read_text().splitlines()]
+    assert [r["seq"] for r in lines] == [1, 2]
+    assert lines[0] == {"host_id": 3, "pid": 42, "step": 7, "t": 1000.0,
+                        "seq": 1, "role": "trainer"}
+    assert lines[1]["step"] == 9 and lines[1]["t"] == 1001.0
+
+
+def test_update_step_rides_next_beat_and_beat_after_stop_is_noop(tmp_path):
+    w = _writer(tmp_path, 0, Clock())
+    w.update_step(123)
+    w.beat()
+    w.stop()
+    w.beat()  # post-stop: must not raise or write
+    lines = heartbeat_path(tmp_path / "ft", 0).read_text().splitlines()
+    assert len(lines) == 1
+    assert json.loads(lines[0])["step"] == 123
+
+
+def test_read_heartbeats_latest_per_host_and_torn_tail(tmp_path):
+    clock = Clock()
+    d = tmp_path / "ft"
+    _writer(tmp_path, 0, clock).beat(step=1)
+    w1 = _writer(tmp_path, 1, clock)
+    w1.beat(step=5)
+    clock.advance(2.0)
+    w1.beat(step=6)
+    # a crash mid-append leaves a torn final line; the reader must fall
+    # back to the last complete record
+    with open(heartbeat_path(d, 1), "a") as f:
+        f.write('{"host_id": 1, "t": 99')
+    recs = read_heartbeats(d)
+    assert sorted(recs) == [0, 1]
+    assert recs[1]["step"] == 6 and recs[1]["t"] == 1002.0
+
+
+def test_monitor_live_suspect_dead_progression(tmp_path):
+    clock = Clock()
+    w = _writer(tmp_path, 0, clock)
+    w.beat(step=10)
+    mon = HeartbeatMonitor(tmp_path / "ft", expected_hosts=1,
+                           config=MonitorConfig(interval_s=1.0), clock=clock)
+    assert mon.observe().hosts[0].state is HostState.LIVE
+    clock.advance(3.5)  # > suspect (3x), <= dead (6x)
+    v = mon.observe().hosts[0]
+    assert v.state is HostState.SUSPECT and v.age_s == pytest.approx(3.5)
+    clock.advance(3.0)  # now 6.5s old > dead
+    v = mon.observe().hosts[0]
+    assert v.state is HostState.DEAD
+    assert v.step == 10 and v.pid == w.pid
+    # a fresh beat resurrects the host
+    w.beat(step=11)
+    assert mon.observe().hosts[0].state is HostState.LIVE
+
+
+def test_monitor_missing_host_grace_then_dead(tmp_path):
+    clock = Clock()
+    (tmp_path / "ft").mkdir()
+    mon = HeartbeatMonitor(tmp_path / "ft", expected_hosts=[0, 1],
+                           config=MonitorConfig(interval_s=1.0), clock=clock)
+    _writer(tmp_path, 0, clock).beat()
+    view = mon.observe()
+    by = view.by_host()
+    assert by[0].state is HostState.LIVE
+    assert by[1].state is HostState.SUSPECT  # startup grace (10x interval)
+    assert "grace" in by[1].reason
+    clock.advance(10.5)
+    by = mon.observe().by_host()
+    assert by[1].state is HostState.DEAD and by[1].age_s is None
+    # restart_grace re-arms the window (what the coordinator does after
+    # every relaunch)
+    mon.restart_grace()
+    assert mon.observe().by_host()[1].state is HostState.SUSPECT
+
+
+def test_straggler_needs_fleet_context_and_lag(tmp_path):
+    clock = Clock()
+    w0, w1 = _writer(tmp_path, 0, clock), _writer(tmp_path, 1, clock)
+    cfg = MonitorConfig(interval_s=1.0, straggler_step_lag=50)
+    mon = HeartbeatMonitor(tmp_path / "ft", config=cfg, clock=clock)
+    w0.beat(step=1000)
+    w1.beat(step=960)  # within lag
+    states = [v.state for v in mon.observe().hosts]
+    assert states == [HostState.LIVE, HostState.LIVE]
+    w1.beat(step=940)  # still fresh, but > 50 behind
+    view = mon.observe()
+    assert view.by_host()[1].state is HostState.STRAGGLER
+    assert view.by_host()[0].state is HostState.LIVE
+    assert view.max_step() == 1000
+    # straggling degrades detail, not /healthz status
+    healthy, detail = view.healthy()
+    assert healthy and detail["fleet"]["STRAGGLER"] == 1
+
+
+def test_injected_heartbeat_delay_expires(tmp_path):
+    clock = Clock()
+    w = _writer(tmp_path, 0, clock)
+    w.beat()
+    mon = HeartbeatMonitor(tmp_path / "ft",
+                           config=MonitorConfig(interval_s=1.0), clock=clock)
+    mon.inject_heartbeat_delay(0, extra_age_s=10.0, duration_s=5.0)
+    assert mon.observe().hosts[0].state is HostState.DEAD  # age 0 + 10 > 6
+    clock.advance(5.5)  # injection expired; real age 5.5 -> SUSPECT
+    w.beat()  # fresh beat after the chaos window
+    assert mon.observe().hosts[0].state is HostState.LIVE
+
+
+def test_retired_host_not_judged_and_healthz_stays_green(tmp_path):
+    """A rank that exits cleanly stops beating; without retirement its
+    aging last beat would flip the supervisor /healthz to 503 for the
+    rest of an otherwise healthy run.  The coordinator retires clean
+    exits; a relaunch re-activates the slot."""
+    clock = Clock()
+    for h in (0, 1):
+        w = _writer(tmp_path, h, clock)
+        w.beat(step=10)
+        w.stop()
+    mon = HeartbeatMonitor(tmp_path / "ft", expected_hosts=2,
+                           config=MonitorConfig(interval_s=1.0), clock=clock)
+    clock.advance(7.0)  # both beats are now past dead_s (6x interval)
+    w1 = _writer(tmp_path, 1, clock)
+    w1.beat(step=11)  # host 1 alive; host 0 finished and stopped
+    w1.stop()
+    assert mon.observe().by_host()[0].state is HostState.DEAD
+    assert mon.health()[0] is False
+
+    mon.retire_host(0)
+    view = mon.observe()
+    assert set(view.by_host()) == {1}, "retired host must not be judged"
+    healthy, detail = view.healthy()
+    assert healthy and detail["fleet"]["DEAD"] == 0
+
+    mon.activate_host(0)  # the slot relaunched: judged again
+    assert mon.observe().by_host()[0].state is HostState.DEAD
+
+
+def test_monitor_feeds_obs_healthz(tmp_path):
+    """The monitor's health() IS an obs-server health_fn: /healthz flips
+    200 → 503 when a host goes DEAD (ISSUE 4 tentpole wiring)."""
+    from tpucfn.obs import MetricRegistry, ObsServer
+
+    clock = Clock()
+    w = _writer(tmp_path, 0, clock)
+    w.beat(step=4)
+    mon = HeartbeatMonitor(tmp_path / "ft", expected_hosts=1,
+                           config=MonitorConfig(interval_s=1.0), clock=clock)
+    srv = ObsServer(MetricRegistry(), port=0, host="127.0.0.1",
+                    role="supervisor", health_fn=mon.health)
+    try:
+        body = json.load(urllib.request.urlopen(srv.url("/healthz"),
+                                                timeout=5))
+        assert body["status"] == "ok" and body["fleet"]["LIVE"] == 1
+        clock.advance(7.0)  # past dead threshold
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.url("/healthz"), timeout=5)
+        assert ei.value.code == 503
+        assert json.load(ei.value)["fleet"]["DEAD"] == 1
+    finally:
+        srv.close()
+
+
+def test_writer_daemon_thread_beats_without_loop_calls(tmp_path):
+    """start() keeps liveness flowing while the 'train loop' is stuck —
+    the one wall-clock test here, bounded at tenths of a second."""
+    w = HeartbeatWriter(tmp_path / "ft", 0, interval_s=0.02)
+    with w:
+        import time
+
+        deadline = time.monotonic() + 2.0
+        path = heartbeat_path(tmp_path / "ft", 0)
+        while time.monotonic() < deadline:
+            recs = path.read_text().splitlines()
+            if len(recs) >= 3:
+                break
+            time.sleep(0.01)
+    assert len(path.read_text().splitlines()) >= 3

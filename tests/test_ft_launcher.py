@@ -1,0 +1,107 @@
+"""Launcher paths under the ft plane (ISSUE 4 satellite): SIGTERM→SIGKILL
+escalation in stop_all, launch_host env identity for solo restarts, and
+the ft env fan-out."""
+
+import signal
+import sys
+import time
+
+import pytest
+
+from tpucfn.bootstrap import EnvContract
+from tpucfn.launch import Launcher, LocalTransport
+
+
+def _contract(tmp_path, n=2) -> EnvContract:
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("".join("127.0.0.1:0\n" for _ in range(n)))
+    return EnvContract(
+        workers_path=str(hostfile), workers_count=n, worker_chip_count=1,
+        coordinator="127.0.0.1:1234", host_id=0, storage=str(tmp_path),
+        generation=1)
+
+
+def test_stop_all_graceful_sigterm(tmp_path):
+    """A cooperative process dies on SIGTERM inside the grace window —
+    no escalation."""
+    launcher = Launcher(_contract(tmp_path, n=2), LocalTransport())
+    procs = launcher.launch(
+        [sys.executable, "-c", "import time; time.sleep(30)"])
+    time.sleep(0.3)  # let the interpreters install default handlers
+    escalated = launcher.stop_all(procs, grace_s=5.0, poll_interval=0.02)
+    assert escalated == 0
+    assert [p.poll() for p in procs] == [-signal.SIGTERM, -signal.SIGTERM]
+
+
+def test_stop_all_escalates_to_sigkill(tmp_path):
+    """A process that ignores SIGTERM (wedged in a collective, or
+    SIGSTOP'd by chaos) is SIGKILLed after the grace window."""
+    launcher = Launcher(_contract(tmp_path, n=1), LocalTransport())
+    ready = tmp_path / "ready"
+    stubborn = (
+        "import pathlib, signal, time\n"
+        "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+        f"pathlib.Path(r'{ready}').write_text('x')\n"
+        "time.sleep(60)\n")
+    procs = launcher.launch([sys.executable, "-c", stubborn])
+    deadline = time.monotonic() + 10
+    while not ready.exists():  # handler must be installed before TERM
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    t0 = time.monotonic()
+    escalated = launcher.stop_all(procs, grace_s=0.3, poll_interval=0.02)
+    assert escalated == 1
+    assert procs[0].poll() == -signal.SIGKILL
+    assert time.monotonic() - t0 < 5.0  # grace + kill, not the full sleep
+
+
+def test_stop_all_reaps_already_dead(tmp_path):
+    launcher = Launcher(_contract(tmp_path, n=1), LocalTransport())
+    procs = launcher.launch([sys.executable, "-c", "pass"])
+    deadline = time.monotonic() + 10
+    while procs[0].poll() is None:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    assert launcher.stop_all(procs, grace_s=0.1) == 0
+    assert procs[0].returncode == 0
+
+
+def test_launch_host_reuses_exact_host_env(tmp_path):
+    """The solo-restart contract: a relaunched host gets byte-identical
+    env (host_id, obs port, ft dir/interval) to the rank it replaces."""
+    launcher = Launcher(_contract(tmp_path, n=3), LocalTransport(),
+                        obs_base_port=9300, ft_dir=str(tmp_path / "ft"),
+                        ft_heartbeat_s=0.25)
+    out = tmp_path / "envs"
+    out.mkdir()
+    code = (
+        "import os, pathlib, time\n"
+        "keys = ['TPUCFN_HOST_ID', 'TPUCFN_OBS_PORT', 'TPUCFN_FT_DIR',"
+        " 'TPUCFN_FT_HEARTBEAT_S']\n"
+        f"d = pathlib.Path(r'{out}')\n"
+        "h = os.environ['TPUCFN_HOST_ID']\n"
+        "with open(d / f'env-{h}.log', 'a') as f:\n"
+        "    f.write(','.join(os.environ[k] for k in keys) + '\\n')\n")
+    procs = launcher.launch([sys.executable, "-c", code])
+    assert launcher.wait(procs) == 0
+    solo = launcher.launch_host([sys.executable, "-c", code], 1)
+    assert solo.wait(timeout=30) == 0
+    lines1 = (out / "env-1.log").read_text().splitlines()
+    assert len(lines1) == 2 and lines1[0] == lines1[1]
+    assert lines1[0] == f"1,9302,{tmp_path / 'ft'},0.25"
+    # the other hosts ran exactly once, with their own ports
+    assert (out / "env-0.log").read_text().splitlines() == [
+        f"0,9301,{tmp_path / 'ft'},0.25"]
+
+
+def test_launch_host_validates_range(tmp_path):
+    launcher = Launcher(_contract(tmp_path, n=2), LocalTransport())
+    with pytest.raises(ValueError):
+        launcher.launch_host([sys.executable, "-c", "pass"], 5)
+
+
+def test_host_env_without_ft_has_no_ft_vars(tmp_path):
+    launcher = Launcher(_contract(tmp_path), LocalTransport())
+    env = launcher.host_env(0)
+    assert "TPUCFN_FT_DIR" not in env
+    assert "TPUCFN_FT_HEARTBEAT_S" not in env

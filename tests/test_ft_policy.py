@@ -1,0 +1,109 @@
+"""Recovery policy semantics (tpucfn.ft.policy): budget accounting,
+deterministic backoff+jitter, the failure-class decision table, and the
+gang-vs-solo restart shapes."""
+
+import random
+
+import pytest
+
+from tpucfn.ft import (
+    Action,
+    Failure,
+    FailureKind,
+    GangRestart,
+    RestartBudget,
+    SoloRestart,
+    policy_from_name,
+)
+
+
+def _crash(host, rc=1):
+    return Failure(host, FailureKind.CRASH, rc=rc)
+
+
+def test_budget_backoff_is_exponential_capped_and_seeded(tmp_path=None):
+    b = RestartBudget(10, backoff_s=1.0, multiplier=2.0, max_backoff_s=5.0,
+                      jitter=0.5, rng=random.Random(7))
+    ref = random.Random(7)
+    seen = []
+    for k in range(5):
+        base = min(1.0 * 2.0 ** k, 5.0)
+        expect = base * (1.0 + ref.uniform(-0.5, 0.5))
+        got = b.next_delay()
+        assert got == pytest.approx(expect), k
+        seen.append(got)
+        assert b.consume()
+    assert seen[4] <= 5.0 * 1.5  # cap applies before jitter
+    # same seed → identical delay stream (the chaos determinism contract)
+    b2 = RestartBudget(10, backoff_s=1.0, multiplier=2.0, max_backoff_s=5.0,
+                      jitter=0.5, rng=random.Random(7))
+    replay = []
+    for _ in range(5):
+        replay.append(b2.next_delay())
+        b2.consume()
+    assert replay == seen
+
+
+def test_budget_zero_backoff_and_exhaustion():
+    b = RestartBudget(2)
+    assert b.next_delay() == 0.0
+    assert b.consume() and b.consume()
+    assert not b.consume()
+    assert b.remaining == 0
+
+
+def test_budget_validation():
+    with pytest.raises(ValueError):
+        RestartBudget(-1)
+    with pytest.raises(ValueError):
+        RestartBudget(1, jitter=1.5)
+
+
+def test_gang_policy_restarts_whole_gang_for_crash():
+    p = GangRestart(RestartBudget(1))
+    d = p.decide([_crash(2, rc=137)])
+    assert d.action is Action.GANG_RESTART
+    assert d.hosts == ()  # whole gang
+    assert p.budget.used == 1
+
+
+def test_clean_exit_and_straggler_burn_no_budget():
+    p = GangRestart(RestartBudget(1))
+    d = p.decide([Failure(0, FailureKind.CLEAN_EXIT, rc=0),
+                  Failure(1, FailureKind.STRAGGLER, step=5)])
+    assert d.action is Action.NONE
+    assert p.budget.used == 0  # the exit-cause-accounting satellite
+    # the budget slot is still there for a real failure
+    assert p.decide([_crash(1)]).action is Action.GANG_RESTART
+
+
+def test_budget_exhaustion_gives_up_with_reason():
+    p = GangRestart(RestartBudget(1))
+    assert p.decide([_crash(0)]).action is Action.GANG_RESTART
+    d = p.decide([_crash(0)])
+    assert d.action is Action.GIVE_UP
+    assert "budget exhausted" in d.reason
+
+
+def test_solo_policy_singles_vs_correlated_failures():
+    p = SoloRestart(RestartBudget(5))
+    d = p.decide([Failure(1, FailureKind.HANG)])
+    assert d.action is Action.SOLO_RESTART and d.hosts == (1,)
+    # two hosts at once: correlated death → escalate to gang restart
+    d = p.decide([_crash(0), Failure(2, FailureKind.HANG)])
+    assert d.action is Action.GANG_RESTART
+    assert p.budget.used == 2
+
+
+def test_decision_table_override_makes_straggler_actionable():
+    p = SoloRestart(RestartBudget(3),
+                    table={FailureKind.STRAGGLER: Action.SOLO_RESTART})
+    d = p.decide([Failure(3, FailureKind.STRAGGLER, step=10)])
+    assert d.action is Action.SOLO_RESTART and d.hosts == (3,)
+
+
+def test_policy_from_name():
+    assert isinstance(policy_from_name("gang", RestartBudget(0)), GangRestart)
+    assert isinstance(policy_from_name("solo", RestartBudget(0)), SoloRestart)
+    with pytest.raises(ValueError):
+        policy_from_name("yolo", RestartBudget(0))

@@ -1,1 +1,6 @@
-from tpucfn.ckpt.manager import CheckpointManager  # noqa: F401
+from tpucfn.ckpt.manager import (  # noqa: F401
+    CheckpointManager,
+    rewrap_prng_keys,
+    split_prng_keys,
+    split_prng_keys_abstract,
+)

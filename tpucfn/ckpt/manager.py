@@ -12,10 +12,88 @@ item 2).
 
 from __future__ import annotations
 
+import shutil
+import time
 from pathlib import Path
 from typing import Any
 
+import jax
+import jax.numpy as jnp
 import orbax.checkpoint as ocp
+
+
+def _is_key(x: Any) -> bool:
+    dt = getattr(x, "dtype", None)
+    return dt is not None and jnp.issubdtype(dt, jax.dtypes.prng_key)
+
+
+def _key_impl_name(abstract_leaf: Any) -> str | None:
+    """PRNG impl name off the key dtype (``key<fry>`` → ``threefry2x32``)
+    so restore rewraps with the impl that saved; None falls back to
+    jax's default impl in wrap_key_data."""
+    impl = getattr(getattr(abstract_leaf, "dtype", None), "_impl", None)
+    return getattr(impl, "name", None)
+
+
+def split_prng_keys(state: Any) -> Any:
+    """Typed PRNG keys → their ``uint32`` key data.  Orbax cannot
+    serialize extended key dtypes (``jax.random.key`` arrays raise
+    "PRNGKey dtype cannot be converted to a NumPy array"), so every save
+    goes through this and every restore through :func:`rewrap_prng_keys`
+    — required for the restart supervisor's resume-from-latest to work
+    on states that carry an rng (ISSUE 4 satellite)."""
+    return jax.tree.map(
+        lambda x: jax.random.key_data(x) if _is_key(x) else x, state)
+
+
+def split_prng_keys_abstract(abstract_state: Any) -> Any:
+    """The abstract-state counterpart of :func:`split_prng_keys`: key
+    leaves become the ShapeDtypeStruct of their key data (trailing
+    key-size dim, uint32), keeping the original leaf's sharding — a
+    replicated key stays replicated, and a PartitionSpec shorter than
+    the rank leaves the new trailing dim unsharded."""
+    def f(a):
+        if not _is_key(a):
+            return a
+        data = jax.eval_shape(jax.random.key_data,
+                              jax.ShapeDtypeStruct(a.shape, a.dtype))
+        return jax.ShapeDtypeStruct(data.shape, data.dtype,
+                                    sharding=getattr(a, "sharding", None))
+    return jax.tree.map(f, abstract_state)
+
+
+def rewrap_prng_keys(restored: Any, abstract_state: Any) -> Any:
+    """Re-typed keys after restore: wherever ``abstract_state`` carries
+    a key dtype, wrap the restored ``uint32`` data back into a typed key
+    of the same impl."""
+    def f(a, r):
+        if _is_key(a):
+            return jax.random.wrap_key_data(r, impl=_key_impl_name(a))
+        return r
+    return jax.tree.map(f, abstract_state, restored)
+
+
+def _rematerialize(restored: Any) -> Any:
+    """Copy every restored jax leaf into a fresh XLA-owned buffer.
+
+    Orbax/tensorstore can hand back arrays whose backing memory XLA does
+    not own; the trainer's ``donate_argnums`` then reuses/frees that
+    memory through the wrong allocator on the first step after resume —
+    observed as glibc "corrupted double-linked list" aborts in the
+    relaunch-and-resume drill on CPU.  One jitted copy program per
+    restore (no donation declared, so outputs are guaranteed distinct
+    buffers; elementwise copy keeps each input's sharding).  Non-jax
+    leaves (numpy-template restores) pass through untouched.
+    """
+    leaves, treedef = jax.tree.flatten(restored)
+    idx = [i for i, x in enumerate(leaves) if isinstance(x, jax.Array)]
+    if not idx:
+        return restored
+    copied = jax.jit(lambda xs: [jnp.copy(x) for x in xs])(
+        [leaves[i] for i in idx])
+    for i, c in zip(idx, copied):
+        leaves[i] = c
+    return jax.tree.unflatten(treedef, leaves)
 
 
 class CheckpointManager:
@@ -36,23 +114,61 @@ class CheckpointManager:
             max_to_keep=max_to_keep,
             save_interval_steps=save_interval_steps,
             enable_async_checkpointing=async_save,
+            # NOT orbax's cleanup_tmp_directories: that sweep runs
+            # unconditionally at init, and in a gang every rank opens a
+            # manager on the SHARED directory — a slow-booting rank then
+            # rmtrees a peer's in-flight save tmp dir and crashes on the
+            # races (observed: FileNotFoundError on a tensorstore
+            # .__lock file mid-rmtree).  _sweep_stale_tmp below removes
+            # only tmp dirs nothing is actively writing.
+            cleanup_tmp_directories=False,
         )
+        self._sweep_stale_tmp(self.directory)
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
+
+    @staticmethod
+    def _sweep_stale_tmp(directory: Path, *, stale_age_s: float = 30.0) -> None:
+        """Best-effort removal of abandoned ``*.orbax-checkpoint-tmp-*``
+        dirs (a SIGKILLed/preempted rank's half-written save) so they
+        don't accumulate across gang restarts.  A tmp dir is only
+        abandoned if NOTHING under it was modified for ``stale_age_s`` —
+        an in-flight save keeps touching its files, so a peer rank's
+        live write is never swept; every OSError is swallowed because
+        concurrent sweepers race each other by construction."""
+        now = time.time()
+        try:
+            tmp_dirs = [p for p in directory.iterdir()
+                        if p.is_dir() and ".orbax-checkpoint-tmp" in p.name]
+        except OSError:
+            return
+        for p in tmp_dirs:
+            try:
+                newest = max((f.stat().st_mtime
+                              for f in [p, *p.rglob("*")]), default=0.0)
+            except OSError:
+                continue  # a peer is mutating it right now — not stale
+            if now - newest >= stale_age_s:
+                shutil.rmtree(p, ignore_errors=True)
 
     def save(self, step: int, state: Any, *, force: bool = False) -> bool:
         if step in self._mgr.all_steps():
             return False  # idempotent: final force-save may race an interval save
-        return self._mgr.save(step, args=ocp.args.StandardSave(state), force=force)
+        return self._mgr.save(step, args=ocp.args.StandardSave(
+            split_prng_keys(state)), force=force)
 
     def restore(self, abstract_state: Any, step: int | None = None) -> Any:
         """Restore into the shardings carried by ``abstract_state``
         (from :meth:`tpucfn.train.Trainer.abstract_state`) — this is what
         makes cross-topology resume work: the saved layout is re-sliced to
-        whatever mesh the abstract state targets."""
+        whatever mesh the abstract state targets.  Typed PRNG keys in the
+        abstract state are restored as key data and rewrapped (the save
+        side split them — see :func:`split_prng_keys`)."""
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint found under {self.directory}")
-        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract_state))
+        restored = self._mgr.restore(step, args=ocp.args.StandardRestore(
+            split_prng_keys_abstract(abstract_state)))
+        return rewrap_prng_keys(_rematerialize(restored), abstract_state)
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()

@@ -116,8 +116,12 @@ def cmd_launch(args) -> int:
         return 1
     contract = converge(rec, _run_dir(args, args.name))
     transport = SSHTransport() if args.transport == "ssh" else LocalTransport()
+    ft_dir = _run_dir(args, args.name) / "ft" if args.ft else None
     launcher = Launcher(contract, transport,
-                        obs_base_port=args.obs_port or None)
+                        obs_base_port=args.obs_port or None,
+                        ft_dir=str(ft_dir) if ft_dir else None,
+                        ft_heartbeat_s=(args.ft_heartbeat_interval
+                                        if args.ft else None))
     argv = list(args.cmd)
     if argv and argv[0] == "--":
         argv = argv[1:]
@@ -141,23 +145,59 @@ def cmd_launch(args) -> int:
                   f"(cluster has {len(contract.hosts())} hosts)", file=sys.stderr)
             return 2
     obs_srv, registry = None, None
+    monitor = None
+    # The launched gang is hosts()[:workers_count] (Launcher.launch's
+    # precedence rule) — what the monitor judges and whose ports serve.
+    n_launched = len(contract.hosts()[:contract.workers_count])
+    if args.ft:
+        # The fault-tolerance plane (ISSUE 4): heartbeat monitor over the
+        # dir every rank writes into (Launcher fans out TPUCFN_FT_DIR).
+        import random
+
+        from tpucfn.ft import (GangCoordinator, HeartbeatMonitor,
+                               MonitorConfig, RestartBudget,
+                               policy_from_name)
+
+        # Startup grace must cover runtime boot (jax import + data
+        # staging + first compile can be tens of seconds), not just a
+        # few heartbeat intervals — a booting gang that has not beaten
+        # yet is not hung, and phantom hang incidents burn the restart
+        # budget.  Crash detection (process exit) is unaffected by it.
+        monitor = HeartbeatMonitor(
+            ft_dir, expected_hosts=n_launched,
+            config=MonitorConfig(
+                interval_s=args.ft_heartbeat_interval,
+                startup_grace_s=args.ft_startup_grace))
     if args.obs_port:
         # The supervisor is a fleet role too: it owns the base port, the
-        # per-host ranks get base+1+host_id (launcher.host_env).
+        # per-host ranks get base+1+host_id (launcher.host_env).  With
+        # --ft its /healthz answers from the heartbeat monitor's fleet
+        # view — 503 the moment any host goes DEAD.
         from tpucfn.obs import MetricRegistry, start_obs_server
 
         registry = MetricRegistry(labels={"role": "supervisor"})
-        obs_srv = start_obs_server(registry, port=args.obs_port,
-                                   role="supervisor")
-        # The launched gang is hosts()[:workers_count] (Launcher.launch's
-        # precedence rule) — only those ports will actually serve.
-        n_launched = len(contract.hosts()[:contract.workers_count])
+        obs_srv = start_obs_server(
+            registry, port=args.obs_port, role="supervisor",
+            health_fn=monitor.health if monitor is not None else None)
         print(f"supervisor obs endpoint: {obs_srv.url()} "
               f"(hosts at ports {args.obs_port + 1}..."
               f"{args.obs_port + n_launched})", file=sys.stderr)
     try:
-        rc = run_with_restarts(launcher, argv, max_restarts=args.restarts,
-                               kill_host_after=inject, registry=registry)
+        if args.ft:
+            budget = RestartBudget(
+                args.ft_restart_budget if args.ft_restart_budget is not None
+                else args.restarts,
+                backoff_s=args.ft_backoff, rng=random.Random(args.ft_seed))
+            coordinator = GangCoordinator(
+                launcher, argv,
+                policy=policy_from_name(args.ft_policy, budget),
+                monitor=monitor, ft_dir=ft_dir, registry=registry,
+                kill_host_after=inject,
+                ckpt_dir=_run_dir(args, args.name) / "ckpt")
+            rc = coordinator.run()
+        else:
+            rc = run_with_restarts(launcher, argv, max_restarts=args.restarts,
+                                   kill_host_after=inject, registry=registry)
     finally:
         if obs_srv is not None:
             obs_srv.close()
@@ -414,6 +454,99 @@ def cmd_obs(args) -> int:
     return 0
 
 
+def cmd_ft_status(args) -> int:
+    """Render the fault-tolerance plane's fleet view: per-host heartbeat
+    verdicts (LIVE/STRAGGLER/SUSPECT/DEAD), the supervisor's ft_*
+    metrics (restarts, failures detected, MTTR), and the recent
+    detect→decide→act→recovered event tail — the read side of
+    ``tpucfn launch --ft`` (ISSUE 4)."""
+    import json as _json
+
+    from tpucfn.ft import HeartbeatMonitor, MonitorConfig
+    from tpucfn.obs.aggregate import render_table
+
+    if not args.dir and not args.name:
+        print("error: ft status needs --name (cluster) or --dir "
+              "(heartbeat dir)", file=sys.stderr)
+        return 2
+    ft_dir = Path(args.dir) if args.dir else _run_dir(args, args.name) / "ft"
+    if not ft_dir.is_dir():
+        print(f"error: no ft dir at {ft_dir} (launch with --ft first, "
+              "or pass --dir)", file=sys.stderr)
+        return 1
+
+    sup: dict = {}
+    sup_path = ft_dir / "supervisor.json"
+    if sup_path.is_file():
+        try:
+            sup = _json.loads(sup_path.read_text())
+        except (OSError, _json.JSONDecodeError):
+            sup = {}
+    interval = args.heartbeat_interval
+    if interval is None:
+        interval = sup.get("heartbeat_interval_s") or 1.0
+    monitor = HeartbeatMonitor(
+        ft_dir, expected_hosts=sup.get("gang_hosts"),
+        config=MonitorConfig(interval_s=float(interval)))
+    view = monitor.observe()
+    healthy, health_detail = view.healthy()
+
+    events: list[dict] = []
+    ev_path = ft_dir / "events.jsonl"
+    if ev_path.is_file():
+        for line in ev_path.read_text().splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(_json.loads(line))
+            except _json.JSONDecodeError:
+                continue  # torn tail while the supervisor appends
+
+    rows = [{"host": v.host_id, "state": v.state.value,
+             "age_s": v.age_s, "step": v.step, "pid": v.pid,
+             "reason": v.reason} for v in view.hosts]
+    report = {
+        "ft_dir": str(ft_dir),
+        "healthy": healthy,
+        "fleet": health_detail["fleet"],
+        "max_step": health_detail["max_step"],
+        "hosts": rows,
+        "policy": sup.get("policy"),
+        "budget": sup.get("budget"),
+        "metrics": sup.get("metrics", {}),
+        "events": events[-args.events:] if args.events else events,
+    }
+    if args.json:
+        print(_json.dumps(report))
+        return 0
+    print(f"# ft fleet view  {ft_dir}  "
+          f"{'HEALTHY' if healthy else 'UNHEALTHY'}")
+    if rows:
+        print(render_table(rows, ["host", "state", "age_s", "step", "pid",
+                                  "reason"], float_fmt="{:.2f}"))
+    else:
+        print("no heartbeats yet")
+    m = report["metrics"]
+    if m:
+        mttr = m.get("ft_mttr_seconds") or {}
+        print(f"\nrestarts={m.get('ft_restarts_total', 0)} "
+              f"(gang={m.get('ft_gang_restarts_total', 0)} "
+              f"solo={m.get('ft_solo_restarts_total', 0)}) "
+              f"failures_detected={m.get('ft_failures_detected_total', 0)} "
+              f"mttr_p50={(mttr.get('p50') if isinstance(mttr, dict) else None)}")
+        if report["budget"]:
+            b = report["budget"]
+            print(f"policy={report['policy']} budget "
+                  f"{b.get('used', 0)}/{b.get('max_restarts', 0)} used")
+    if report["events"]:
+        print("\n== recent events ==")
+        for e in report["events"]:
+            extra = {k: v for k, v in e.items() if k not in ("ts", "kind")}
+            print(f"  {e.get('ts', 0):.3f} {e.get('kind', '?'):12s} {extra}")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tpucfn", description=__doc__)
     p.add_argument("--state-dir", default=os.environ.get("TPUCFN_STATE_DIR", "~/.tpucfn"))
@@ -467,8 +600,57 @@ def build_parser() -> argparse.ArgumentParser:
                    help="observability plane: supervisor /metrics on BASE, "
                         "each host's process on BASE+1+host_id via "
                         "TPUCFN_OBS_PORT (0 = off)")
+    l.add_argument("--ft", action="store_true",
+                   help="fault-tolerance plane: per-host heartbeats "
+                        "(TPUCFN_FT_DIR fan-out), failure detection, and "
+                        "gang-coordinated recovery via tpucfn.ft")
+    l.add_argument("--ft-heartbeat-interval", type=float, default=1.0,
+                   metavar="SECONDS",
+                   help="heartbeat write interval; detection thresholds "
+                        "scale off it (suspect 3x, dead 6x)")
+    l.add_argument("--ft-restart-budget", type=int, default=None,
+                   metavar="N",
+                   help="recoveries allowed before giving up "
+                        "(default: --restarts)")
+    l.add_argument("--ft-startup-grace", type=float, default=120.0,
+                   metavar="SECONDS",
+                   help="no-heartbeat-yet window after every (re)launch "
+                        "before a silent host counts as hung — must cover "
+                        "runtime boot (jax import + first compile); crash "
+                        "detection is unaffected")
+    l.add_argument("--ft-policy", choices=["gang", "solo"], default="gang",
+                   help="recovery shape: gang = kill all + relaunch all + "
+                        "resume from latest checkpoint (the SPMD-safe "
+                        "default); solo = restart only the dead host into "
+                        "the same gang")
+    l.add_argument("--ft-backoff", type=float, default=1.0, metavar="SECONDS",
+                   help="base restart backoff; doubles per restart with "
+                        "seeded jitter (--ft-seed)")
+    l.add_argument("--ft-seed", type=int, default=0,
+                   help="seed for backoff jitter (determinism: same seed "
+                        "replays the same delays)")
     l.add_argument("cmd", nargs=argparse.REMAINDER)
     l.set_defaults(fn=cmd_launch)
+
+    ft = sub.add_parser(
+        "ft", help="fault-tolerance plane (heartbeats, recovery, chaos)")
+    ftsub = ft.add_subparsers(dest="ft_command", required=True)
+    fs = ftsub.add_parser(
+        "status",
+        help="render the fleet's heartbeat verdicts, recovery metrics "
+             "(restarts, MTTR), and recent incident events")
+    fs.add_argument("--name", help="cluster name (heartbeats under its "
+                                   "state dir ft/)")
+    fs.add_argument("--dir", help="explicit heartbeat dir (overrides --name)")
+    fs.add_argument("--heartbeat-interval", type=float, default=None,
+                    metavar="SECONDS",
+                    help="classification interval override (default: the "
+                         "supervisor snapshot's value, else 1.0)")
+    fs.add_argument("--events", type=int, default=10,
+                    help="incident-event tail length (0 = all)")
+    fs.add_argument("--json", action="store_true",
+                    help="emit the full fleet report as one JSON object")
+    fs.set_defaults(fn=cmd_ft_status)
 
     k = sub.add_parser("kill-host", help="fault injection: mark a host dead")
     k.add_argument("--name", required=True)

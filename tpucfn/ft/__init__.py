@@ -1,0 +1,39 @@
+"""tpucfn.ft — the fleet fault-tolerance plane (ISSUE 4).
+
+Heartbeat failure detection (``heartbeat``), recovery policies with
+budgets and backoff (``policy``), the gang coordinator that executes
+them over the launcher's process table (``coordinator``), and the
+deterministic chaos harness that proves the whole loop works
+(``chaos``).
+"""
+
+from tpucfn.ft.chaos import (  # noqa: F401
+    ChaosEngine,
+    ChaosEvent,
+    ChaosSpec,
+    ChaosTarget,
+    ControlPlaneChaosTarget,
+    corrupt_latest_checkpoint,
+)
+from tpucfn.ft.coordinator import GangCoordinator  # noqa: F401
+from tpucfn.ft.heartbeat import (  # noqa: F401
+    FleetView,
+    HeartbeatMonitor,
+    HeartbeatWriter,
+    HostState,
+    HostVerdict,
+    MonitorConfig,
+    heartbeat_path,
+    read_heartbeats,
+)
+from tpucfn.ft.policy import (  # noqa: F401
+    Action,
+    Decision,
+    Failure,
+    FailureKind,
+    GangRestart,
+    RecoveryPolicy,
+    RestartBudget,
+    SoloRestart,
+    policy_from_name,
+)

@@ -1,0 +1,219 @@
+"""Deterministic fault injection — the chaos harness the recovery plane
+is tested with.
+
+A :class:`ChaosSpec` is a declarative schedule ("at second T / at fleet
+step N: kill host k, hang host k for D seconds, delay heartbeats, corrupt
+the latest checkpoint").  A :class:`ChaosEngine` replays it against a
+:class:`ChaosTarget`:
+
+* the gang coordinator's real subprocesses (SIGKILL / SIGSTOP+SIGCONT)
+  — ft/coordinator.py implements the target over its process table;
+* :class:`~tpucfn.provision.control_plane.FakeControlPlane` via
+  :class:`ControlPlaneChaosTarget` (``kill_host`` flips the host record
+  unhealthy, exercising the provisioning-side monitor/heal path).
+
+Every random choice (unpinned victim host, corruption byte offsets)
+comes from a ``random.Random`` seeded by the spec — no wall-clock
+randomness anywhere, so a chaos run replays bit-for-bit (ISSUE 4
+tentpole).  Time itself is injectable: the engine never reads a clock,
+it is *told* the elapsed time and fleet step on each ``tick``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+import re
+from pathlib import Path
+from typing import Any
+
+ACTIONS = ("kill", "hang", "delay_heartbeats", "corrupt_ckpt")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosEvent:
+    """One scheduled fault.  Fires when EITHER trigger is reached:
+    ``at_s`` (seconds since the engine's first tick) or ``at_step``
+    (fleet max step).  ``host=None`` lets the seeded RNG pick a victim
+    at fire time."""
+
+    action: str
+    at_s: float | None = None
+    at_step: int | None = None
+    host: int | None = None
+    duration_s: float = 0.0  # hang / delay_heartbeats length
+
+    def __post_init__(self):
+        if self.action not in ACTIONS:
+            raise ValueError(
+                f"unknown chaos action {self.action!r}; one of {ACTIONS}")
+        if self.at_s is None and self.at_step is None:
+            raise ValueError("chaos event needs at_s and/or at_step")
+
+    def to_json(self) -> dict:
+        return {k: v for k, v in dataclasses.asdict(self).items()
+                if v is not None and not (k == "duration_s" and v == 0.0)}
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosSpec:
+    events: tuple[ChaosEvent, ...]
+    seed: int = 0
+
+    @classmethod
+    def from_json(cls, obj: str | dict) -> "ChaosSpec":
+        if isinstance(obj, str):
+            obj = json.loads(obj)
+        return cls(events=tuple(ChaosEvent(**e) for e in obj.get("events", ())),
+                   seed=int(obj.get("seed", 0)))
+
+    def to_json(self) -> dict:
+        return {"seed": self.seed,
+                "events": [e.to_json() for e in self.events]}
+
+
+class ChaosTarget:
+    """What the engine acts on.  Implementations: the coordinator's
+    subprocess table, ControlPlaneChaosTarget, and test recorders."""
+
+    def num_hosts(self) -> int:
+        raise NotImplementedError
+
+    def kill_host(self, host_id: int) -> None:
+        raise NotImplementedError
+
+    def hang_host(self, host_id: int) -> None:
+        """Freeze the host (SIGSTOP for subprocesses) — heartbeats stop
+        but the process stays alive, the HANG failure class."""
+        raise NotImplementedError
+
+    def resume_host(self, host_id: int) -> None:
+        """Undo hang_host (SIGCONT) once the event's duration elapsed."""
+        raise NotImplementedError
+
+    def delay_heartbeats(self, host_id: int, duration_s: float) -> None:
+        """Make the monitor see this host's heartbeats as stale without
+        touching the process (detector-side fault)."""
+        raise NotImplementedError
+
+    def corrupt_latest_checkpoint(self, rng: random.Random) -> None:
+        raise NotImplementedError
+
+
+class ControlPlaneChaosTarget(ChaosTarget):
+    """Replays kill events against the provisioning fake — the chaos
+    path for the ``tpucfn heal`` / Provisioner.ensure_healthy state
+    machine rather than live processes."""
+
+    def __init__(self, control_plane, cluster_name: str):
+        self.cp = control_plane
+        self.name = cluster_name
+
+    def num_hosts(self) -> int:
+        return len(self.cp.describe(self.name).hosts)
+
+    def kill_host(self, host_id: int) -> None:
+        self.cp.kill_host(self.name, host_id)
+
+
+@dataclasses.dataclass
+class FiredEvent:
+    event: ChaosEvent
+    host: int | None
+    elapsed_s: float
+    fleet_step: int | None
+
+
+class ChaosEngine:
+    """Replays one spec against one target.
+
+    Call :meth:`tick` from the supervision loop with the elapsed wall
+    seconds (since the run started) and the current fleet max step; the
+    engine fires every due, not-yet-fired event in schedule order and
+    schedules hang resumes.  Events and their resolved victims land in
+    :attr:`fired` — the audit trail tests and benches assert on.
+    """
+
+    def __init__(self, spec: ChaosSpec, target: ChaosTarget, *,
+                 rng: random.Random | None = None):
+        self.spec = spec
+        self.target = target
+        self.rng = rng if rng is not None else random.Random(spec.seed)
+        self._pending = list(spec.events)
+        self._resumes: list[tuple[float, int]] = []  # (due_elapsed_s, host)
+        self.fired: list[FiredEvent] = []
+
+    def done(self) -> bool:
+        return not self._pending and not self._resumes
+
+    def _due(self, ev: ChaosEvent, elapsed_s: float,
+             fleet_step: int | None) -> bool:
+        if ev.at_s is not None and elapsed_s >= ev.at_s:
+            return True
+        return (ev.at_step is not None and fleet_step is not None
+                and fleet_step >= ev.at_step)
+
+    def tick(self, elapsed_s: float, fleet_step: int | None = None) -> list[FiredEvent]:
+        fired_now: list[FiredEvent] = []
+        still = []
+        for ev in self._pending:
+            if not self._due(ev, elapsed_s, fleet_step):
+                still.append(ev)
+                continue
+            host = ev.host
+            if host is None and ev.action != "corrupt_ckpt":
+                host = self.rng.randrange(self.target.num_hosts())
+            rec = FiredEvent(ev, host, elapsed_s, fleet_step)
+            if ev.action == "kill":
+                self.target.kill_host(host)
+            elif ev.action == "hang":
+                self.target.hang_host(host)
+                if ev.duration_s > 0:
+                    self._resumes.append((elapsed_s + ev.duration_s, host))
+            elif ev.action == "delay_heartbeats":
+                self.target.delay_heartbeats(host, ev.duration_s)
+            elif ev.action == "corrupt_ckpt":
+                self.target.corrupt_latest_checkpoint(self.rng)
+            self.fired.append(rec)
+            fired_now.append(rec)
+        self._pending = still
+        ripe = [r for r in self._resumes if elapsed_s >= r[0]]
+        if ripe:
+            self._resumes = [r for r in self._resumes if elapsed_s < r[0]]
+            for _, host in ripe:
+                self.target.resume_host(host)
+        return fired_now
+
+
+_STEP_DIR = re.compile(r"^\d+$")
+
+
+def corrupt_latest_checkpoint(ckpt_dir: str | Path, rng: random.Random,
+                              *, garbage_bytes: int = 256) -> Path | None:
+    """Overwrite the head of the largest file under the latest step's
+    checkpoint directory with RNG garbage (and truncate there), so a
+    subsequent restore fails loudly instead of resuming from silently
+    wrong state.  Returns the corrupted path, or None when there is no
+    checkpoint to corrupt.
+
+    Works on the Orbax layout (``<dir>/<step>/...``) but only assumes
+    "numeric step subdirectories containing files".
+    """
+    d = Path(ckpt_dir)
+    if not d.is_dir():
+        return None
+    steps = sorted((int(p.name), p) for p in d.iterdir()
+                   if p.is_dir() and _STEP_DIR.match(p.name))
+    if not steps:
+        return None
+    _, latest = steps[-1]
+    files = sorted(p for p in latest.rglob("*") if p.is_file())
+    if not files:
+        return None
+    victim = max(files, key=lambda p: (p.stat().st_size, str(p)))
+    junk = bytes(rng.randrange(256) for _ in range(garbage_bytes))
+    with open(victim, "r+b") as f:
+        f.write(junk)
+        f.truncate(max(garbage_bytes, 1))
+    return victim

@@ -1,0 +1,451 @@
+"""Gang supervision: detect → decide → act → recovered.
+
+The :class:`GangCoordinator` owns the launcher's process table and runs
+the whole fault-tolerance loop in one place (ISSUE 4 tentpole):
+
+* **detect** — polls every rank's exit code each ``poll_interval`` and,
+  when a :class:`~tpucfn.ft.heartbeat.HeartbeatMonitor` is attached,
+  consumes its verdicts (a DEAD heartbeat on a live process is a HANG;
+  process exit codes are CRASH / CLEAN_EXIT).
+* **decide** — hands the failure set to the
+  :class:`~tpucfn.ft.policy.RecoveryPolicy` (gang vs solo restart,
+  budget + backoff, per-failure-class table).
+* **act** — SIGTERM→SIGKILL escalation through
+  :meth:`~tpucfn.launch.launcher.Launcher.stop_all`, then relaunch:
+  the whole gang (resume happens in the job via its CheckpointManager —
+  ``Trainer.init_or_resume``) or just the dead host with its original
+  ``host_env`` (same host_id, obs port, heartbeat file).
+* **record** — every incident becomes ``ft_*`` registry metrics (MTTR
+  included), one line each in ``<ft_dir>/events.jsonl``, a trace span,
+  and a refreshed ``<ft_dir>/supervisor.json`` snapshot that ``tpucfn
+  ft status`` renders.
+
+``launch.run_with_restarts`` is a thin shim over this class (gang
+policy, no monitor), preserving its signature and its ``supervisor_*``
+metric names.
+
+The coordinator is also a :class:`~tpucfn.ft.chaos.ChaosTarget`: a
+:class:`~tpucfn.ft.chaos.ChaosSpec` passed in is replayed against the
+real subprocess table (SIGKILL / SIGSTOP / heartbeat delay / checkpoint
+corruption) on the same supervision clock, which is what makes the
+end-to-end recovery drill deterministic.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+from pathlib import Path
+from typing import Callable, Sequence
+
+from tpucfn.ft.chaos import ChaosEngine, ChaosSpec, ChaosTarget, \
+    corrupt_latest_checkpoint
+from tpucfn.ft.heartbeat import HeartbeatMonitor, HostState
+from tpucfn.ft.policy import (
+    Action,
+    Decision,
+    Failure,
+    FailureKind,
+    GangRestart,
+    RecoveryPolicy,
+    RestartBudget,
+)
+
+
+class GangCoordinator(ChaosTarget):
+    def __init__(
+        self,
+        launcher,
+        argv: Sequence[str],
+        *,
+        policy: RecoveryPolicy | None = None,
+        monitor: HeartbeatMonitor | None = None,
+        ft_dir: str | Path | None = None,
+        registry=None,
+        tracer=None,
+        poll_interval: float = 0.05,
+        term_grace_s: float = 5.0,
+        chaos: ChaosSpec | ChaosEngine | None = None,
+        kill_host_after: tuple[int, float] | None = None,
+        ckpt_dir: str | Path | None = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.launcher = launcher
+        self.argv = list(argv)
+        self.policy = policy if policy is not None else GangRestart(
+            RestartBudget(0))
+        self.monitor = monitor
+        self.ft_dir = Path(ft_dir) if ft_dir is not None else None
+        self.tracer = tracer
+        self.poll_interval = poll_interval
+        self.term_grace_s = term_grace_s
+        self.kill_host_after = kill_host_after
+        self.ckpt_dir = Path(ckpt_dir) if ckpt_dir is not None else None
+        self.clock = clock
+        self.sleep = sleep
+
+        if registry is None:
+            # Throwaway registry: identical flow, nothing exported —
+            # keeps the loop free of per-metric None guards.
+            from tpucfn.obs.registry import MetricRegistry
+
+            registry = MetricRegistry()
+        self.registry = registry
+        r = registry
+        # supervisor_* names predate the ft plane (obs PR) and stay for
+        # dashboard compatibility; ft_* is the recovery-plane surface.
+        self.attempts_c = r.counter(
+            "supervisor_launch_attempts_total",
+            "gang launches (incl. the first)")
+        self.restarts_c = r.counter(
+            "supervisor_restarts_total", "relaunches after a failure")
+        self.failures_c = r.counter(
+            "supervisor_failures_total",
+            "gang-level failures observed (clean exits excluded)")
+        self.hosts_g = r.gauge(
+            "supervisor_gang_hosts", "hosts in the launched gang")
+        self.rc_g = r.gauge(
+            "supervisor_last_exit_code", "exit code of the last finished gang")
+        self.ft_failures_c = r.counter(
+            "ft_failures_detected_total",
+            "host failures detected (crash + hang)")
+        self.ft_restarts_c = r.counter(
+            "ft_restarts_total", "recovery restarts executed (gang + solo)")
+        self.ft_gang_restarts_c = r.counter(
+            "ft_gang_restarts_total", "whole-gang restarts")
+        self.ft_solo_restarts_c = r.counter(
+            "ft_solo_restarts_total", "single-host restarts into a live gang")
+        self.ft_incidents_c = r.counter(
+            "ft_incidents_total", "detect→decide→act cycles")
+        self.ft_give_ups_c = r.counter(
+            "ft_give_ups_total", "incidents abandoned (budget exhausted)")
+        self.ft_mttr_s = r.summary(
+            "ft_mttr_seconds", "detect → relaunch-complete recovery time")
+        self.ft_hosts_live_g = r.gauge(
+            "ft_hosts_live", "hosts LIVE per the heartbeat monitor")
+        self.ft_stragglers_g = r.gauge(
+            "ft_stragglers", "hosts flagged STRAGGLER by step lag")
+
+        hosts = self.launcher.contract.hosts()[
+            : self.launcher.contract.workers_count]
+        self.host_ids = list(range(len(hosts)))
+        self._procs: dict[int, object] = {}  # host_id → live Popen
+        self._finished: dict[int, int] = {}  # host_id → clean rc (0)
+        self._incident = 0
+        # Per-host post-(re)launch window during which monitor verdicts
+        # for that host are ignored — a fleet-wide window would let one
+        # solo restart blind hang detection for every other host.
+        self._blind_until: dict[int, float] = {}
+        self._next_observe = 0.0  # monitor read throttle (see _detect)
+        self._last_fleet_step: int | None = None
+        self._reported_stragglers: set[int] = set()
+        # HANG/DEAD verdicts the policy already declined to act on
+        # (observe-only tables): suppressed until the host beats again,
+        # or the detect loop would re-open the same incident every tick.
+        self._suppressed_hangs: set[int] = set()
+        if isinstance(chaos, ChaosSpec):
+            chaos = ChaosEngine(chaos, self)
+        self.chaos = chaos
+        if (self.chaos is not None and self.monitor is None
+                and any(e.at_step is not None and e.at_s is None
+                        for e in self.chaos.spec.events)):
+            # Fleet step comes from heartbeat observations; without a
+            # monitor an at_step-only event would silently never fire
+            # and the drill would pass vacuously.
+            raise ValueError(
+                "chaos events with only an at_step trigger need a "
+                "HeartbeatMonitor attached (fleet step comes from "
+                "heartbeats)")
+        if self.ft_dir is not None:
+            self.ft_dir.mkdir(parents=True, exist_ok=True)
+
+    # -- ChaosTarget ------------------------------------------------------
+
+    def num_hosts(self) -> int:
+        return len(self.host_ids)
+
+    def kill_host(self, host_id: int) -> None:
+        p = self._procs.get(host_id)
+        if p is not None and p.poll() is None:
+            p.kill()
+
+    def hang_host(self, host_id: int) -> None:
+        p = self._procs.get(host_id)
+        if p is not None and p.poll() is None:
+            os.kill(p.pid, signal.SIGSTOP)
+
+    def resume_host(self, host_id: int) -> None:
+        p = self._procs.get(host_id)
+        if p is not None and p.poll() is None:
+            os.kill(p.pid, signal.SIGCONT)
+
+    def delay_heartbeats(self, host_id: int, duration_s: float) -> None:
+        if self.monitor is None:
+            raise ValueError(
+                "chaos delay_heartbeats needs a HeartbeatMonitor attached")
+        self.monitor.inject_heartbeat_delay(
+            host_id, extra_age_s=duration_s, duration_s=duration_s)
+
+    def corrupt_latest_checkpoint(self, rng) -> None:
+        if self.ckpt_dir is None:
+            raise ValueError(
+                "chaos corrupt_ckpt fired but GangCoordinator has no "
+                "ckpt_dir configured")
+        victim = corrupt_latest_checkpoint(self.ckpt_dir, rng)
+        self._event("chaos_ckpt_corrupted",
+                    path=None if victim is None else str(victim))
+
+    # -- event / snapshot plumbing ---------------------------------------
+
+    def _event(self, kind: str, **fields) -> None:
+        if self.ft_dir is None:
+            return
+        rec = {"ts": time.time(), "kind": kind, **fields}
+        with open(self.ft_dir / "events.jsonl", "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        self._write_snapshot()
+
+    def _write_snapshot(self) -> None:
+        if self.ft_dir is None:
+            return
+        hb = None
+        if self.monitor is not None:
+            hb = self.monitor.config.interval_s
+        snap = {
+            "updated_ts": time.time(),
+            "pid": os.getpid(),
+            "argv": self.argv,
+            "gang_hosts": len(self.host_ids),
+            "policy": self.policy.name,
+            "budget": {"max_restarts": self.policy.budget.max_restarts,
+                       "used": self.policy.budget.used},
+            "heartbeat_interval_s": hb,
+            **self.registry.varz(),
+        }
+        tmp = self.ft_dir / "supervisor.json.tmp"
+        tmp.write_text(json.dumps(snap, indent=2))
+        tmp.replace(self.ft_dir / "supervisor.json")
+
+    # -- supervision loop -------------------------------------------------
+
+    def _launch_gang(self, *, first: bool) -> None:
+        inject = self.kill_host_after if first else None
+        procs = self.launcher.launch(self.argv, kill_host_after=inject)
+        self._procs = dict(zip(self.host_ids, procs))
+        self._finished.clear()
+        self._reported_stragglers.clear()
+        self._suppressed_hangs.clear()
+        self.attempts_c.add()
+        self.hosts_g.set(len(procs))
+        if self.monitor is not None:
+            self.monitor.restart_grace()
+            for h in self.host_ids:
+                self.monitor.activate_host(h)
+            blind = self.clock() + self.monitor.config.grace_s
+            self._blind_until = {h: blind for h in self.host_ids}
+        self._event("launch", first=first, hosts=len(procs),
+                    pids=[p.pid for p in procs])
+
+    def _launch_solo(self, host_id: int) -> None:
+        # Same host_env as the rank it replaces (host_id, obs port,
+        # heartbeat file) — the gang must not notice the substitution.
+        self._procs[host_id] = self.launcher.launch_host(self.argv, host_id)
+        self._finished.pop(host_id, None)
+        self._suppressed_hangs.discard(host_id)
+        self._reported_stragglers.discard(host_id)
+        if self.monitor is not None:
+            self.monitor.activate_host(host_id)
+            # Blind only the replaced host: its stale heartbeat must not
+            # re-condemn it while it boots, but the REST of the gang
+            # keeps full-rate hang detection.
+            self._blind_until[host_id] = (self.clock()
+                                          + self.monitor.config.grace_s)
+        self._event("solo_launch", host=host_id,
+                    pid=self._procs[host_id].pid)
+
+    def _straggler_actionable(self) -> bool:
+        return self.policy.table.get(
+            FailureKind.STRAGGLER, Action.NONE) is not Action.NONE
+
+    def _detect(self, now: float) -> list[Failure]:
+        failures: list[Failure] = []
+        for host_id, p in list(self._procs.items()):
+            rc = p.poll()
+            if rc is None:
+                continue
+            if rc == 0:
+                del self._procs[host_id]
+                self._finished[host_id] = 0
+                if self.monitor is not None:
+                    # a finished rank's heartbeat going stale is
+                    # retirement, not death — keep /healthz green
+                    self.monitor.retire_host(host_id)
+                self._event("host_exit", host=host_id, rc=0)
+            else:
+                failures.append(Failure(host_id, FailureKind.CRASH, rc=rc))
+        if (self.monitor is not None and self._procs
+                and now >= self._next_observe):
+            # Throttle to half the heartbeat interval: heartbeat files
+            # change once per interval, so tail-reading every 50ms poll
+            # tick is pure redundant I/O (process-exit CRASH detection
+            # above still runs at full poll rate).
+            self._next_observe = now + self.monitor.config.interval_s / 2.0
+            view = self.monitor.observe()
+            self._last_fleet_step = view.max_step()
+            counts = view.counts()
+            self.ft_hosts_live_g.set(counts[HostState.LIVE.value])
+            self.ft_stragglers_g.set(counts[HostState.STRAGGLER.value])
+            crashed = {f.host_id for f in failures}
+            for v in view.hosts:
+                if v.host_id not in self._procs or v.host_id in crashed:
+                    continue
+                if now < self._blind_until.get(v.host_id, 0.0):
+                    # Per-host post-(re)launch blind window: a stale
+                    # heartbeat from the previous incarnation must not
+                    # condemn a rank that is still importing jax.
+                    continue
+                if v.state is HostState.DEAD:
+                    if v.host_id in self._suppressed_hangs:
+                        continue  # policy already declined to act
+                    failures.append(Failure(v.host_id, FailureKind.HANG,
+                                            step=v.step, detail=v.reason))
+                else:
+                    # the host came back (fresh beat): re-arm reporting
+                    self._suppressed_hangs.discard(v.host_id)
+                    if v.state is HostState.LIVE:
+                        # caught back up: a later straggle is a NEW
+                        # episode and must be reported again
+                        self._reported_stragglers.discard(v.host_id)
+                    if (v.state is HostState.STRAGGLER
+                            and self._straggler_actionable()
+                            and v.host_id not in self._reported_stragglers):
+                        self._reported_stragglers.add(v.host_id)
+                        failures.append(
+                            Failure(v.host_id, FailureKind.STRAGGLER,
+                                    step=v.step, detail=v.reason))
+        return failures
+
+    def _stop_hosts(self, host_ids: Sequence[int]) -> None:
+        procs = [self._procs[h] for h in host_ids if h in self._procs]
+        self.launcher.stop_all(procs, grace_s=self.term_grace_s,
+                               poll_interval=self.poll_interval)
+        for h in host_ids:
+            self._procs.pop(h, None)
+
+    def _failure_rc(self, failures: list[Failure]) -> int:
+        for f in failures:
+            if f.rc is not None and f.rc != 0:
+                return f.rc
+        return 1  # hang/straggler incidents have no exit code
+
+    def run(self) -> int:
+        """Supervise until the gang finishes cleanly (0), a failure
+        exhausts the policy budget (the failing rc), or the policy
+        declines to act on a fatal class."""
+        try:
+            self._launch_gang(first=True)
+            start = self.clock()
+            while True:
+                self.sleep(self.poll_interval)
+                now = self.clock()
+                if self.chaos is not None and not self.chaos.done():
+                    self.chaos.tick(now - start, self._last_fleet_step)
+                failures = self._detect(now)
+                if not failures:
+                    if not self._procs:  # every supervised rank exited
+                        rc = next((r for r in self._finished.values() if r),
+                                  0)
+                        self.rc_g.set(rc)
+                        self._event("done", rc=rc)
+                        return rc
+                    continue
+                rc = self._handle_incident(failures)
+                if rc is not None:
+                    return rc
+        finally:
+            if self._procs:
+                self.launcher.stop_all(list(self._procs.values()),
+                                       grace_s=self.term_grace_s,
+                                       poll_interval=self.poll_interval)
+                self._procs.clear()
+            self._write_snapshot()
+
+    def _handle_incident(self, failures: list[Failure]) -> int | None:
+        """One detect→decide→act→recovered cycle; returns the run's exit
+        code when the incident ends the run, else None."""
+        t_detect = self.clock()
+        self._incident += 1
+        incident = self._incident
+        self.ft_incidents_c.add()
+        real = [f for f in failures if f.kind in (FailureKind.CRASH,
+                                                  FailureKind.HANG)]
+        if real:
+            self.ft_failures_c.add(len(real))
+            self.failures_c.add()
+            self.rc_g.set(self._failure_rc(real))
+        fail_json = [{"host": f.host_id, "kind": f.kind.value, "rc": f.rc,
+                      "step": f.step, "detail": f.detail} for f in failures]
+        self._event("detect", incident=incident, failures=fail_json)
+        if self.tracer is not None:
+            self.tracer.event("ft_detect", trace_id=incident,
+                              failures=fail_json)
+        decision = self.policy.decide(failures)
+        self._event("decide", incident=incident,
+                    action=decision.action.value,
+                    hosts=list(decision.hosts),
+                    delay_s=round(decision.delay_s, 3),
+                    reason=decision.reason)
+
+        if decision.action is Action.NONE:
+            # A table can declare a failure non-actionable (observe-
+            # only); the incident must then be closed, not re-detected
+            # every poll tick: reap crashed hosts with their rc, and
+            # suppress further HANG verdicts until the host beats again.
+            for f in failures:
+                if f.kind is FailureKind.CRASH and f.host_id in self._procs:
+                    del self._procs[f.host_id]
+                    self._finished[f.host_id] = f.rc if f.rc else 1
+                elif f.kind is FailureKind.HANG:
+                    self._suppressed_hangs.add(f.host_id)
+            return None
+        if decision.action is Action.GIVE_UP:
+            rc = self._failure_rc(failures)
+            self.ft_give_ups_c.add()
+            self._stop_hosts(list(self._procs))
+            self.rc_g.set(rc)
+            self._event("give_up", incident=incident, rc=rc,
+                        reason=decision.reason)
+            if self.tracer is not None:
+                self.tracer.record("ft_give_up", start=t_detect,
+                                   end=self.clock(), trace_id=incident,
+                                   rc=rc)
+            return rc
+
+        if decision.delay_s > 0:
+            self.sleep(decision.delay_s)
+        if decision.action is Action.SOLO_RESTART:
+            self._stop_hosts(decision.hosts)
+            for h in decision.hosts:
+                self._launch_solo(h)
+            self.ft_solo_restarts_c.add(len(decision.hosts))
+            self.ft_restarts_c.add(len(decision.hosts))
+            self.restarts_c.add(len(decision.hosts))
+        else:  # GANG_RESTART
+            self._stop_hosts(list(self._procs))
+            self._launch_gang(first=False)
+            self.ft_gang_restarts_c.add()
+            self.ft_restarts_c.add()
+            self.restarts_c.add()
+        mttr = self.clock() - t_detect
+        self.ft_mttr_s.observe(mttr)
+        self._event("recovered", incident=incident,
+                    action=decision.action.value, mttr_s=round(mttr, 4))
+        if self.tracer is not None:
+            self.tracer.record("ft_recover", start=t_detect, dur_s=mttr,
+                               trace_id=incident,
+                               action=decision.action.value,
+                               hosts=list(decision.hosts))
+        return None

@@ -1,0 +1,206 @@
+"""Recovery policies: what to do about a detected failure, and at what
+cost.
+
+The decision layer between detection (ft/heartbeat.py, process exit
+codes) and action (ft/coordinator.py).  Three pieces:
+
+* :class:`RestartBudget` — how many recoveries a run is allowed, and the
+  exponential-backoff-with-jitter delay before each one.  Jitter comes
+  from a ``random.Random`` the caller seeds (no wall-clock randomness:
+  the same seed replays the same delays, which is what makes the chaos
+  harness deterministic).
+* A **decision table** — failure class → action, overridable per policy
+  (the per-failure-class table from ISSUE 4: a crash is not a hang is
+  not a straggler).
+* :class:`GangRestart` / :class:`SoloRestart` — the two recovery shapes
+  for a TPU gang.  A TPU slice runs one SPMD program, so the safe
+  default is gang restart: kill all, relaunch all, resume from the
+  latest checkpoint.  Solo restart (restart only the dead host into the
+  same gang) is the cheaper path for harnesses whose ranks are loosely
+  coupled (data-parallel CPU rigs, serving fleets) — it falls back to a
+  gang restart when multiple hosts fail at once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import random
+
+
+class FailureKind(enum.Enum):
+    CLEAN_EXIT = "clean_exit"  # rc == 0 — not a failure; never burns budget
+    CRASH = "crash"            # process exited nonzero (or was killed)
+    HANG = "hang"              # process alive but heartbeats went DEAD
+    STRAGGLER = "straggler"    # alive, beating, but step-lagging the fleet
+
+
+class Action(enum.Enum):
+    NONE = "none"
+    SOLO_RESTART = "solo_restart"
+    GANG_RESTART = "gang_restart"
+    GIVE_UP = "give_up"
+
+
+@dataclasses.dataclass(frozen=True)
+class Failure:
+    host_id: int
+    kind: FailureKind
+    rc: int | None = None      # exit code for CRASH/CLEAN_EXIT
+    step: int | None = None    # last heartbeat step, when known
+    detail: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    action: Action
+    hosts: tuple[int, ...] = ()  # SOLO_RESTART victims; empty = whole gang
+    delay_s: float = 0.0
+    reason: str = ""
+
+
+# action each failure class earns by default; CLEAN_EXIT and STRAGGLER
+# are observe-only (a straggler is a scheduling/obs problem first — see
+# ROADMAP ft follow-ons for eviction policies).
+DEFAULT_DECISION_TABLE: dict[FailureKind, Action] = {
+    FailureKind.CLEAN_EXIT: Action.NONE,
+    FailureKind.CRASH: Action.GANG_RESTART,
+    FailureKind.HANG: Action.GANG_RESTART,
+    FailureKind.STRAGGLER: Action.NONE,
+}
+
+
+class RestartBudget:
+    """``max_restarts`` recoveries, exponential backoff + jitter between.
+
+    Delay before restart ``k`` (0-based over *consumed* restarts)::
+
+        min(backoff_s * multiplier**k, max_backoff_s) * (1 + U(-j, +j))
+
+    ``backoff_s=0`` disables delays entirely (the unit-test path).  The
+    budget is only consumed for actual recoveries — a clean exit after
+    prior restarts must not burn a slot (ISSUE 4 satellite: exit-cause
+    accounting).
+    """
+
+    def __init__(self, max_restarts: int, *, backoff_s: float = 0.0,
+                 multiplier: float = 2.0, max_backoff_s: float = 60.0,
+                 jitter: float = 0.1, rng: random.Random | None = None):
+        if max_restarts < 0:
+            raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
+        if not 0.0 <= jitter < 1.0:
+            raise ValueError(f"jitter must be in [0, 1), got {jitter}")
+        self.max_restarts = max_restarts
+        self.backoff_s = float(backoff_s)
+        self.multiplier = float(multiplier)
+        self.max_backoff_s = float(max_backoff_s)
+        self.jitter = float(jitter)
+        self.rng = rng if rng is not None else random.Random(0)
+        self.used = 0
+
+    @property
+    def remaining(self) -> int:
+        return max(0, self.max_restarts - self.used)
+
+    def next_delay(self) -> float:
+        """The delay the *next* restart would wait (no state change)."""
+        if self.backoff_s <= 0.0:
+            return 0.0
+        base = min(self.backoff_s * self.multiplier ** self.used,
+                   self.max_backoff_s)
+        if self.jitter:
+            base *= 1.0 + self.rng.uniform(-self.jitter, self.jitter)
+        return base
+
+    def consume(self) -> bool:
+        """Take one restart slot; False when the budget is exhausted."""
+        if self.used >= self.max_restarts:
+            return False
+        self.used += 1
+        return True
+
+
+class RecoveryPolicy:
+    """decide(failures) → Decision; owns the budget and the table."""
+
+    name = "base"
+
+    def __init__(self, budget: RestartBudget,
+                 table: dict[FailureKind, Action] | None = None):
+        self.budget = budget
+        self.table = dict(DEFAULT_DECISION_TABLE)
+        if table:
+            self.table.update(table)
+
+    def _restart_shape(self, actionable: list[Failure]) -> Action:
+        raise NotImplementedError
+
+    def decide(self, failures: list[Failure]) -> Decision:
+        actionable = [f for f in failures
+                      if self.table.get(f.kind, Action.NONE) is not Action.NONE]
+        if not actionable:
+            kinds = ",".join(sorted({f.kind.value for f in failures})) or "none"
+            return Decision(Action.NONE, reason=f"table: no action for {kinds}")
+        shape = self._restart_shape(actionable)
+        # Delay is drawn before consume so it reflects the restart being
+        # paid for (restart k waits multiplier**k), and only when the
+        # budget actually has a slot (a drawn-then-refused delay would
+        # desync the seeded jitter stream between runs that exhaust at
+        # different points).
+        if self.budget.remaining == 0:
+            return Decision(
+                Action.GIVE_UP,
+                reason=f"restart budget exhausted "
+                       f"({self.budget.max_restarts} used)")
+        delay = self.budget.next_delay()
+        self.budget.consume()
+        hosts = tuple(sorted(f.host_id for f in actionable))
+        if shape is Action.SOLO_RESTART:
+            return Decision(Action.SOLO_RESTART, hosts=hosts, delay_s=delay,
+                            reason=f"solo restart of host(s) {hosts} "
+                                   f"({self.budget.used}/"
+                                   f"{self.budget.max_restarts})")
+        return Decision(Action.GANG_RESTART, delay_s=delay,
+                        reason=f"gang restart for host(s) {hosts} "
+                               f"({self.budget.used}/"
+                               f"{self.budget.max_restarts})")
+
+
+class GangRestart(RecoveryPolicy):
+    """Kill all, relaunch all, resume from the latest checkpoint — the
+    only safe shape when the ranks form one SPMD program (a TPU slice's
+    collectives wedge the moment one participant is gone)."""
+
+    name = "gang"
+
+    def _restart_shape(self, actionable: list[Failure]) -> Action:
+        return Action.GANG_RESTART
+
+
+class SoloRestart(RecoveryPolicy):
+    """Restart only the dead host back into the same gang (same host_id,
+    same env: obs port, heartbeat file).  Correct only for loosely
+    coupled ranks; multiple simultaneous failures escalate to a gang
+    restart (correlated death usually means the gang state is gone)."""
+
+    name = "solo"
+
+    def _restart_shape(self, actionable: list[Failure]) -> Action:
+        if len(actionable) == 1:
+            return Action.SOLO_RESTART
+        return Action.GANG_RESTART
+
+
+POLICIES = {GangRestart.name: GangRestart, SoloRestart.name: SoloRestart}
+
+
+def policy_from_name(name: str, budget: RestartBudget,
+                     table: dict[FailureKind, Action] | None = None
+                     ) -> RecoveryPolicy:
+    try:
+        cls = POLICIES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown ft policy {name!r}; choose from {sorted(POLICIES)}"
+        ) from None
+    return cls(budget, table)

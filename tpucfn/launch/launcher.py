@@ -77,12 +77,24 @@ class Launcher:
     # role in the job becomes scrapeable at a predictable address
     # (tpucfn/obs/server.py documents the endpoint surface).
     obs_base_port: int | None = None
+    # Fault-tolerance-plane fan-out (tpucfn/ft): when set, every host
+    # writes heartbeats into this shared directory (TPUCFN_FT_DIR) at
+    # TPUCFN_FT_HEARTBEAT_S intervals, and the gang coordinator's
+    # HeartbeatMonitor reads the same dir.  Part of host_env so a solo
+    # restart reuses the identical env — the replacement rank appends to
+    # the same heartbeat file the dead one owned.
+    ft_dir: str | None = None
+    ft_heartbeat_s: float | None = None
 
     def host_env(self, host_id: int) -> dict[str, str]:
         env = self.contract.to_env()
         env["TPUCFN_HOST_ID"] = str(host_id)
         if self.obs_base_port is not None:
             env["TPUCFN_OBS_PORT"] = str(self.obs_base_port + 1 + host_id)
+        if self.ft_dir is not None:
+            env["TPUCFN_FT_DIR"] = self.ft_dir
+            if self.ft_heartbeat_s is not None:
+                env["TPUCFN_FT_HEARTBEAT_S"] = repr(float(self.ft_heartbeat_s))
         return env
 
     def launch(
@@ -128,6 +140,44 @@ class Launcher:
             t.daemon = True
             t.start()
         return procs
+
+    def launch_host(self, argv: Sequence[str], host_id: int) -> subprocess.Popen:
+        """(Re)start ``argv`` on one host with that host's exact env —
+        the solo-restart path: the replacement rank gets the same
+        host_id, obs port, and heartbeat file as the rank it replaces,
+        so the rest of the gang cannot tell the difference."""
+        hosts = self.contract.hosts()[: self.contract.workers_count]
+        if not 0 <= host_id < len(hosts):
+            raise ValueError(
+                f"host_id {host_id} out of range for {len(hosts)} hosts")
+        return self.transport.run(hosts[host_id], argv,
+                                  self.host_env(host_id))
+
+    def stop_all(self, procs: Sequence[subprocess.Popen], *,
+                 grace_s: float = 5.0, poll_interval: float = 0.05) -> int:
+        """Stop every live process: SIGTERM first, then SIGKILL whatever
+        is still alive after ``grace_s`` (a rank wedged in a collective,
+        or SIGSTOP'd by the chaos harness, ignores SIGTERM forever).
+        All processes are reaped before returning.  Returns how many
+        needed the SIGKILL escalation."""
+        import time
+
+        live = [p for p in procs if p.poll() is None]
+        for p in live:
+            p.terminate()
+        deadline = time.monotonic() + grace_s
+        while any(p.poll() is None for p in live):
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(poll_interval)
+        escalated = 0
+        for p in live:
+            if p.poll() is None:
+                escalated += 1
+                p.kill()
+        for p in live:
+            p.wait()
+        return escalated
 
     def wait(self, procs: list[subprocess.Popen], poll_interval: float = 0.05) -> int:
         """Wait for all ranks; first nonzero exit wins and the rest are
@@ -179,42 +229,30 @@ def run_with_restarts(
     automates the re-run.
 
     ``registry`` (a ``tpucfn.obs.MetricRegistry``) makes the supervisor
-    itself a scrapeable role: attempts, restarts, gang size, and the
-    last exit code are published so a dashboard can tell "training is
-    slow" apart from "training is crash-looping".
+    itself a scrapeable role: attempts, restarts, failures, gang size,
+    and the last exit code are published so a dashboard can tell
+    "training is slow" apart from "training is crash-looping".
+
+    Exit-cause accounting (ISSUE 4 satellite): only actual failures
+    consume the restart budget and bump ``supervisor_failures_total`` /
+    ``supervisor_restarts_total`` — a clean rc=0 gang after a prior
+    failure ends the run successfully without burning a slot.
+    ``supervisor_launch_attempts_total`` still counts every gang launch
+    including the first (it is a launch counter, not a failure counter).
     """
-    import time
+    from tpucfn.ft import GangCoordinator, GangRestart, RestartBudget
 
-    if registry is None:
-        # Throwaway registry: identical flow, nothing exported — keeps
-        # the loop free of per-metric None guards.
-        from tpucfn.obs.registry import MetricRegistry
-
-        registry = MetricRegistry()
-    attempts_c = registry.counter(
-        "supervisor_launch_attempts_total", "gang launches (incl. first)")
-    restarts_c = registry.counter(
-        "supervisor_restarts_total", "relaunches after a failure")
-    hosts_g = registry.gauge(
-        "supervisor_gang_hosts", "hosts in the launched gang")
-    rc_g = registry.gauge(
-        "supervisor_last_exit_code", "exit code of the last finished gang")
-    attempt = 0
-    while True:
-        # Fault injection fires on the first attempt only — the drill is
-        # "die once, recover from checkpoint".
-        inject = kill_host_after if attempt == 0 else None
-        procs = launcher.launch(argv, kill_host_after=inject)
-        attempts_c.add()
-        hosts_g.set(len(procs))
-        rc = launcher.wait(procs)
-        rc_g.set(rc)
-        if rc == 0 or attempt >= max_restarts:
-            return rc
-        attempt += 1
-        restarts_c.add()
-        if backoff_s:
-            time.sleep(backoff_s)
+    # multiplier=1/jitter=0/uncapped preserves this entry point's
+    # historical constant-backoff contract (the replaced loop slept
+    # exactly backoff_s); the full exponential+jitter surface is
+    # GangCoordinator with an explicitly built RestartBudget.
+    budget = RestartBudget(max_restarts, backoff_s=backoff_s,
+                           multiplier=1.0, jitter=0.0,
+                           max_backoff_s=float("inf"))
+    coordinator = GangCoordinator(
+        launcher, argv, policy=GangRestart(budget), registry=registry,
+        kill_host_after=kill_host_after)
+    return coordinator.run()
 
 
 def initialize_runtime(contract: EnvContract | None = None) -> EnvContract | None:

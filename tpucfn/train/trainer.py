@@ -145,6 +145,21 @@ class Trainer:
         KVStore-pushes startup, minus the wire traffic)."""
         return jax.jit(self._create_state, out_shardings=self.state_shardings())(rng)
 
+    def init_or_resume(self, rng: jax.Array, ckpt=None, *,
+                       fresh: bool = False) -> tuple[TrainState, int | None]:
+        """Resume-from-latest on startup (ISSUE 4): restore the latest
+        checkpoint through ``ckpt`` (a :class:`tpucfn.ckpt.
+        CheckpointManager`) into this trainer's abstract state, or init
+        fresh when there is none (or ``fresh`` forces it).  Returns
+        ``(state, resumed_step)`` with ``resumed_step=None`` for a fresh
+        init — the one call a gang-restarted job needs to continue from
+        the last saved step instead of retraining from 0."""
+        if ckpt is not None and not fresh:
+            latest = ckpt.latest_step()
+            if latest is not None:
+                return ckpt.restore(self.abstract_state()), latest
+        return self.init(rng), None
+
     def abstract_state(self) -> Any:
         """ShapeDtypeStructs with shardings attached — what checkpoint
         restore needs to re-materialize the state on a (possibly different)
