@@ -44,23 +44,13 @@ import time
 
 REFERENCE_IMAGES_PER_SEC_PER_ACCEL = 400.0  # V100 ResNet-50 fp16, reference-era
 
-# Peak dense bf16 TFLOP/s per chip by device_kind substring (public specs).
-_PEAK_BF16_TFLOPS = (
-    ("v6", 918.0), ("trillium", 918.0),
-    ("v5p", 459.0),
-    ("v5 lite", 197.0), ("v5e", 197.0), ("v5litepod", 197.0),
-    ("v4", 275.0),
-    ("v3", 123.0),
-    ("v2", 45.0),
-)
-
-
 def _peak_tflops(device_kind: str) -> float | None:
-    kind = device_kind.lower()
-    for key, tflops in _PEAK_BF16_TFLOPS:
-        if key in kind:
-            return tflops
-    return None
+    # The peak table lives in tpucfn.obs.goodput so the offline bench
+    # and the live train_mfu gauge share one denominator.
+    from tpucfn.obs.goodput import device_peak_flops
+
+    peak = device_peak_flops(device_kind)
+    return peak / 1e12 if peak else None
 
 
 # Peak HBM bandwidth GB/s per chip by device_kind substring (public specs).
@@ -402,15 +392,12 @@ def _measure_trainer(trainer, state, batch, *, steps, warmup):
     flops_per_dev_step = None
     bytes_per_dev_step = None
     try:
+        from tpucfn.obs.goodput import cost_analysis_value
+
         cost = (trainer._jit_step.lower(trainer.abstract_state(), batch)
                 .compile().cost_analysis())
-        # jax <= 0.4.x returns a per-device LIST of dicts; >= 0.5 a dict.
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else None
-        if cost and cost.get("flops"):
-            flops_per_dev_step = float(cost["flops"])
-        if cost and cost.get("bytes accessed"):
-            bytes_per_dev_step = float(cost["bytes accessed"])
+        flops_per_dev_step = cost_analysis_value(cost, "flops")
+        bytes_per_dev_step = cost_analysis_value(cost, "bytes accessed")
     except Exception:  # noqa: BLE001 — cost analysis is best-effort
         pass
 

@@ -73,6 +73,7 @@ def _run_workload(engine, args, prompts, *, prefix_cache, max_prefill_batch,
         "ttft_p95_s": snap["ttft_s"]["p95"],
         "tokens_per_sec": round(snap["generated_tokens"] / wall, 3),
         "snapshot": snap,
+        "slo": server.slo.snapshot(),
     }
 
 
@@ -184,6 +185,10 @@ def main() -> int:
             # (queue depth, occupancy, token counts) instead of just the
             # headline number (ISSUE 2 satellite).
             "serving_metrics": head["snapshot"],
+            # The serve_slo_* snapshot (ISSUE 5): TTFT/TPOT objective
+            # targets, violation counts, and rolling-window burn rates
+            # for the headline workload.
+            "serve_slo": head["slo"],
             # ISSUE 3 acceptance: prefix caching's prefilled-token
             # reduction and batched prefill's call ceiling, cache off vs
             # on over identical prompts in the same run.

@@ -195,7 +195,7 @@ def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=(),
     # or a bind error from the obs endpoint itself must still release
     # the bound port and the open log/trace files — a retry in the same
     # process would otherwise hit "Address already in use".
-    logger = tracer = obs_srv = hb = None
+    logger = tracer = obs_srv = hb = ledger = None
     try:
         logger = MetricLogger(run_dir / "logs", stdout_every=args.log_every)
         # The observability plane (ISSUE 2): registry metrics + trace
@@ -205,7 +205,15 @@ def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=(),
         # fan-out is scrapeable.
         registry = set_default_labels(host=str(host), role="trainer")
         tracer = Tracer(run_dir / "trace", host_id=host, role="trainer")
-        obs = TrainerObs(registry, tracer)
+        # The goodput ledger (ISSUE 5): every loop phase is attributed to
+        # a wall-clock bucket in a per-host JSONL; a relaunch appends a
+        # new window to the same file, which is how `tpucfn obs goodput`
+        # sees restart downtime and post-rewind re-runs.
+        from tpucfn.obs.goodput import GoodputLedger
+
+        ledger = GoodputLedger(run_dir / "goodput", host_id=host,
+                               role="trainer")
+        obs = TrainerObs(registry, tracer, ledger=ledger)
         obs_srv = start_obs_server(
             registry, role="trainer", host_id=host,
             health_fn=lambda: (True, {"step": obs.last_step.value}))
@@ -234,6 +242,8 @@ def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=(),
             logger.close()
         if tracer is not None:
             tracer.close()
+        if ledger is not None:
+            ledger.close()
         if obs_srv is not None:
             obs_srv.close()
 
@@ -290,6 +300,34 @@ def _train_loop_body(trainer, ds, mesh, args, items_per_step, extra_axes,
                     logger.log(step, {"time_to_first_step": round(
                         time.perf_counter() - t_start, 2)})
                     t_start = None
+                    # Live MFU (ISSUE 5): cost-analysis FLOPs captured
+                    # ONCE, right after the first step.  AOT
+                    # lower/compile does NOT share the jit call's
+                    # executable cache and can recompile the whole
+                    # program, so capture off-thread — the train loop
+                    # never blocks, the gauge arms when analysis lands.
+                    # lower() only needs avals: hand the thread an
+                    # abstract batch so the closure doesn't pin the real
+                    # step-1 device buffers in HBM for the whole compile.
+                    import threading
+
+                    from tpucfn.obs.goodput import device_peak_flops
+
+                    peak = device_peak_flops(jax.devices()[0].device_kind)
+                    # No peak entry (CPU fallback, unknown device) means
+                    # the gauge can never arm — skip the duplicate AOT
+                    # compile entirely rather than burn a core on it.
+                    if peak is not None:
+                        abstract_batch = jax.tree_util.tree_map(
+                            lambda x: jax.ShapeDtypeStruct(
+                                x.shape, x.dtype,
+                                sharding=getattr(x, "sharding", None)),
+                            batch)
+                        threading.Thread(
+                            target=lambda: obs.set_model_flops(
+                                trainer.step_cost_flops(abstract_batch),
+                                peak),
+                            daemon=True, name="mfu-cost-analysis").start()
                 if step % args.log_every == 0 or step == halt:
                     logger.log(step, {**{k: float(v) for k, v in metrics.items()},
                                       "step_time": timer._last or 0.0,

@@ -22,6 +22,7 @@ import numpy as np  # noqa: E402
 
 from tpucfn.ckpt import CheckpointManager  # noqa: E402  (imports jax/orbax)
 from tpucfn.ft import HeartbeatWriter  # noqa: E402
+from tpucfn.obs.goodput import GoodputLedger  # noqa: E402
 
 
 def _latest_finalized_step(ckpt_dir: Path) -> int:
@@ -53,6 +54,12 @@ def main() -> int:
     if ft_dir:
         hb = HeartbeatWriter(ft_dir, host_id=host, interval_s=hb_s,
                              role="e2e").start()
+    # Goodput ledger (ISSUE 5): every incarnation appends a new window
+    # to the same per-host file; a SIGKILLed incarnation leaves no close
+    # record, and the gap to the relaunch's window marker is what the
+    # merge reports as restart_downtime.  Re-run steps after the rewind
+    # are detected by their repeated step numbers (lost_work).
+    ledger = GoodputLedger(run_dir / "goodput", host_id=host, role="e2e")
     template = {"step": np.zeros((), np.int64),
                 "w": np.asarray(10.0, np.float64)}
     losses = run_dir / f"losses-host{host:03d}.jsonl"
@@ -77,10 +84,15 @@ def main() -> int:
                         # can drag the fleet max step (the chaos at_step
                         # trigger) past the kill point before host 0 has
                         # written the checkpoint the drill resumes from.
+                        t0_wait = time.monotonic()
                         while (step + 1 - _latest_finalized_step(
                                    run_dir / "ckpt") > ckpt_every
                                and time.monotonic() < sync_deadline):
                             time.sleep(0.01)
+                        t_wait = time.monotonic() - t0_wait
+                        if t_wait >= 0.001:
+                            ledger.account("data_wait", t_wait, step=step + 1)
+                    t0_step = time.monotonic()
                     w = 0.9 * w + 0.1
                     step += 1
                     f.write(json.dumps({
@@ -89,16 +101,28 @@ def main() -> int:
                     f.flush()
                     if hb is not None:
                         hb.update_step(step)
-                    if host == 0:
-                        ckpt.save(step, {"step": np.asarray(step, np.int64),
-                                         "w": np.asarray(w, np.float64)})
                     time.sleep(step_sleep)
+                    ledger.account("step", time.monotonic() - t0_step,
+                                   step=step)
+                    if host == 0:
+                        t0_ckpt = time.monotonic()
+                        if ckpt.save(step,
+                                     {"step": np.asarray(step, np.int64),
+                                      "w": np.asarray(w, np.float64)}):
+                            ledger.account(
+                                "ckpt", time.monotonic() - t0_ckpt,
+                                step=step)
             if host == 0:
-                ckpt.save(step, {"step": np.asarray(step, np.int64),
-                                 "w": np.asarray(w, np.float64)}, force=True)
+                t0_ckpt = time.monotonic()
+                if ckpt.save(step, {"step": np.asarray(step, np.int64),
+                                    "w": np.asarray(w, np.float64)},
+                             force=True):
+                    ledger.account("ckpt", time.monotonic() - t0_ckpt,
+                                   step=step)
     finally:
         if hb is not None:
             hb.stop()
+        ledger.close()
     return 0
 
 

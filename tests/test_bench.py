@@ -255,6 +255,16 @@ def test_serve_bench_row_carries_prefix_and_batch_stats():
     for key in ("metric", "value", "unit", "vs_baseline", "detail"):
         assert key in rec, rec
     assert rec["metric"] == "serve_tokens_per_sec"
+    # ISSUE 5 acceptance: the BENCH row carries the serve_slo_* snapshot
+    # (targets, objective, violation counts, rolling-window burn rates).
+    slo = rec["detail"]["serve_slo"]
+    for key in ("ttft_target_s", "tpot_target_s", "objective", "window_s",
+                "requests", "window_requests", "ttft", "tpot"):
+        assert key in slo, (key, slo)
+    for objective in ("ttft", "tpot"):
+        for key in ("violations_total", "window_violations", "burn_rate"):
+            assert key in slo[objective], (objective, key)
+    assert slo["requests"] == rec["detail"]["requests"]
     sp = rec["detail"]["shared_prefix"]
     for key in ("prefix_len", "requests", "max_prefill_batch",
                 "prefill_calls_ceiling", "off", "on",

@@ -1,0 +1,122 @@
+"""Goodput chaos-drill acceptance (ISSUE 5): a scripted mid-run kill →
+gang restart → resume drill must produce a `tpucfn obs goodput --json`
+report whose buckets sum to within 5% of the wall time it measured,
+with nonzero restart_downtime_s and lost_work_s attributed to the
+injected incident.
+
+Multi-second by construction (each worker pays a jax+orbax import) —
+``slow``-marked, excluded from tier-1 like the ft e2e drill.
+"""
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from tpucfn.bootstrap import EnvContract
+from tpucfn.ft import (
+    ChaosEvent,
+    ChaosSpec,
+    GangCoordinator,
+    GangRestart,
+    HeartbeatMonitor,
+    MonitorConfig,
+    RestartBudget,
+)
+from tpucfn.launch import Launcher, LocalTransport
+from tpucfn.obs import MetricRegistry
+
+pytestmark = pytest.mark.slow
+
+REPO = Path(__file__).resolve().parent.parent
+WORKER = str(REPO / "tests" / "ft_e2e_worker.py")
+
+TOTAL_STEPS = 40
+CKPT_EVERY = 10
+# Kill off a checkpoint boundary so the rewind DEFINITELY re-runs work:
+# resume is from step <= 21, the kill landed at >= 25, so steps 21..24
+# are paid twice whatever the detection jitter does.
+KILL_AT_STEP = 25
+
+
+def _contract(tmp_path, n) -> EnvContract:
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("".join("127.0.0.1:0\n" for _ in range(n)))
+    return EnvContract(
+        workers_path=str(hostfile), workers_count=n, worker_chip_count=1,
+        coordinator="127.0.0.1:1234", host_id=0, storage=str(tmp_path),
+        generation=1)
+
+
+def test_chaos_drill_goodput_report_sums_to_wall(tmp_path):
+    run_dir = tmp_path / "drill"
+    ft_dir = run_dir / "ft"
+    run_dir.mkdir()
+    env = {"FT_E2E_RUN_DIR": str(run_dir),
+           "FT_E2E_TOTAL_STEPS": str(TOTAL_STEPS),
+           "FT_E2E_CKPT_EVERY": str(CKPT_EVERY),
+           "FT_E2E_STEP_SLEEP": "0.05",
+           "PYTHONPATH": str(REPO) + os.pathsep + os.environ.get(
+               "PYTHONPATH", "")}
+    os.environ.update(env)
+    launcher = Launcher(_contract(run_dir, 2), LocalTransport(),
+                        ft_dir=str(ft_dir), ft_heartbeat_s=0.2)
+    monitor = HeartbeatMonitor(
+        ft_dir, expected_hosts=2,
+        config=MonitorConfig(interval_s=0.2, startup_grace_s=120.0))
+    chaos = ChaosSpec(events=(
+        ChaosEvent(action="kill", at_step=KILL_AT_STEP, host=0),))
+    coord = GangCoordinator(
+        launcher, [sys.executable, WORKER],
+        policy=GangRestart(RestartBudget(1)), monitor=monitor,
+        registry=MetricRegistry(), ft_dir=ft_dir, ckpt_dir=run_dir / "ckpt",
+        poll_interval=0.02, term_grace_s=1.0, chaos=chaos)
+    t0 = time.monotonic()
+    rc = coord.run()
+    measured_wall = time.monotonic() - t0
+    assert rc == 0, "gang must finish cleanly after one recovery"
+    assert coord.chaos.done(), "the scripted kill must have fired"
+
+    # -- the acceptance report, through the real CLI ---------------------
+    from tpucfn.cli.main import main
+
+    import io
+    import contextlib
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(["obs", "goodput", "--run-dir", str(run_dir), "--json"])
+    assert rc == 0
+    rep = json.loads(buf.getvalue())
+
+    assert rep["num_hosts"] == 2
+    # buckets sum to within 5% of the wall the ledger measured (by
+    # construction the residual is float noise; 5% is the acceptance
+    # ceiling) and the ledger wall cannot exceed what the test measured.
+    assert rep["wall_s"] > 0
+    assert abs(rep["accounted_s"] - rep["wall_s"]) <= 0.05 * rep["wall_s"]
+    assert rep["wall_s"] <= measured_wall + 0.5
+    for host_rep in rep["hosts"].values():
+        assert (abs(host_rep["accounted_s"] - host_rep["wall_s"])
+                <= 0.05 * host_rep["wall_s"])
+
+    # -- the injected incident shows up as downtime + lost work ----------
+    assert rep["restart_downtime_s"] > 0
+    assert rep["lost_work_s"] > 0
+    assert rep["lost_steps"] >= 4  # 21..24 at minimum, per host >= ...
+    # every host restarted once: two ledger windows each
+    assert all(h["windows"] == 2 for h in rep["hosts"].values())
+    # the coordinator attributed it: one enriched incident row
+    [inc] = rep["incidents"]
+    assert inc["action"] == "gang_restart"
+    assert inc["downtime_s"] > 0
+    assert inc["detection_s"] is not None
+    assert inc["fleet_step"] is not None and inc["fleet_step"] >= KILL_AT_STEP
+    # the merge attributes the ledger's re-run steps to this incident
+    assert inc["lost_steps"] == rep["lost_steps"]
+    # productive work dominates a 2-host drill with one restart
+    assert 0 < rep["goodput_ratio"] <= 1
+    assert rep["productive_steps"] >= 2 * TOTAL_STEPS  # both hosts finish

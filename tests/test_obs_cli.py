@@ -156,6 +156,37 @@ def test_obs_cli_empty_run_dir(tmp_path, capsys):
     assert "no metrics or trace JSONL found" in capsys.readouterr().out
 
 
+def test_obs_goodput_flags_work_on_either_side_of_subcommand(tmp_path,
+                                                            capsys):
+    """Subparser defaults must not clobber parent-position flags:
+    `tpucfn obs --json --run-dir X goodput` and `tpucfn obs goodput
+    --run-dir X --json` are the same invocation."""
+    from tpucfn.obs.goodput import GoodputLedger
+
+    led = GoodputLedger(tmp_path / "goodput", 0)
+    led.account("step", 0.5, step=1)
+    led.close()
+    for argv in (["obs", "--json", "--run-dir", str(tmp_path), "goodput"],
+                 ["obs", "goodput", "--run-dir", str(tmp_path), "--json"]):
+        rc = main(argv)
+        assert rc == 0, argv
+        report = json.loads(capsys.readouterr().out)
+        assert report["num_hosts"] == 1, argv
+        assert report["buckets"]["productive_step"] == 0.5, argv
+    # missing --run-dir is a clean usage error on both commands
+    assert main(["obs", "goodput"]) == 2
+    assert main(["obs"]) == 2
+    capsys.readouterr()
+    # ...but an explicit --goodput-dir stands on its own (--run-dir only
+    # derives the defaults): relocated/copied ledgers need no dummy dir
+    rc = main(["obs", "goodput", "--goodput-dir",
+               str(tmp_path / "goodput"), "--json"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["num_hosts"] == 1
+    assert report["incidents"] == []  # no run dir -> no ft events default
+
+
 def test_obs_cli_explicit_dirs(fleet_run, tmp_path, capsys):
     rc = main(["obs", "--run-dir", str(tmp_path),
                "--logs-dir", str(fleet_run / "logs"),

@@ -1,0 +1,117 @@
+"""Prometheus exposition correctness pin (ISSUE 5 satellite): the
+histogram's cumulative ``le`` buckets, ``_count``/``_sum`` lines, and a
+minimal text-format checker over the full ``/metrics`` body — so a
+scraper-breaking regression fails here, not in a dashboard."""
+
+import math
+import re
+import urllib.request
+
+import pytest
+
+from tpucfn.obs import MetricRegistry
+from tpucfn.obs.server import ObsServer
+
+_SERIES = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?P<labels>\{[^}]*\})?"
+    r" (?P<value>[^ ]+)$")
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus(text: str) -> dict:
+    """Minimal Prometheus text-format checker: validates line shapes and
+    returns ``{(name, labels_tuple): float_value}``.  Raises on any line
+    that is neither a comment nor a well-formed series."""
+    out = {}
+    typed = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            assert len(line.split(" ", 3)) >= 3, line
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, mtype = line.split(" ", 3)
+            assert mtype in ("counter", "gauge", "summary", "histogram"), line
+            typed[name] = mtype
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line}"
+        m = _SERIES.match(line)
+        assert m, f"malformed series line: {line!r}"
+        labels = ()
+        if m.group("labels"):
+            body = m.group("labels")[1:-1]
+            parsed = _LABEL.findall(body)
+            # every byte of the label body must be consumed by pairs
+            rebuilt = ",".join(f'{k}="{v}"' for k, v in parsed)
+            assert rebuilt == body, f"malformed labels: {body!r}"
+            labels = tuple(parsed)
+        v = m.group("value")
+        value = (math.inf if v == "+Inf" else -math.inf if v == "-Inf"
+                 else math.nan if v == "NaN" else float(v))
+        out[(m.group("name"), labels)] = value
+    return out, typed
+
+
+def _series(parsed, name):
+    return {labels: v for (n, labels), v in parsed.items() if n == name}
+
+
+def test_histogram_cumulative_le_buckets_count_and_sum():
+    reg = MetricRegistry(labels={"host": "3"})
+    h = reg.histogram("train_step_seconds", "step time",
+                      buckets=(0.1, 0.5, 1.0))
+    for v in (0.05, 0.1, 0.3, 0.7, 2.0):  # 0.1 lands IN le=0.1 (le = <=)
+        h.observe(v)
+    parsed, typed = parse_prometheus(reg.to_prometheus())
+    assert typed["train_step_seconds"] == "histogram"
+    buckets = _series(parsed, "train_step_seconds_bucket")
+    by_le = {dict(labels)["le"]: v for labels, v in buckets.items()}
+    assert by_le == {"0.1": 2, "0.5": 3, "1.0": 4, "+Inf": 5}
+    # cumulative: monotone nondecreasing in le order
+    vals = [by_le[k] for k in ("0.1", "0.5", "1.0", "+Inf")]
+    assert vals == sorted(vals)
+    count = _series(parsed, "train_step_seconds_count")
+    total = _series(parsed, "train_step_seconds_sum")
+    assert list(count.values()) == [5]
+    assert list(total.values())[0] == pytest.approx(0.05 + 0.1 + 0.3 + 0.7 + 2.0)
+    # the Prometheus invariant: _count == the +Inf bucket
+    assert by_le["+Inf"] == list(count.values())[0]
+    # constant labels ride on every series of the family
+    for labels in buckets:
+        assert ("host", "3") in labels
+
+
+def test_full_metrics_endpoint_parses_under_the_checker():
+    reg = MetricRegistry(labels={"role": "trainer", "host": "0"})
+    reg.counter("steps_total", "steps").add(3)
+    reg.gauge("queue_depth", "depth").set(1.5)
+    s = reg.summary("ttft_seconds", "ttft")
+    for v in (0.1, 0.2, 0.3):
+        s.observe(v)
+    h = reg.histogram("lat_seconds", "latency")
+    h.observe(0.02)
+    srv = ObsServer(reg, port=0, host="127.0.0.1", role="trainer")
+    try:
+        body = urllib.request.urlopen(srv.url("/metrics"),
+                                      timeout=5).read().decode()
+    finally:
+        srv.close()
+    parsed, typed = parse_prometheus(body)  # raises on any malformed line
+    assert typed == {"steps_total": "counter", "queue_depth": "gauge",
+                     "ttft_seconds": "summary", "lat_seconds": "histogram"}
+    assert _series(parsed, "steps_total") \
+        == {(("role", "trainer"), ("host", "0")): 3.0}
+    # summary: quantile labels + _sum/_count present
+    quantiles = _series(parsed, "ttft_seconds")
+    assert {dict(l)["quantile"] for l in quantiles} == {"0.5", "0.95", "0.99"}
+    assert list(_series(parsed, "ttft_seconds_count").values()) == [3]
+
+
+def test_escaped_label_values_survive_the_checker():
+    reg = MetricRegistry(labels={"note": 'say "hi"\nback\\slash'})
+    reg.counter("c", "c").add()
+    parsed, _ = parse_prometheus(reg.to_prometheus())
+    [labels] = _series(parsed, "c")
+    assert dict(labels)["note"] == r'say \"hi\"\nback\\slash'

@@ -1,0 +1,184 @@
+"""SLOTracker (ISSUE 5): TTFT/TPOT objectives with rolling-window burn
+rate, wired into the Server request lifecycle and exported as
+``serve_slo_*`` on the registry the /metrics endpoint scrapes."""
+
+import time
+
+import pytest
+
+from tpucfn.obs import MetricRegistry
+from tpucfn.serve import Server
+from tpucfn.serve.frontend import SLOTracker
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class FakeEngine:
+    def __init__(self, max_batch=4, cache_len=64, prefill_delay=0.002,
+                 decode_delay=0.001):
+        self.max_batch = max_batch
+        self.cache_len = cache_len
+        self.prefill_delay = prefill_delay
+        self.decode_delay = decode_delay
+
+    def prefill(self, slot, prefix, bucket, temperature=0.0):
+        time.sleep(self.prefill_delay)
+        return 11
+
+    def decode(self, tokens_by_slot):
+        time.sleep(self.decode_delay)
+        return {s: 12 for s in tokens_by_slot}
+
+
+# ---- the tracker alone (fake clock) --------------------------------------
+
+def test_burn_rate_is_window_violation_rate_over_budget():
+    clk = FakeClock()
+    t = SLOTracker(MetricRegistry(), ttft_slo_s=0.2, tpot_slo_s=0.05,
+                   objective=0.9, window_s=10.0, clock=clk)
+    t.record(0.1, 0.01)  # both ok
+    t.record(0.5, 0.01)  # ttft violation
+    t.record(0.1, 0.10)  # tpot violation
+    snap = t.snapshot()
+    assert snap["requests"] == 3 and snap["window_requests"] == 3
+    # 1/3 violations over a 0.1 error budget
+    assert snap["ttft"]["burn_rate"] == pytest.approx((1 / 3) / 0.1)
+    assert snap["tpot"]["burn_rate"] == pytest.approx((1 / 3) / 0.1)
+    assert snap["ttft"]["violations_total"] == 1
+    assert snap["tpot"]["violations_total"] == 1
+
+
+def test_window_evicts_and_burn_rate_recovers():
+    clk = FakeClock()
+    t = SLOTracker(MetricRegistry(), ttft_slo_s=0.2, objective=0.99,
+                   window_s=10.0, clock=clk)
+    t.record(9.9, 0.0)  # violation at t=0
+    assert t.snapshot()["ttft"]["burn_rate"] == pytest.approx(100.0)
+    clk.t = 5.0
+    t.record(0.1, 0.0)  # ok at t=5: violation still in window
+    assert t.snapshot()["ttft"]["burn_rate"] == pytest.approx(50.0)
+    clk.t = 11.0  # t=0 sample ages out; only the ok one remains
+    snap = t.snapshot()
+    assert snap["window_requests"] == 1
+    assert snap["ttft"]["burn_rate"] == 0.0
+    # totals are monotonic — the window forgets, the counters do not
+    assert snap["ttft"]["violations_total"] == 1
+
+
+def test_none_scores_as_violation_and_objective_validated():
+    t = SLOTracker(MetricRegistry(), ttft_slo_s=1.0, tpot_slo_s=1.0)
+    t.record(None, None)  # expired request: no usable answer
+    snap = t.snapshot()
+    assert snap["ttft"]["violations_total"] == 1
+    assert snap["tpot"]["violations_total"] == 1
+    with pytest.raises(ValueError):
+        SLOTracker(MetricRegistry(), objective=1.0)
+
+
+def test_cli_rejects_out_of_range_objective_as_usage_error():
+    """`tpucfn serve --slo-objective 1` must be an argparse usage error
+    (exit 2, no traceback) — not SLOTracker's ValueError escaping after
+    the obs port is already bound."""
+    from tpucfn.cli.main import main
+
+    for bad in ("1", "0", "1.5", "nan"):
+        with pytest.raises(SystemExit) as ei:
+            main(["serve", "--preset", "tiny", "--synthetic", "1",
+                  "--slo-objective", bad])
+        assert ei.value.code == 2
+
+
+def test_burn_rate_on_metrics_scrape_decays_without_traffic():
+    """A /metrics scrape reads the gauges directly (never snapshot());
+    the window gauges must be computed AS OF the scrape, or an alert on
+    sustained burn keeps firing on dead traffic forever."""
+    clk = FakeClock()
+    reg = MetricRegistry()
+    t = SLOTracker(reg, ttft_slo_s=0.2, objective=0.99, window_s=10.0,
+                   clock=clk)
+    t.record(9.9, 0.0)  # violation
+    m = reg.varz()["metrics"]
+    assert m["serve_slo_ttft_burn_rate"] == pytest.approx(100.0)
+    assert m["serve_slo_window_requests"] == 1
+    clk.t = 60.0  # no further requests; the window is logically empty
+    m = reg.varz()["metrics"]
+    assert m["serve_slo_ttft_burn_rate"] == 0.0
+    assert m["serve_slo_window_requests"] == 0
+    assert m["serve_slo_ttft_violations_total"] == 1  # counters keep history
+    # the text exposition path reads the same computed values
+    assert "serve_slo_ttft_burn_rate 0.0" in reg.to_prometheus()
+
+
+def test_slo_metrics_exported_with_targets():
+    reg = MetricRegistry()
+    SLOTracker(reg, ttft_slo_s=0.25, tpot_slo_s=0.04, objective=0.95)
+    m = reg.varz()["metrics"]
+    assert m["serve_slo_ttft_target_s"] == 0.25
+    assert m["serve_slo_tpot_target_s"] == 0.04
+    assert m["serve_slo_objective"] == 0.95
+    text = reg.to_prometheus()
+    for name in ("serve_slo_ttft_burn_rate", "serve_slo_tpot_burn_rate",
+                 "serve_slo_requests_total",
+                 "serve_slo_ttft_violations_total"):
+        assert f"\n{name} " in "\n" + text, name
+
+
+def test_second_tracker_on_shared_registry_rebinds_not_raises():
+    """A process that rebuilds a Server against the shared
+    default_registry() constructs a second SLOTracker on the same
+    registry: like every other instrument this must get-or-create, with
+    the LIVE tracker's window backing the computed gauges (counters
+    stay shared and cumulative)."""
+    reg = MetricRegistry()
+    clock = FakeClock()
+    a = SLOTracker(reg, ttft_slo_s=0.1, tpot_slo_s=0.1, objective=0.9,
+                   clock=clock)
+    a.record(ttft_s=1.0, tpot_s=1.0)  # violation in A's window
+    b = SLOTracker(reg, ttft_slo_s=0.1, tpot_slo_s=0.1, objective=0.9,
+                   clock=clock)  # must not raise
+    m = reg.varz()["metrics"]
+    # computed gauges now read B's (empty) window, not A's
+    assert m["serve_slo_ttft_burn_rate"] == 0.0
+    assert m["serve_slo_window_requests"] == 0.0
+    b.record(ttft_s=1.0, tpot_s=1.0)
+    m = reg.varz()["metrics"]
+    assert m["serve_slo_ttft_burn_rate"] == pytest.approx(10.0)
+    # the violation/request counters were shared all along: A's one
+    # request plus B's one request
+    assert m["serve_slo_requests_total"] == 2.0
+    assert m["serve_slo_ttft_violations_total"] == 2.0
+
+
+# ---- wired into the Server lifecycle -------------------------------------
+
+def test_server_scores_completed_requests():
+    server = Server(FakeEngine(), num_blocks=64, block_size=8,
+                    ttft_slo_s=10.0, tpot_slo_s=10.0)
+    reqs = [server.submit([1] * n, max_new_tokens=3) for n in (3, 5)]
+    server.run_until_idle()
+    assert all(r.error is None for r in reqs)
+    snap = server.slo.snapshot()
+    assert snap["requests"] == 2
+    assert snap["ttft"]["violations_total"] == 0
+    assert snap["tpot"]["violations_total"] == 0
+    assert snap["ttft"]["burn_rate"] == 0.0
+
+
+def test_server_tight_targets_burn_and_expired_counts_both():
+    server = Server(FakeEngine(), num_blocks=64, block_size=8,
+                    ttft_slo_s=1e-6, tpot_slo_s=1e-6)
+    ok = server.submit([1, 2, 3], max_new_tokens=2)
+    dead = server.submit([4, 5, 6], max_new_tokens=2, deadline_s=-1.0)
+    server.run_until_idle()
+    assert ok.error is None and dead.error is not None
+    snap = server.slo.snapshot()
+    assert snap["requests"] == 2  # completed + expired; rejected excluded
+    assert snap["ttft"]["violations_total"] == 2
+    assert snap["tpot"]["violations_total"] == 2
+    assert snap["ttft"]["burn_rate"] == pytest.approx(100.0)  # 0.99 objective

@@ -34,6 +34,21 @@ from tpucfn.provision import FakeControlPlane, Provisioner
 from tpucfn.spec import ClusterSpec
 
 
+def _slo_objective(s: str) -> float:
+    """argparse type for ``--slo-objective``: the fraction must leave a
+    nonzero error budget (burn rate divides by 1 − objective), so 0 and
+    1 are usage errors, not tracebacks from SLOTracker's constructor."""
+    try:
+        v = float(s)
+    except ValueError:
+        raise argparse.ArgumentTypeError(f"not a number: {s!r}")
+    if not 0.0 < v < 1.0:
+        raise argparse.ArgumentTypeError(
+            f"objective must be in (0, 1) exclusive, got {v} — 1.0 has "
+            "no error budget to burn")
+    return v
+
+
 def _control_plane(args):
     if getattr(args, "backend", "fake") == "gcp":
         from tpucfn.provision import GcpQueuedResourceControlPlane
@@ -333,7 +348,9 @@ def cmd_serve(args) -> int:
                         max_queued_tokens=args.max_queued_tokens,
                         registry=registry, tracer=tracer,
                         prefix_cache=args.prefix_cache,
-                        max_prefill_batch=args.max_prefill_batch)
+                        max_prefill_batch=args.max_prefill_batch,
+                        ttft_slo_s=args.slo_ttft, tpot_slo_s=args.slo_tpot,
+                        slo_objective=args.slo_objective)
         reqs = []
         for p in prompts:
             try:
@@ -355,7 +372,8 @@ def cmd_serve(args) -> int:
     print(f"served {ok}/{len(prompts)} requests "
           f"({len(prompts) - len(reqs)} rejected at submit)",
           file=sys.stderr)
-    print(_json.dumps(server.metrics.snapshot()))
+    print(_json.dumps({**server.metrics.snapshot(),
+                       "slo": server.slo.snapshot()}))
     # Partial failure is failure: scripts wrapping this must see expired/
     # rejected requests in the exit code, not just in the JSON.
     return 0 if ok == len(prompts) else 1
@@ -370,23 +388,96 @@ def cmd_obs(args) -> int:
     import json as _json
     import time as _time
 
-    from tpucfn.obs import read_trace_dir
     from tpucfn.obs.aggregate import (
+        JsonlTailer,
+        apply_clock_skew,
+        estimate_clock_skew,
         host_straggler_report,
         merge_step_timeline,
-        read_metrics_dir,
         render_table,
         request_breakdown,
+        select_skew_reference_beats,
         step_spans_by_host,
     )
+    from tpucfn.ft.heartbeat import HB_GLOB
+    from tpucfn.obs.goodput import host_id_from_path
 
+    if not args.run_dir:
+        print("error: --run-dir required", file=sys.stderr)
+        return 2
     run_dir = Path(args.run_dir).expanduser()
     logs_dir = Path(args.logs_dir) if args.logs_dir else run_dir / "logs"
     trace_dir = Path(args.trace_dir) if args.trace_dir else run_dir / "trace"
+    ft_dir = run_dir / "ft"
+
+    # Incremental tail state (ISSUE 5 satellite): --watch keeps per-file
+    # byte offsets and appends only NEW complete lines each tick instead
+    # of re-reading every file from byte 0; one-shot mode is simply the
+    # first poll.
+    tailer = JsonlTailer()
+    by_host: dict[str, list[dict]] = {}
+    events_by_file: dict = {}
+    hb_by_host: dict[int, list[dict]] = {}
+    hb_last: dict[int, tuple] = {}  # host -> (seq, step) of last KEPT beat
+    # Per-domain recompute cache: a tick that tails nothing new must not
+    # redo O(run-length) merge/skew/sort work (the same discipline the
+    # incremental tailer applies to the read side).
+    cache = {"skew": {}, "events": [], "report": None}
+
+    def _extend_sorted(_k, lst: list, recs: list) -> int:
+        # per-file start order, as read_trace_dir does: spans recorded
+        # retroactively (queue_wait) land in timeline order.  Sorted
+        # HERE so only files that produced records this tick re-sort;
+        # untouched files reuse their list as-is.
+        lst.extend(recs)
+        lst.sort(key=lambda e: e.get("start", 0.0))
+        return len(recs)
+
+    def _keep_hb(host: int, lst: list, recs: list) -> int:
+        """Accumulate only the beats estimate_clock_skew can use as
+        reference points (shared rule: select_skew_reference_beats) so
+        hours of 2 Hz beats do not pile up in watch-mode memory.
+        Returns how many were kept (skew may change)."""
+        kept, hb_last[host] = select_skew_reference_beats(
+            recs, hb_last.get(host, (None, None)))
+        lst.extend(kept)
+        return len(kept)
 
     def one_pass() -> dict:
-        by_host = read_metrics_dir(logs_dir) if logs_dir.is_dir() else {}
-        events = read_trace_dir(trace_dir) if trace_dir.is_dir() else []
+        new_logs = new_trace = new_hb = False
+        if logs_dir.is_dir():
+            new_logs = tailer.poll_into(
+                sorted(logs_dir.glob("*.jsonl")), by_host,
+                key_fn=lambda p: p.stem)
+        if trace_dir.is_dir():
+            new_trace = tailer.poll_into(
+                sorted(trace_dir.glob("trace-*.jsonl")), events_by_file,
+                extend=_extend_sorted)
+        # Heartbeats ride the same incremental tailer as everything
+        # else, compacted to the skew-reference beats on arrival.
+        if ft_dir.is_dir():
+            new_hb = tailer.poll_into(
+                sorted(ft_dir.glob(HB_GLOB)), hb_by_host,
+                key_fn=host_id_from_path, extend=_keep_hb,
+                on_drop=lambda h: hb_last.pop(h, None))
+        if not (new_logs or new_trace or new_hb) and cache["report"]:
+            return cache["report"]  # idle tick: nothing to redo
+        # Cross-host span ordering is skew-tolerant (ISSUE 5 satellite):
+        # heartbeat wall-times give the reference points when the ft
+        # plane ran; lockstep step spans otherwise.  The estimate is
+        # APPLIED, not just reported — downstream views see events on
+        # the corrected fleet clock (ts_adj), in corrected order.
+        # Both the estimate and the corrected merge are cached: only a
+        # tick that tailed new trace/heartbeat records pays for them.
+        if new_trace or new_hb or cache["report"] is None:
+            events = []
+            for p in sorted(events_by_file):
+                events.extend(events_by_file[p])
+            skew = estimate_clock_skew(events, hb_by_host or None)
+            if any(skew.values()):
+                events = apply_clock_skew(events, skew)
+            cache["skew"], cache["events"] = skew, events
+        skew, events = cache["skew"], cache["events"]
         # Trainer trace spans feed the same views when the metrics JSONL
         # is absent (span-only runs); with both present the metrics JSONL
         # wins for the timeline (same host under two labels must not be
@@ -397,6 +488,7 @@ def cmd_obs(args) -> int:
             "logs_dir": str(logs_dir),
             "trace_dir": str(trace_dir),
             "hosts": sorted(timeline_src),
+            "clock_skew_s": skew,
             "timeline": merge_step_timeline(timeline_src, key="step_time",
                                             last=args.steps),
             "stragglers": host_straggler_report(
@@ -407,6 +499,7 @@ def cmd_obs(args) -> int:
                 span_hosts, keys=("step_time", "data_wait_time"))
         rows, agg = request_breakdown(events)
         report["requests"], report["request_aggregate"] = rows, agg
+        cache["report"] = report
         return report
 
     def show(report: dict) -> None:
@@ -415,6 +508,10 @@ def cmd_obs(args) -> int:
             return
         print(f"# fleet view  logs={report['logs_dir']} "
               f"trace={report['trace_dir']}")
+        if len(report.get("clock_skew_s", {})) >= 2:
+            print("clock skew (s vs fleet median): " + "  ".join(
+                f"{h}={s:+.3f}" for h, s in
+                sorted(report["clock_skew_s"].items())))
         if report["timeline"]:
             print(f"\n== merged step timeline (last {args.steps}) ==")
             print(render_table(report["timeline"],
@@ -445,6 +542,88 @@ def cmd_obs(args) -> int:
                 or report["requests"]):
             print("no metrics or trace JSONL found "
                   f"under {report['logs_dir']} / {report['trace_dir']}")
+
+    show(one_pass())
+    while args.watch:
+        _time.sleep(args.watch)
+        print()
+        show(one_pass())
+    return 0
+
+
+def cmd_obs_goodput(args) -> int:
+    """The goodput ledger report (ISSUE 5 tentpole): wall-clock
+    decomposed into productive step / compile / data_wait / ckpt / idle
+    / lost_work / restart_downtime buckets that SUM to wall time, per
+    host and fleet-averaged, with incident attribution from the ft
+    plane's events.jsonl — the answer to "what fraction of paid
+    TPU-seconds trained the model, and who stole the rest"."""
+    import json as _json
+    import time as _time
+
+    from tpucfn.obs.aggregate import JsonlTailer
+    from tpucfn.obs.goodput import (LEDGER_GLOB, host_id_from_path,
+                                    merge_goodput, render_goodput)
+
+    # --run-dir only derives the defaults, so explicit --goodput-dir
+    # (relocated/copied ledgers) stands on its own.
+    if not args.run_dir and not args.goodput_dir:
+        print("error: --run-dir or --goodput-dir required",
+              file=sys.stderr)
+        return 2
+    run_dir = Path(args.run_dir).expanduser() if args.run_dir else None
+    goodput_dir = (Path(args.goodput_dir) if args.goodput_dir
+                   else run_dir / "goodput")
+    ft_events = (Path(args.ft_events) if args.ft_events
+                 else run_dir / "ft" / "events.jsonl" if run_dir
+                 else None)
+
+    # Same incremental-tail discipline as cmd_obs (ISSUE 5 satellite):
+    # --watch appends only NEW complete lines per tick instead of
+    # re-parsing O(run-length) ledger history; one-shot mode is simply
+    # the first poll.
+    tailer = JsonlTailer()
+    by_host: dict[int, list[dict]] = {}
+    ev_store: dict[str, list[dict]] = {}
+    # Idle-tick cache, same discipline as cmd_obs: a tick that tailed
+    # nothing new must not re-merge O(run-length) ledger history.
+    cache: dict = {"report": None}
+
+    def one_pass() -> dict:
+        dirty = cache["report"] is None
+        if goodput_dir.is_dir():
+            dirty |= tailer.poll_into(
+                sorted(goodput_dir.glob(LEDGER_GLOB)), by_host,
+                key_fn=host_id_from_path)
+        if ft_events is not None and ft_events.is_file():
+            dirty |= tailer.poll_into([ft_events], ev_store,
+                                      key_fn=lambda p: "ft")
+        if dirty:
+            cache["report"] = merge_goodput(
+                by_host, ev_store.get("ft", ()),
+                skipped_lines=tailer.skipped)
+        return cache["report"]
+
+    def show(report: dict) -> None:
+        if args.json:
+            print(_json.dumps(report))
+        elif report["num_hosts"] == 0:
+            print(f"no goodput ledgers under {goodput_dir} "
+                  "(runs write them via examples/common.py; see README "
+                  "Observability → Goodput)")
+            # ft incidents can exist without any ledger (older worker,
+            # misplaced goodput dir) — exactly the broken-run case the
+            # operator is diagnosing; don't hide them.
+            if report["incidents"]:
+                print(f"{len(report['incidents'])} ft incident(s) in "
+                      f"{ft_events} (downtime "
+                      f"{report['incident_downtime_s']:.2f}s) — "
+                      "run --json for detail")
+            if report["skipped_lines"]:
+                print(f"skipped {report['skipped_lines']} "
+                      "undecodable line(s)")
+        else:
+            print(render_goodput(report))
 
     show(one_pass())
     while args.watch:
@@ -722,6 +901,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="same-bucket prefills fused into one jitted call "
                          "(the engine's fixed lane count; 1 disables)")
     sv.add_argument("--deadline-s", type=float, default=None)
+    sv.add_argument("--slo-ttft", type=float, default=0.5, metavar="SECONDS",
+                    help="TTFT SLO target; burn rate exported as "
+                         "serve_slo_ttft_burn_rate")
+    sv.add_argument("--slo-tpot", type=float, default=0.05,
+                    metavar="SECONDS",
+                    help="per-output-token SLO target")
+    sv.add_argument("--slo-objective", type=_slo_objective, default=0.99,
+                    help="fraction of requests that must meet each target "
+                         "(exclusive (0, 1))")
     sv.add_argument("--seed", type=int, default=0)
     sv.add_argument("--obs-port", type=int, default=None, metavar="PORT",
                     help="serve /metrics, /healthz, /varz on PORT while the "
@@ -735,7 +923,9 @@ def build_parser() -> argparse.ArgumentParser:
         "obs",
         help="aggregate per-host metrics/trace JSONL into one fleet view "
              "(merged step timeline, stragglers, request latency breakdown)")
-    ob.add_argument("--run-dir", required=True,
+    # not argparse-required: `tpucfn obs goodput` is a subcommand with
+    # its own --run-dir; cmd_obs validates for the fleet view itself.
+    ob.add_argument("--run-dir",
                     help="the training/serving --run-dir (expects logs/ "
                          "and trace/ beneath unless overridden)")
     ob.add_argument("--logs-dir", help="metrics JSONL dir (default RUN/logs)")
@@ -745,8 +935,32 @@ def build_parser() -> argparse.ArgumentParser:
     ob.add_argument("--json", action="store_true",
                     help="emit the full report as one JSON object")
     ob.add_argument("--watch", type=float, default=0, metavar="SECONDS",
-                    help="re-read and re-render every N seconds (tail mode)")
+                    help="re-render every N seconds, tailing files "
+                         "incrementally from their last offset")
     ob.set_defaults(fn=cmd_obs)
+    obsub = ob.add_subparsers(dest="obs_command")
+    og = obsub.add_parser(
+        "goodput",
+        help="per-run wall-clock ledger: productive/compile/data_wait/"
+             "ckpt/idle/lost_work/restart_downtime buckets that sum to "
+             "wall time, plus ft incident attribution")
+    # SUPPRESS defaults on the flags the parent `obs` parser also owns:
+    # argparse applies subparser defaults AFTER the parent's values are
+    # parsed, so a plain default here would silently clobber
+    # `tpucfn obs --json --run-dir X goodput` back to json=False.
+    og.add_argument("--run-dir", default=argparse.SUPPRESS,
+                    help="the training --run-dir (expects goodput/ and "
+                         "optionally ft/events.jsonl beneath)")
+    og.add_argument("--goodput-dir",
+                    help="explicit ledger dir (default RUN/goodput)")
+    og.add_argument("--ft-events",
+                    help="ft incident log (default RUN/ft/events.jsonl)")
+    og.add_argument("--json", action="store_true", default=argparse.SUPPRESS,
+                    help="emit the full report as one JSON object")
+    og.add_argument("--watch", type=float, default=argparse.SUPPRESS,
+                    metavar="SECONDS",
+                    help="re-read and re-render every N seconds")
+    og.set_defaults(fn=cmd_obs_goodput)
 
     return p
 

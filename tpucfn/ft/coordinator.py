@@ -443,6 +443,20 @@ class GangCoordinator(ChaosTarget):
         self.ft_mttr_s.observe(mttr)
         self._event("recovered", incident=incident,
                     action=decision.action.value, mttr_s=round(mttr, 4))
+        # Goodput attribution (ISSUE 5): one ledger row per incident so
+        # `tpucfn obs goodput` can name who stole the fleet's seconds.
+        # detection_s is the estimated failure→detect latency: a HANG is
+        # by construction dead_after_s of silent heartbeats old when the
+        # verdict lands; a CRASH is caught within one poll tick.
+        detection_s = self.poll_interval
+        if self.monitor is not None and any(
+                f.kind is FailureKind.HANG for f in failures):
+            detection_s = self.monitor.config.dead_s
+        self._event("goodput_incident", incident=incident,
+                    action=decision.action.value,
+                    downtime_s=round(mttr, 4),
+                    detection_s=round(detection_s, 4),
+                    fleet_step=self._last_fleet_step)
         if self.tracer is not None:
             self.tracer.record("ft_recover", start=t_detect, dur_s=mttr,
                                trace_id=incident,

@@ -38,7 +38,13 @@ import time
 from pathlib import Path
 from typing import Callable
 
+# The heartbeat file-naming convention in its three forms — writer
+# (heartbeat_path), reader regex, and directory glob (used by the
+# `tpucfn obs` skew-reference ingestion).  They MUST agree; renaming
+# one without the others silently degrades skew estimation to its
+# span fallback.
 _HB_FILE = re.compile(r"^hb-host(\d+)\.jsonl$")
+HB_GLOB = "hb-host*.jsonl"
 
 # Read at most this much of a heartbeat file's tail per observe() — the
 # monitor only needs the last line, and the files grow for the whole run.

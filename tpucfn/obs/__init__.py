@@ -5,6 +5,12 @@ from tpucfn.obs.metrics import (  # noqa: F401
     StepTimer,
     Summary,
 )
+from tpucfn.obs.goodput import (  # noqa: F401
+    GoodputLedger,
+    goodput_report,
+    merge_goodput,
+    read_goodput_dir,
+)
 from tpucfn.obs.profiler import (  # noqa: F401
     enable_compile_cache,
     profile_steps,

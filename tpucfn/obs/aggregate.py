@@ -23,6 +23,7 @@ import statistics
 from pathlib import Path
 from typing import Iterable
 
+from tpucfn.obs.goodput import parse_jsonl_line
 from tpucfn.obs.trace import read_trace_file
 
 
@@ -166,6 +167,276 @@ def step_spans_by_host(events: Iterable[dict]) -> dict[str, list[dict]]:
             rec["step"] = e["trace_id"]
         by_host.setdefault(host, []).append(rec)
     return by_host
+
+
+def select_skew_reference_beats(
+        recs: Iterable[dict],
+        state: tuple = (None, None)) -> tuple[list[dict], tuple]:
+    """The heartbeats usable as clock-skew reference points: the first
+    beat at each ``step`` value, plus any ``seq`` reset (incarnation
+    boundary — the reset beat must survive so downstream incarnation
+    counting still sees the boundary).  Single source of truth shared
+    by :func:`estimate_clock_skew` and the watch-mode compaction in
+    ``tpucfn obs`` — if the two drifted apart, compaction would discard
+    beats the estimator needs and silently bias the skew.
+
+    Returns ``(kept, new_state)``; thread ``new_state`` back in for
+    incremental (tailing) use.  Selection is idempotent: running it
+    over an already-selected stream keeps every beat.
+    """
+    prev_seq, prev_step = state
+    kept = []
+    for r in recs:
+        seq = r.get("seq")
+        if not isinstance(seq, int) or "t" not in r:
+            continue
+        reset = prev_seq is not None and seq <= prev_seq
+        step = r.get("step")
+        if reset or (step is not None and step != prev_step):
+            kept.append(r)
+            prev_step = step
+        prev_seq = seq
+    return kept, (prev_seq, prev_step)
+
+
+def estimate_clock_skew(events: Iterable[dict],
+                        heartbeats_by_host: dict[int, list[dict]]
+                        | None = None) -> dict[str, float]:
+    """Per-host wall-clock skew estimate (seconds; positive = that
+    host's clock runs ahead of the fleet median).
+
+    Cross-host span ordering rides on each host's wall ``ts``; hosts'
+    clocks drift, so raw ``ts`` ordering lies.  The reference points
+    must be events that truly happen fleet-simultaneously, and the only
+    such anchor in the record streams is the **global training step**:
+    an SPMD gang executes step N in lockstep (the collectives force
+    it).  Two sources carry it, preferred in order:
+
+    * **Heartbeats** — each beat stamps the loop's current ``step``;
+      the first beat observing step N lands within one heartbeat
+      interval of the host reaching N.  (Pairing beats by ``seq``
+      instead would conflate writer *start stagger* — a host whose jax
+      import ran seconds longer — with clock skew and mis-order events
+      whose raw timestamps were correct, so beats without a step, e.g.
+      a serve host's, contribute nothing.)
+    * **Step spans** — the per-step trace spans' wall times, same
+      lockstep argument without the beat-interval quantization.
+
+    Returns ``{host_label: skew_s}``; subtract the skew from a host's
+    ``ts`` to place its events on the fleet's median clock
+    (:func:`apply_clock_skew`).
+    """
+    # reference_points: host -> {key: wall_t}
+    points: dict[str, dict] = {}
+    if heartbeats_by_host:
+        for host, recs in heartbeats_by_host.items():
+            # HeartbeatWriter restarts seq from 1 per incarnation while
+            # appending to the SAME file, and a restarted trainer REWINDS
+            # to the checkpoint step — so key by (incarnation, step):
+            # a restarted host's post-downtime re-run of step N must not
+            # overwrite its first-incarnation reference point (it would
+            # read as tens of seconds of phantom skew), and its second
+            # incarnation only matches peers that restarted with it
+            # (gang restart) — a solo restart's unmatched points are
+            # simply dropped by the >=2-hosts filter below.
+            pts = {}
+            incarnation, prev_seq, prev_step = 0, None, None
+            kept, _ = select_skew_reference_beats(recs)
+            for r in kept:
+                if prev_seq is not None and r["seq"] <= prev_seq:
+                    incarnation += 1
+                    prev_step = None
+                prev_seq = r["seq"]
+                step = r.get("step")
+                if step is not None and step != prev_step:
+                    pts[(incarnation, step)] = float(r["t"])
+                    prev_step = step
+            if pts:
+                points[f"host{host}"] = pts
+    if len(points) < 2:
+        # Fewer than two hosts have usable heartbeats (one hb file
+        # missing/torn still means NO cross-host reference) — fall back
+        # to step spans wholesale rather than mixing point sources.
+        points = {}
+        for e in events:
+            if (e.get("kind") == "span" and e.get("name") == "step"
+                    and e.get("trace_id") is not None
+                    and e.get("ts") is not None):
+                host = (f"host{e['host']}" if e.get("host") is not None
+                        else "host?")
+                points.setdefault(host, {})[e["trace_id"]] = float(e["ts"])
+    if len(points) < 2:
+        return {h: 0.0 for h in points}
+    # per shared key, the fleet median; per host, median offset from it
+    all_keys: dict = {}
+    for pts in points.values():
+        for k, t in pts.items():
+            all_keys.setdefault(k, []).append(t)
+    medians = {k: statistics.median(ts) for k, ts in all_keys.items()
+               if len(ts) >= 2}
+    skew = {}
+    for host, pts in sorted(points.items()):
+        offsets = [t - medians[k] for k, t in pts.items() if k in medians]
+        skew[host] = statistics.median(offsets) if offsets else 0.0
+    return skew
+
+
+def apply_clock_skew(events: list[dict],
+                     skew: dict[str, float]) -> list[dict]:
+    """Events sorted on the skew-corrected fleet clock, each annotated
+    with ``ts_adj`` — the cross-host-comparable timestamp the merged
+    timeline orders by (original dicts are not mutated).
+
+    Each event's ``mono`` (the write instant on its host's monotonic
+    clock) breaks same-instant ties within a host: wall ``ts`` is
+    reconstructed from two clock reads and can collide or invert for
+    back-to-back writes (retroactively recorded spans, a stepping NTP
+    clock), while ``mono`` strictly orders one process's writes.
+    Monotonic origins are per-process, so ``mono`` is only consulted
+    when the corrected wall times actually tie."""
+    out = []
+    for e in events:
+        host = f"host{e['host']}" if e.get("host") is not None else "host?"
+        ts = e.get("ts")
+        adj = (ts - skew.get(host, 0.0)) if ts is not None else None
+        out.append({**e, "ts_adj": adj})
+    out.sort(key=lambda e: (e["ts_adj"] is None, e["ts_adj"] or 0.0,
+                            e.get("mono") is None, e.get("mono") or 0.0))
+    return out
+
+
+class JsonlTailer:
+    """Incremental multi-file JSONL reader for ``--watch`` mode.
+
+    ``tpucfn obs --watch`` used to re-read every metrics/trace file from
+    byte 0 on each tick — O(run length) per refresh.  This keeps a byte
+    offset per file and yields only complete NEW lines each poll:
+
+    * a torn tail (writer mid-append) is left in place — the offset
+      only advances past the last ``\\n``, so the partial line is
+      re-read whole on a later tick (same tolerance as the heartbeat
+      reader);
+    * an undecodable complete line is skipped and counted
+      (:attr:`skipped`), never raised on;
+    * a file that SHRANK (rotated/truncated) resets to byte 0 — stale
+      offsets must not silently hide a restarted writer;
+    * a file whose first bytes CHANGED resets too: a restarted writer
+      (Tracer opens with truncate) that regrows PAST the stored offset
+      between two polls never shrinks from the tailer's point of view,
+      so the size check alone would resume mid-stream inside the new
+      file — the head signature betrays the swap.
+    """
+
+    _HEAD_SIG_LEN = 64
+
+    def __init__(self):
+        self._offsets: dict[Path, int] = {}
+        self._heads: dict[Path, bytes] = {}  # first-bytes signature
+        self.skipped = 0
+        # files whose size shrank on the LAST poll: the re-read restarts
+        # from byte 0, so a caller holding accumulated records for the
+        # file must drop them first or every old record double-counts.
+        self.truncated: set[Path] = set()
+
+    def poll(self, paths: Iterable[str | Path]) -> dict[Path, list[dict]]:
+        """New records per file since the last poll (files appear in the
+        result only when they produced records).  Check
+        :attr:`truncated` after each call for files that restarted."""
+        out: dict[Path, list[dict]] = {}
+        self.truncated = set()
+        for p in paths:
+            p = Path(p)
+            try:
+                size = p.stat().st_size
+            except OSError:
+                continue
+            off = self._offsets.get(p, 0)
+            if size < off:  # truncated/rotated: start over
+                # Persist the reset NOW: if the regrown file has no
+                # complete line yet this poll, the stale offset would
+                # otherwise survive, and a file that later regrows PAST
+                # it would resume mid-stream — silently dropping the new
+                # file's head (and starting mid-line).
+                off = self._offsets[p] = 0
+                self.truncated.add(p)
+                self._heads.pop(p, None)
+            head = self._heads.get(p) if off else None
+            if size == off and not head:
+                continue
+            try:
+                with open(p, "rb") as f:
+                    if head and f.read(len(head)) != head:
+                        # Truncate-then-regrow past the stored offset:
+                        # size never dipped below `off`, only the first
+                        # bytes changed.  Restart from byte 0.
+                        off = self._offsets[p] = 0
+                        self.truncated.add(p)
+                        self._heads.pop(p, None)
+                    if size == off:
+                        continue
+                    f.seek(off)
+                    chunk = f.read(size - off)
+            except OSError:
+                continue
+            # only consume up to the last complete line
+            nl = chunk.rfind(b"\n")
+            if nl < 0:
+                continue  # torn tail only; retry next tick
+            if off == 0:  # consumed bytes only — immutable once written
+                self._heads[p] = chunk[: min(self._HEAD_SIG_LEN, nl + 1)]
+            self._offsets[p] = off + nl + 1
+            recs = []
+            for raw in chunk[: nl + 1].splitlines():
+                raw = raw.strip()
+                if not raw:
+                    continue
+                rec = parse_jsonl_line(raw)
+                if rec is None:
+                    self.skipped += 1
+                else:
+                    recs.append(rec)
+            if recs:
+                out[p] = recs
+        return out
+
+    def poll_into(self, paths: Iterable[str | Path], store: dict,
+                  key_fn=None, extend=None, on_drop=None) -> bool:
+        """:meth:`poll` plus the accumulate discipline every ``--watch``
+        domain repeats: a truncated file's accumulated records are
+        dropped BEFORE its re-read records are appended (the other
+        order double-counts history), and the caller learns whether
+        anything actually changed (the idle-tick recompute caches key
+        off it).
+
+        ``key_fn(path)`` maps a file to its ``store`` key (return None
+        to skip the file); ``extend(key, lst, recs)`` appends and
+        returns how many records it kept (default keeps all — a
+        compacting extend that kept nothing does not dirty the store);
+        ``on_drop(key)`` clears caller state beyond the store entry.
+        """
+        key_fn = key_fn or (lambda p: p)
+        dirty = False
+        new = self.poll(paths)
+        for p in self.truncated:
+            k = key_fn(p)
+            if k is None:
+                continue
+            store.pop(k, None)
+            if on_drop is not None:
+                on_drop(k)
+            dirty = True
+        for p, recs in new.items():
+            k = key_fn(p)
+            if k is None:
+                continue
+            lst = store.setdefault(k, [])
+            if extend is not None:
+                if extend(k, lst, recs):
+                    dirty = True
+            else:
+                lst.extend(recs)
+                dirty = True
+        return dirty
 
 
 def render_table(rows: list[dict], columns: list[str],

@@ -62,6 +62,36 @@ class Gauge:
         self.value = float(v)
 
 
+class ComputedGauge(Gauge):
+    """Gauge whose value is computed at read time by a callback.
+
+    For series that must reflect live state as of the scrape instant —
+    a rolling-window burn rate frozen at the last write would keep an
+    alert firing on dead traffic forever.  Registered via
+    ``MetricRegistry.computed_gauge``; exposition treats it exactly
+    like a :class:`Gauge`."""
+
+    def __init__(self, name: str = "", fn=lambda: 0.0):
+        self._fn = fn
+        super().__init__(name)
+        self._init_done = True
+
+    @property
+    def value(self) -> float:
+        return float(self._fn())
+
+    @value.setter
+    def value(self, v):
+        # Gauge.__init__ assigns 0.0 — tolerated; afterwards a write is
+        # a name collision (someone fetched this via registry.gauge()
+        # and called set()) and must NOT vanish silently.
+        if getattr(self, "_init_done", False):
+            raise AttributeError(
+                f"gauge {self.name!r} is computed at read time; set() "
+                "writes would be silently shadowed — it is registered "
+                "via computed_gauge() elsewhere")
+
+
 class Summary:
     """Streaming distribution (TTFT, per-request latency): count/sum
     always exact; percentiles over a bounded reservoir of the most

@@ -19,7 +19,8 @@ import math
 import re
 import threading
 
-from tpucfn.obs.metrics import Counter, Gauge, Summary, nearest_rank
+from tpucfn.obs.metrics import (Counter, ComputedGauge, Gauge, Summary,
+                                nearest_rank)
 
 # Latency-flavored defaults (seconds): sub-ms to tens of seconds, the
 # span of a TTFT or a training step on real hardware.
@@ -168,6 +169,17 @@ class MetricRegistry:
 
     def gauge(self, name: str, help: str = "") -> Gauge:
         return self._get_or_create(name, Gauge, help, Gauge)
+
+    def computed_gauge(self, name: str, fn, help: str = "") -> ComputedGauge:
+        """Gauge whose value is ``fn()`` at read time.  Get-or-create
+        like every other instrument, but the callback is rebound on
+        every call: when a component is rebuilt against a shared
+        registry (a new ``Server`` on ``default_registry()``), the LIVE
+        object's state must back the series, not the dead one's."""
+        g = self._get_or_create(name, ComputedGauge, help,
+                                lambda n: ComputedGauge(n, fn))
+        g._fn = fn
+        return g
 
     def summary(self, name: str, help: str = "", *, keep: int = 4096) -> Summary:
         s = self._get_or_create(name, Summary, help,

@@ -6,7 +6,8 @@ the same shippable-file contract as the metrics JSONL), carrying:
 
     {"kind": "span", "name": "prefill", "trace_id": 7, "span_id": 3,
      "parent_id": null, "start": <monotonic>, "dur_s": 0.012,
-     "ts": <wall clock>, "host": 0, "role": "server", "attrs": {...}}
+     "ts": <wall clock>, "mono": <monotonic at write>, "host": 0,
+     "role": "server", "attrs": {...}}
 
 * ``trace_id`` groups one logical unit — a serve request (its req_id)
   or a training step (the step number).
@@ -101,6 +102,12 @@ class Tracer:
             "start": start,
             "dur_s": dur_s,
             "ts": time.time() - (time.monotonic() - start),
+            # the write instant on this host's monotonic clock: within
+            # one process it orders events exactly even when the wall
+            # clock steps; the merged timeline orders on skew-corrected
+            # wall time and uses this to break same-instant ties
+            # (obs.aggregate.apply_clock_skew).
+            "mono": time.monotonic(),
             "host": self.host_id,
             "role": self.role,
             "attrs": attrs,
@@ -149,6 +156,7 @@ class Tracer:
             "span_id": span_id, "parent_id": parent_id,
             "start": start, "dur_s": end - start,
             "ts": time.time() - (time.monotonic() - start),
+            "mono": time.monotonic(),
             "host": self.host_id, "role": self.role, "attrs": attrs,
         })
         with self._lock:

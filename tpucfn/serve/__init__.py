@@ -23,6 +23,7 @@ from tpucfn.serve.frontend import (  # noqa: F401
     Server,
     ServeRequest,
     ServingMetrics,
+    SLOTracker,
 )
 from tpucfn.serve.kvcache import (  # noqa: F401
     AdmitResult,

@@ -177,6 +177,139 @@ class ServingMetrics:
         }
 
 
+class SLOTracker:
+    """TTFT/TPOT service-level objectives with a rolling-window burn
+    rate, exported as ``serve_slo_*`` (ISSUE 5).
+
+    Two latency objectives — time-to-first-token and time-per-output-
+    token — each with a target and one shared ``objective`` (the
+    fraction of requests that must meet it, e.g. 0.99).  Every finished
+    request is scored against both; the **burn rate** is the classic
+    SRE ratio
+
+        (violation fraction in the rolling window) / (1 − objective)
+
+    — 1.0 means the error budget is being consumed exactly as fast as
+    it refills; >1 sustained means the SLO will be missed.  An expired
+    (deadline-exceeded) request counts as a violation of both
+    objectives: the caller got no usable answer, whatever the partial
+    timings say.
+
+    ``clock`` is injectable so burn-rate windows are pinned by
+    fake-clock tests.
+    """
+
+    def __init__(self, registry: MetricRegistry | None = None, *,
+                 ttft_slo_s: float = 0.5, tpot_slo_s: float = 0.05,
+                 objective: float = 0.99, window_s: float = 60.0,
+                 clock=time.monotonic):
+        if not 0.0 < objective < 1.0:
+            raise ValueError(f"objective must be in (0, 1), got {objective}")
+        r = registry if registry is not None else MetricRegistry()
+        self.ttft_slo_s = ttft_slo_s
+        self.tpot_slo_s = tpot_slo_s
+        self.objective = objective
+        self.window_s = window_s
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._window: deque[tuple[float, bool, bool]] = deque()
+        self.requests = r.counter(
+            "serve_slo_requests_total", "requests scored against the SLOs")
+        self.ttft_violations = r.counter(
+            "serve_slo_ttft_violations_total",
+            "requests whose TTFT missed the target")
+        self.tpot_violations = r.counter(
+            "serve_slo_tpot_violations_total",
+            "requests whose per-output-token time missed the target")
+        # computed_gauge rebinds the read callback on re-registration,
+        # so a process that rebuilds a Server against the shared
+        # default_registry() gets the LIVE tracker's window backing the
+        # series — the counters stay shared and cumulative either way.
+        self.ttft_burn = r.computed_gauge(
+            "serve_slo_ttft_burn_rate", self._ttft_burn_now,
+            "TTFT violation rate in the rolling window / error budget")
+        self.tpot_burn = r.computed_gauge(
+            "serve_slo_tpot_burn_rate", self._tpot_burn_now,
+            "TPOT violation rate in the rolling window / error budget")
+        self.window_requests = r.computed_gauge(
+            "serve_slo_window_requests", lambda: self._window_stats()[0],
+            "requests in the rolling window")
+        # Targets as gauges so a scrape is self-describing: a burn rate
+        # without its objective is not actionable.
+        r.gauge("serve_slo_ttft_target_s",
+                "TTFT objective target").set(ttft_slo_s)
+        r.gauge("serve_slo_tpot_target_s",
+                "TPOT objective target").set(tpot_slo_s)
+        r.gauge("serve_slo_objective",
+                "fraction of requests that must meet each target").set(
+            objective)
+
+    def record(self, ttft_s: float | None, tpot_s: float | None) -> None:
+        """Score one finished request; ``None`` means the quantity was
+        never achieved (no first token before expiry) and is a
+        violation by definition."""
+        ttft_ok = ttft_s is not None and ttft_s <= self.ttft_slo_s
+        tpot_ok = tpot_s is not None and tpot_s <= self.tpot_slo_s
+        now = self.clock()
+        self.requests.add()
+        if not ttft_ok:
+            self.ttft_violations.add()
+        if not tpot_ok:
+            self.tpot_violations.add()
+        with self._lock:
+            self._window.append((now, ttft_ok, tpot_ok))
+            self._evict(now)
+
+    def _evict(self, now: float) -> None:
+        cutoff = now - self.window_s
+        while self._window and self._window[0][0] < cutoff:
+            self._window.popleft()
+
+    def _window_stats(self) -> tuple[int, int, int]:
+        """``(requests, ttft_violations, tpot_violations)`` in the
+        rolling window AS OF NOW — evicts first, so idle time decays the
+        window between requests (the computed gauges read this)."""
+        with self._lock:
+            self._evict(self.clock())
+            n = len(self._window)
+            ttft_bad = sum(1 for _, ok, _t in self._window if not ok)
+            tpot_bad = sum(1 for _, _f, ok in self._window if not ok)
+        return n, ttft_bad, tpot_bad
+
+    def _burn(self, bad: int, n: int) -> float:
+        """Burn rate = window violation rate / error budget.  The ONE
+        definition behind both the computed gauges and snapshot() — the
+        /metrics series and serve_bench's BENCH row must never
+        disagree."""
+        return bad / n / (1.0 - self.objective) if n else 0.0
+
+    def _ttft_burn_now(self) -> float:
+        n, ttft_bad, _ = self._window_stats()
+        return self._burn(ttft_bad, n)
+
+    def _tpot_burn_now(self) -> float:
+        n, _, tpot_bad = self._window_stats()
+        return self._burn(tpot_bad, n)
+
+    def snapshot(self) -> dict:
+        """The ``serve_slo_*`` block serve_bench's BENCH row carries."""
+        n, ttft_bad, tpot_bad = self._window_stats()
+        return {
+            "ttft_target_s": self.ttft_slo_s,
+            "tpot_target_s": self.tpot_slo_s,
+            "objective": self.objective,
+            "window_s": self.window_s,
+            "requests": self.requests.value,
+            "window_requests": n,
+            "ttft": {"violations_total": self.ttft_violations.value,
+                     "window_violations": ttft_bad,
+                     "burn_rate": self._burn(ttft_bad, n)},
+            "tpot": {"violations_total": self.tpot_violations.value,
+                     "window_violations": tpot_bad,
+                     "burn_rate": self._burn(tpot_bad, n)},
+        }
+
+
 class Server:
     """One engine + one scheduler + the frontend queue.
 
@@ -192,7 +325,9 @@ class Server:
                  registry: MetricRegistry | None = None,
                  tracer: Tracer | None = None,
                  prefix_cache: bool = True,
-                 max_prefill_batch: int | None = None):
+                 max_prefill_batch: int | None = None,
+                 ttft_slo_s: float = 0.5, tpot_slo_s: float = 0.05,
+                 slo_objective: float = 0.99, slo_window_s: float = 60.0):
         self.engine = engine
         # Both ISSUE-3 fast paths are duck-typed off the engine so fakes
         # (and any decode-protocol engine without the batched entry
@@ -215,6 +350,10 @@ class Server:
             cache_len=engine.cache_len, eos_id=eos_id,
             max_prefill_batch=k)
         self.metrics = ServingMetrics(registry)
+        self.slo = SLOTracker(self.metrics.registry, ttft_slo_s=ttft_slo_s,
+                              tpot_slo_s=tpot_slo_s,
+                              objective=slo_objective,
+                              window_s=slo_window_s)
         self.tracer = tracer if tracer is not None else Tracer(None)
         self.max_queued_tokens = max_queued_tokens
         self._lock = threading.Lock()
@@ -277,12 +416,26 @@ class Server:
         req.tokens, req.error = tokens, error
         with self._lock:
             self._outstanding_tokens -= len(req.prompt) + req.max_new_tokens
+        ttft = (None if req.t_first_token is None
+                else req.t_first_token - req.t_submit)
         if error is None:
             self.metrics.completed.add()
             self.metrics.request_latency_s.observe(req.t_done - req.t_submit)
             self.metrics.request_latency_hist.observe(req.t_done - req.t_submit)
+            # TPOT over the decode tail (first token excluded — that one
+            # is the TTFT's business); single-token answers have no tail
+            # and score a perfect 0.
+            tail = len(tokens) - 1 if tokens else 0
+            tpot = ((req.t_done - req.t_first_token) / tail if tail > 0
+                    else 0.0)
+            self.slo.record(ttft, tpot)
         elif isinstance(error, DeadlineExceeded):
             self.metrics.expired.add()
+            # an expired request violates both objectives by definition —
+            # the caller got no usable answer (None scores as violation;
+            # results aren't streamed, so a mid-flight first token never
+            # reached anyone).
+            self.slo.record(None, None)
         else:
             self.metrics.rejected.add()
         if self.tracer.enabled:
