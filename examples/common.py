@@ -23,6 +23,16 @@ if _REPO_ROOT not in sys.path:
 
 import jax
 
+# Persistent XLA compile cache, armed BEFORE anything compiles: jax
+# initializes the compilation cache at most once per process, at the
+# FIRST compile — and the examples compile during data staging/mesh
+# probing, well before run_train_loop runs.  Setting the dir there was
+# too late: the cache initialized path-less and stayed disabled for the
+# whole process (warm restarts silently recompiled everything).
+from tpucfn.obs import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
+
 
 def add_cluster_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--run-dir", default="/tmp/tpucfn-run",
@@ -129,12 +139,14 @@ def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=(),
     from tpucfn.parallel import shard_batch
     from tpucfn.train.trainer import TrainerObs
 
-    from tpucfn.obs import enable_compile_cache, start_profiler_server
+    from tpucfn.obs import CompileCacheProbe, start_profiler_server
 
-    # Persistent XLA compile cache: a relaunch (or the restart supervisor's
-    # resume) skips recompilation, keeping time_to_first_step from being
-    # compile-dominated (SURVEY.md §7.4 item 6).
-    enable_compile_cache()
+    # The compile cache itself was enabled at module import (see top of
+    # file — it must precede the process's first compile).  The probe
+    # tells the goodput ledger whether the first step's compile came
+    # from that cache (compile vs compile_cached bucket); TrainerObs
+    # re-arms it at the first step's entry.
+    compile_probe = CompileCacheProbe(enable_compile_cache())
     if getattr(args, "profile_server", 0):
         start_profiler_server(args.profile_server)
 
@@ -213,10 +225,28 @@ def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=(),
 
         ledger = GoodputLedger(run_dir / "goodput", host_id=host,
                                role="trainer")
-        obs = TrainerObs(registry, tracer, ledger=ledger)
+        # The forensics plane (ISSUE 6): a bounded in-memory flight ring
+        # of per-phase + HBM samples, dumped to run_dir/flight on
+        # SIGTERM/atexit and served live on /flightrecorder (where the
+        # gang coordinator fetches it at detect time); device_hbm_*
+        # gauges on /metrics (absent on CPU — memory_stats is None); an
+        # on-demand profiler capture behind POST /profile.
+        from tpucfn.obs import (FlightRecorder, ProfileCapture,
+                                register_device_gauges)
+
+        flight = FlightRecorder(host_id=host, role="trainer")
+        flight.install_dump_handlers(run_dir / "flight")
+        register_device_gauges(
+            registry,
+            jit_sources=(lambda: trainer._jit_step,
+                         lambda: trainer._jit_eval))
+        obs = TrainerObs(registry, tracer, ledger=ledger, flight=flight,
+                         compile_probe=compile_probe)
         obs_srv = start_obs_server(
             registry, role="trainer", host_id=host,
-            health_fn=lambda: (True, {"step": obs.last_step.value}))
+            health_fn=lambda: (True, {"step": obs.last_step.value}),
+            flight=flight,
+            profiler=ProfileCapture(run_dir / "profile", tracer=tracer))
         # The fault-tolerance plane (ISSUE 4): when the gang coordinator
         # assigned a heartbeat dir, a daemon thread beats liveness every
         # interval and the loop keeps the step fresh (update_step) so

@@ -54,6 +54,24 @@ def main() -> int:
     if ft_dir:
         hb = HeartbeatWriter(ft_dir, host_id=host, interval_s=hb_s,
                              role="e2e").start()
+    # Forensics plane (ISSUE 6): when the launcher assigned an obs port,
+    # run the full per-host surface a real trainer runs — flight ring
+    # (dumped on SIGTERM/atexit, served on /flightrecorder for the
+    # coordinator's at-detect capture), step trace spans (the postmortem
+    # timeline), and /metrics.
+    obs_srv = flight = tracer = None
+    from tpucfn.obs import obs_port_from_env
+
+    if obs_port_from_env() is not None:
+        from tpucfn.obs import (FlightRecorder, MetricRegistry, Tracer,
+                                start_obs_server)
+
+        flight = FlightRecorder(capacity=1024, host_id=host, role="e2e")
+        flight.install_dump_handlers(run_dir / "flight")
+        tracer = Tracer(run_dir / "trace", host_id=host, role="e2e")
+        obs_srv = start_obs_server(
+            MetricRegistry(labels={"host": str(host), "role": "e2e"}),
+            role="e2e", host_id=host, flight=flight)
     # Goodput ledger (ISSUE 5): every incarnation appends a new window
     # to the same per-host file; a SIGKILLed incarnation leaves no close
     # record, and the gap to the relaunch's window marker is what the
@@ -102,8 +120,13 @@ def main() -> int:
                     if hb is not None:
                         hb.update_step(step)
                     time.sleep(step_sleep)
-                    ledger.account("step", time.monotonic() - t0_step,
-                                   step=step)
+                    dur = time.monotonic() - t0_step
+                    ledger.account("step", dur, step=step)
+                    if flight is not None:
+                        flight.record("step", step=step, dur_s=dur)
+                    if tracer is not None:
+                        tracer.record("step", start=t0_step, dur_s=dur,
+                                      trace_id=step)
                     if host == 0:
                         t0_ckpt = time.monotonic()
                         if ckpt.save(step,
@@ -123,6 +146,10 @@ def main() -> int:
         if hb is not None:
             hb.stop()
         ledger.close()
+        if tracer is not None:
+            tracer.close()
+        if obs_srv is not None:
+            obs_srv.close()
     return 0
 
 

@@ -1,0 +1,183 @@
+"""Forensics chaos-drill acceptance (ISSUE 6): chaos-kill one host of a
+two-host gang → the coordinator captures the SURVIVOR's flight ring
+over its obs endpoint before restarting → after the run,
+`tpucfn obs postmortem --latest` assembles a bundle whose incident
+matches events.jsonl, whose flight tails cover the seconds up to
+detection, and whose timeline window is skew-corrected.
+
+Multi-second by construction (each worker pays a jax+orbax import) —
+``slow``-marked, excluded from tier-1 like the other e2e drills.
+"""
+
+import json
+import os
+import socket
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from tpucfn.bootstrap import EnvContract
+from tpucfn.ft import (
+    ChaosEvent,
+    ChaosSpec,
+    GangCoordinator,
+    GangRestart,
+    HeartbeatMonitor,
+    MonitorConfig,
+    RestartBudget,
+)
+from tpucfn.launch import Launcher, LocalTransport
+from tpucfn.obs import MetricRegistry
+from tpucfn.obs.flight import read_flight_dir
+
+pytestmark = pytest.mark.slow
+
+REPO = Path(__file__).resolve().parent.parent
+WORKER = str(REPO / "tests" / "ft_e2e_worker.py")
+
+TOTAL_STEPS = 40
+CKPT_EVERY = 10
+KILL_AT_STEP = 25  # off-boundary: the rewind definitely re-runs work
+
+
+def _contract(tmp_path, n) -> EnvContract:
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("".join("127.0.0.1:0\n" for _ in range(n)))
+    return EnvContract(
+        workers_path=str(hostfile), workers_count=n, worker_chip_count=1,
+        coordinator="127.0.0.1:1234", host_id=0, storage=str(tmp_path),
+        generation=1)
+
+
+def _free_port_base() -> int:
+    """A base whose +1/+2 host ports are very likely free (the launcher
+    hands host i base+1+i; binding base itself reserves nothing for
+    them, but fresh ephemeral neighbors rarely collide on a quiet CI
+    box and the drill fails loudly if they do)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_chaos_kill_postmortem_bundle(tmp_path):
+    run_dir = tmp_path / "drill"
+    ft_dir = run_dir / "ft"
+    run_dir.mkdir()
+    env = {"FT_E2E_RUN_DIR": str(run_dir),
+           "FT_E2E_TOTAL_STEPS": str(TOTAL_STEPS),
+           "FT_E2E_CKPT_EVERY": str(CKPT_EVERY),
+           "FT_E2E_STEP_SLEEP": "0.05",
+           "PYTHONPATH": str(REPO) + os.pathsep + os.environ.get(
+               "PYTHONPATH", "")}
+    os.environ.update(env)
+    base = _free_port_base()
+    launcher = Launcher(_contract(run_dir, 2), LocalTransport(),
+                        obs_base_port=base,
+                        ft_dir=str(ft_dir), ft_heartbeat_s=0.2)
+    monitor = HeartbeatMonitor(
+        ft_dir, expected_hosts=2,
+        config=MonitorConfig(interval_s=0.2, startup_grace_s=120.0))
+    chaos = ChaosSpec(events=(
+        ChaosEvent(action="kill", at_step=KILL_AT_STEP, host=0),))
+    coord = GangCoordinator(
+        launcher, [sys.executable, WORKER],
+        policy=GangRestart(RestartBudget(1)), monitor=monitor,
+        registry=MetricRegistry(), ft_dir=ft_dir, ckpt_dir=run_dir / "ckpt",
+        poll_interval=0.02, term_grace_s=1.0, chaos=chaos,
+        flight_timeout_s=5.0)
+    rc = coord.run()
+    assert rc == 0, "gang must finish cleanly after one recovery"
+    assert coord.chaos.done(), "the scripted kill must have fired"
+
+    events = [json.loads(s) for s in
+              (ft_dir / "events.jsonl").read_text().splitlines()
+              if s.strip()]
+    kinds = [e["kind"] for e in events]
+    # -- the coordinator captured the survivor's ring at detect ----------
+    assert "flight_capture" in kinds
+    cap_ev = next(e for e in events if e["kind"] == "flight_capture")
+    assert cap_ev["hosts"] == [1], "host 1 survived and must be captured"
+    assert cap_ev["errors"] == 0
+    detect = next(e for e in events if e["kind"] == "detect")
+    assert detect["incident"] == cap_ev["incident"]
+    captures = read_flight_dir(
+        ft_dir / "flight",
+        glob=f"incident{cap_ev['incident']:03d}-host*.jsonl")
+    assert list(captures) == [1]
+    t_last = max(s["t"] for s in captures[1]["samples"])
+    # coverage up to detection: the survivor's ring reaches within a
+    # couple of step periods of the detect instant
+    assert detect["ts"] - t_last < 2.0
+
+    # -- per-process SIGTERM/atexit dumps landed too ---------------------
+    dumps = read_flight_dir(run_dir / "flight")
+    assert sorted(dumps) == [0, 1]
+
+    # -- the postmortem CLI assembles the bundle -------------------------
+    from tpucfn.cli.main import main
+
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(["obs", "postmortem", "--run-dir", str(run_dir),
+                   "--latest", "--json"])
+    assert rc == 0
+    rep = json.loads(buf.getvalue())
+
+    # the bundle's incident IS the events.jsonl incident
+    assert rep["incident"]["incident"] == detect["incident"]
+    assert rep["incident"]["action"] == "gang_restart"
+    assert rep["incident"]["downtime_s"] > 0
+    assert rep["detect_ts"] == pytest.approx(detect["ts"])
+
+    # flight tails from every surviving host cover up to detection
+    flight_rows = {(r["source"], r["host"]): r for r in rep["flight"]}
+    cap_row = flight_rows[("incident-capture", 1)]
+    assert cap_row["samples"] > 0
+    assert cap_row["gap_to_detect_s"] < 2.0
+    # the dead host was SIGKILLed: its only on-disk dump is its SECOND
+    # incarnation's ring (post-detection), which must NOT masquerade as
+    # this incident's final seconds — excluded, with a note saying so
+    assert ("process-dump", 0) not in flight_rows
+    assert any("host 0" in n and "after detection" in n
+               for n in rep["notes"])
+    # host 1 is covered by the capture, so its (overwritten) dump is
+    # not double-reported either
+    assert ("process-dump", 1) not in flight_rows
+
+    # the timeline window is skew-corrected: every event annotated and
+    # inside the window, both hosts present
+    assert rep["timeline"], "empty timeline window"
+    hosts_seen = set()
+    for e in rep["timeline"]:
+        assert "ts_adj" in e and e["ts_adj"] is not None
+        assert rep["window"]["start"] <= e["ts_adj"] <= rep["window"]["end"]
+        hosts_seen.add(e.get("host"))
+    assert {0, 1} <= hosts_seen
+    assert set(rep["clock_skew_s"]) == {"host0", "host1"}
+
+    # last heartbeat per host made it in, aged against detection
+    hb = {h["host"]: h for h in rep["heartbeats"]}
+    assert set(hb) == {0, 1}
+    assert hb[0]["age_at_detect_s"] is not None
+
+    # bundle directory materialized
+    bundle = Path(rep["bundle"])
+    for name in ("report.md", "incident.json", "timeline.jsonl",
+                 "goodput.json", "heartbeats.json"):
+        assert (bundle / name).is_file(), name
+    assert any((bundle / "flight").iterdir())
+
+    # the goodput plane still balances after the forensics additions
+    buf2 = io.StringIO()
+    with contextlib.redirect_stdout(buf2):
+        assert main(["obs", "goodput", "--run-dir", str(run_dir),
+                     "--json"]) == 0
+    gp = json.loads(buf2.getvalue())
+    assert gp["num_hosts"] == 2
+    assert abs(gp["accounted_s"] - gp["wall_s"]) <= 0.05 * gp["wall_s"]
+    assert gp["restart_downtime_s"] > 0

@@ -336,3 +336,20 @@ def test_obs_cli_watch_path_uses_incremental_state(tmp_path, capsys):
     assert rc == 0
     rep = json.loads(capsys.readouterr().out)
     assert len(rep["timeline"]) == 1
+
+
+def test_window_events_filters_on_ts_adj():
+    from tpucfn.obs.aggregate import window_events
+
+    events = [
+        {"name": "a", "ts_adj": 9.0},
+        {"name": "b", "ts_adj": 10.0},   # boundary: included
+        {"name": "c", "ts_adj": 15.0},
+        {"name": "d", "ts_adj": 20.0},   # boundary: included
+        {"name": "e", "ts_adj": 20.1},
+        {"name": "f", "ts_adj": None},   # unplaceable: excluded
+        {"name": "g"},                   # no annotation at all
+    ]
+    out = window_events(events, 10.0, 20.0)
+    assert [e["name"] for e in out] == ["b", "c", "d"]
+    assert window_events([], 0.0, 1.0) == []

@@ -1,0 +1,411 @@
+"""FlightRecorder (ISSUE 6 tentpole): ring overwrite semantics, dump on
+signal/atexit, torn-dump tolerance on the read side, and the obs
+server's /flightrecorder + POST /profile routes."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from tpucfn.obs import (FlightRecorder, MetricRegistry, ObsServer,
+                        ProfileCapture, ProfilerBusy, read_flight_dir,
+                        read_flight_file)
+from tpucfn.obs.flight import flight_path, incident_flight_path, \
+    write_flight_dump
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ---- ring semantics ------------------------------------------------------
+
+def test_ring_overwrites_oldest_and_counts_drops():
+    fr = FlightRecorder(capacity=3, host_id=0)
+    for i in range(5):
+        fr.record("step", step=i)
+    snap = fr.snapshot()
+    assert [s["step"] for s in snap["samples"]] == [2, 3, 4]
+    assert snap["recorded"] == 5 and snap["dropped"] == 2
+    assert snap["capacity"] == 3
+    # seq is monotonic across overwrites: a reader can tell how much
+    # history the ring ate
+    assert [s["seq"] for s in snap["samples"]] == [3, 4, 5]
+
+
+def test_capacity_must_be_positive():
+    with pytest.raises(ValueError):
+        FlightRecorder(capacity=0)
+
+
+def test_record_is_thread_safe_under_contention():
+    fr = FlightRecorder(capacity=128)
+    n, workers = 500, 4
+
+    def spin(k):
+        for i in range(n):
+            fr.record("x", k=k, i=i)
+
+    ts = [threading.Thread(target=spin, args=(k,)) for k in range(workers)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    snap = fr.snapshot()
+    assert snap["recorded"] == n * workers
+    assert len(snap["samples"]) == 128
+    assert snap["dropped"] == n * workers - 128
+
+
+def test_sample_device_is_none_safe_on_cpu():
+    # CPU backends report no memory_stats: no sample, no crash, and the
+    # probe result is memoized (second call returns fast).
+    fr = FlightRecorder()
+    assert fr.sample_device() is None
+    assert fr.sample_device() is None
+    assert fr.snapshot()["samples"] == []
+
+
+def test_sample_device_records_hbm_fields_from_fake_device():
+    class FakeDev:
+        def memory_stats(self):
+            return {"bytes_in_use": 100, "peak_bytes_in_use": 200,
+                    "bytes_limit": 300}
+
+    fr = FlightRecorder()
+    rec = fr.sample_device(FakeDev())
+    assert rec["kind"] == "hbm"
+    assert (rec["used"], rec["peak"], rec["limit"]) == (100, 200, 300)
+
+
+# ---- dump + read side ----------------------------------------------------
+
+def test_dump_writes_header_plus_samples_and_truncates(tmp_path):
+    fr = FlightRecorder(capacity=8, host_id=2, role="trainer")
+    for i in range(3):
+        fr.record("step", step=i)
+    p = fr.dump(tmp_path)  # dir form derives the per-host name
+    assert p == flight_path(tmp_path, 2)
+    header, samples, skipped = read_flight_file(p)
+    assert header["kind"] == "flight_dump" and header["samples"] == 3
+    assert header["host"] == 2 and header["role"] == "trainer"
+    assert [s["step"] for s in samples] == [0, 1, 2] and skipped == 0
+    # a second dump REPLACES the first (latest ring wins) — repeated
+    # dumps (signal then atexit) must not fuse two rings
+    fr.record("step", step=3)
+    fr.dump(tmp_path)
+    header2, samples2, _ = read_flight_file(p)
+    assert header2["samples"] == 4 and len(samples2) == 4
+
+
+def test_torn_dump_read_side_skips_and_counts(tmp_path):
+    p = tmp_path / "flight-host000.jsonl"
+    fr = FlightRecorder(host_id=0)
+    fr.record("step", step=1)
+    fr.record("step", step=2)
+    fr.dump(p)
+    # SIGKILL mid-write: chop the file mid-line
+    raw = p.read_bytes()
+    p.write_bytes(raw[: len(raw) - 7])
+    header, samples, skipped = read_flight_file(p)
+    assert header is not None
+    assert [s["step"] for s in samples] == [1]
+    assert skipped == 1
+    # torn HEAD (no header line at all) still yields the samples
+    lines = [json.dumps({"kind": "step", "t": 1.0, "seq": 1, "step": 9})]
+    p2 = tmp_path / "flight-host001.jsonl"
+    p2.write_text("\n".join(lines) + "\n")
+    header2, samples2, skipped2 = read_flight_file(p2)
+    assert header2 is None and len(samples2) == 1 and skipped2 == 0
+
+
+def test_read_flight_dir_keys_by_host_and_skips_unparseable(tmp_path):
+    for host in (0, 3):
+        fr = FlightRecorder(host_id=host)
+        fr.record("step", step=host)
+        fr.dump(tmp_path)
+    (tmp_path / "flight-hostXYZ.jsonl").write_text("{}\n")  # bad host id
+    out = read_flight_dir(tmp_path)
+    assert sorted(out) == [0, 3]
+    assert out[3]["samples"][0]["step"] == 3
+    assert read_flight_dir(tmp_path / "missing") == {}
+
+
+def test_incident_capture_file_shares_the_reader(tmp_path):
+    # the coordinator's HTTP capture goes through write_flight_dump with
+    # the snapshot body — same header+samples layout, same reader, and
+    # host_id_from_path parses the incident naming
+    fr = FlightRecorder(host_id=1)
+    fr.record("serve", queue=4)
+    p = incident_flight_path(tmp_path, 7, 1)
+    write_flight_dump(p, fr.snapshot())
+    out = read_flight_dir(tmp_path, glob="incident007-host*.jsonl")
+    assert list(out) == [1]
+    assert out[1]["header"]["samples"] == 1
+
+
+DUMP_ON_SIGTERM = """
+import os, signal, sys, time
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from tpucfn.obs import FlightRecorder
+fr = FlightRecorder(capacity=64, host_id=5, role="drill")
+fr.install_dump_handlers({out!r})
+for i in range(10):
+    fr.record("step", step=i)
+print("READY", flush=True)
+time.sleep(60)
+"""
+
+
+@pytest.mark.slow
+def test_dump_on_sigterm_lands_ring_on_disk(tmp_path):
+    out = tmp_path / "flight"
+    code = DUMP_ON_SIGTERM.format(repo=str(REPO), out=str(out))
+    p = subprocess.Popen([sys.executable, "-c", code],
+                         stdout=subprocess.PIPE, text=True)
+    try:
+        assert p.stdout.readline().strip() == "READY"
+        p.terminate()
+        rc = p.wait(timeout=30)
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+    # the handler re-raises SIGTERM's default disposition after dumping
+    assert rc != 0
+    header, samples, _ = read_flight_file(flight_path(out, 5))
+    assert header["host"] == 5 and len(samples) == 10
+    assert [s["step"] for s in samples] == list(range(10))
+
+
+# ---- server routes -------------------------------------------------------
+
+@pytest.fixture()
+def srv_with_flight():
+    fr = FlightRecorder(capacity=16, host_id=0, role="t")
+    fr.record("step", step=1, dur_s=0.1)
+    calls = []
+    pc = ProfileCapture("/tmp", capture_fn=lambda d, s: calls.append(s))
+    srv = ObsServer(MetricRegistry(), port=0, host="127.0.0.1",
+                    flight=fr, profiler=pc)
+    yield srv, fr, calls
+    srv.close()
+
+
+def test_flightrecorder_route_serves_the_ring(srv_with_flight):
+    srv, fr, _ = srv_with_flight
+    with urllib.request.urlopen(srv.url("/flightrecorder")) as r:
+        assert r.status == 200
+        body = json.loads(r.read())
+    assert body["host"] == 0 and body["role"] == "t"
+    assert body["samples"][0]["step"] == 1
+
+
+def test_flightrecorder_route_404_without_recorder():
+    srv = ObsServer(MetricRegistry(), port=0, host="127.0.0.1")
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(srv.url("/flightrecorder"))
+        assert e.value.code == 404
+    finally:
+        srv.close()
+
+
+def _post(url, timeout=10):
+    req = urllib.request.Request(url, data=b"", method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_profile_route_runs_capture_and_validates(srv_with_flight):
+    srv, _, calls = srv_with_flight
+    status, body = _post(srv.url("/profile?seconds=0.25"))
+    assert status == 200 and calls == [0.25]
+    assert "artifact" in body and body["seconds"] == 0.25
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(srv.url("/profile?seconds=nope"))
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(srv.url("/profile?seconds=-1"))
+    assert e.value.code == 400
+
+
+def test_profile_route_404_without_profiler():
+    srv = ObsServer(MetricRegistry(), port=0, host="127.0.0.1")
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.url("/profile?seconds=1"))
+        assert e.value.code == 404
+    finally:
+        srv.close()
+
+
+def test_profile_capture_serializes_concurrent_requests(tmp_path):
+    # jax owns one global trace: the second concurrent capture must be
+    # refused (ProfilerBusy -> 409 at the HTTP layer), not interleaved.
+    started = threading.Event()
+
+    def slow_capture(d, s):
+        started.set()
+        time.sleep(0.3)
+
+    pc = ProfileCapture(tmp_path, capture_fn=slow_capture)
+    results = {}
+
+    def first():
+        results["first"] = pc(1.0)
+
+    t = threading.Thread(target=first)
+    t.start()
+    assert started.wait(5)
+    with pytest.raises(ProfilerBusy):
+        pc(1.0)
+    t.join()
+    assert "artifact" in results["first"]
+    with pytest.raises(ValueError):
+        pc(0.0)
+    with pytest.raises(ValueError):
+        pc(ProfileCapture.MAX_SECONDS + 1)
+
+
+def test_obs_profile_cli_client(srv_with_flight, capsys):
+    srv, _, calls = srv_with_flight
+    from tpucfn.cli.main import main
+
+    host, port = "127.0.0.1", srv.port
+    assert main(["obs", "profile", "--host", f"{host}:{port}",
+                 "--seconds", "0.5"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["seconds"] == 0.5 and calls == [0.5]
+    # connection refused -> rc 1, not a traceback
+    assert main(["obs", "profile", "--host", "127.0.0.1:1",
+                 "--seconds", "0.1"]) == 1
+
+
+# ---- instrumentation wiring ----------------------------------------------
+
+def test_trainer_obs_lands_phases_in_the_ring():
+    from tpucfn.train.trainer import TrainerObs
+
+    class Clock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clk = Clock()
+    fr = FlightRecorder(capacity=64)
+    obs = TrainerObs(MetricRegistry(), clock=clk, flight=fr)
+    with obs.data_wait(1):
+        clk.t += 0.05
+    with obs.step(1):  # first step: compile-bucketed, still sampled
+        clk.t += 1.0
+    with obs.step(2):
+        clk.t += 0.2
+    obs.record_ckpt(2, 1.2, 0.3)
+    kinds = [s["kind"] for s in fr.snapshot()["samples"]]
+    assert kinds == ["data_wait", "step", "step", "ckpt"]
+    steps = [s for s in fr.snapshot()["samples"] if s["kind"] == "step"]
+    assert steps[0]["dur_s"] == 1.0 and steps[1]["step"] == 2
+
+
+def test_serve_frontend_lands_sched_and_queue_samples():
+    from test_serve_slo import FakeEngine
+
+    from tpucfn.serve import Server
+
+    fr = FlightRecorder(capacity=256)
+    server = Server(FakeEngine(), num_blocks=64, block_size=8, flight=fr)
+    reqs = [server.submit([1, 2, 3], max_new_tokens=2) for _ in range(2)]
+    server.run_until_idle()
+    assert all(r.error is None for r in reqs)
+    samples = fr.snapshot()["samples"]
+    kinds = {s["kind"] for s in samples}
+    assert {"sched", "serve", "admit"} <= kinds
+    scheds = [s for s in samples if s["kind"] == "sched"]
+    assert any(s["work"] == "prefill" for s in scheds)
+    assert any(s["work"] == "decode" for s in scheds)
+    serves = [s for s in samples if s["kind"] == "serve"]
+    assert all({"queue", "running", "occupancy"} <= set(s) for s in serves)
+
+
+def test_snapshot_reentrant_from_a_signal_frame():
+    # The SIGTERM dump handler runs ON the main thread and may
+    # interrupt a record() that already holds the recorder's lock; the
+    # lock is reentrant so the dump proceeds instead of self-
+    # deadlocking until the coordinator's SIGKILL escalation.
+    fr = FlightRecorder(capacity=8, host_id=0)
+    fr.record("step", step=1)
+    with fr._lock:  # simulate the signal landing inside record()
+        snap = fr.snapshot()
+    assert len(snap["samples"]) == 1
+
+
+def test_cmd_serve_wires_flight_and_profiler(tmp_path, monkeypatch, capsys):
+    # the REAL serve CLI path must expose the forensics surface: the
+    # ring behind /flightrecorder (what the coordinator captures at
+    # detect time) fed by the live workload, and --trace-dir arming the
+    # exit dump + on-demand profiler next to the trace dir
+    import tpucfn.cli.main as climain
+
+    seen = {}
+
+    def capture_start(*a, **kw):
+        seen.update(kw)
+        return None  # no port bound in the test
+
+    # cmd_serve resolves start_obs_server from the tpucfn.obs package
+    # namespace at call time (function-local import)
+    monkeypatch.setattr("tpucfn.obs.start_obs_server", capture_start)
+    trace_dir = tmp_path / "run" / "trace"
+    assert climain.main([
+        "serve", "--preset", "tiny", "--synthetic", "3",
+        "--max-new", "4", "--max-batch", "2", "--cache-len", "64",
+        "--num-blocks", "32", "--block-size", "8",
+        "--trace-dir", str(trace_dir)]) == 0
+    assert seen.get("flight") is not None
+    assert seen.get("profiler") is not None
+    # the workload's scheduler decisions landed in the SAME ring the
+    # endpoint would have served
+    kinds = {s["kind"] for s in seen["flight"].snapshot()["samples"]}
+    assert {"sched", "serve", "admit"} <= kinds
+    # profiler artifacts are rooted next to the trace dir, where
+    # `obs postmortem` and the launch layout expect them
+    assert seen["profiler"].log_dir == trace_dir.parent / "profile"
+    capsys.readouterr()
+
+
+@pytest.mark.slow
+def test_sigterm_dump_preserves_sig_ign(tmp_path):
+    # a worker configured to survive SIGTERM (inherited SIG_IGN) must
+    # STILL survive it after dump handlers are installed — the dump
+    # happens, the process keeps living
+    code = """
+import os, signal, sys, time
+signal.signal(signal.SIGTERM, signal.SIG_IGN)
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from tpucfn.obs import FlightRecorder
+fr = FlightRecorder(capacity=16, host_id=7)
+fr.install_dump_handlers({out!r})
+fr.record("step", step=1)
+print("READY", flush=True)
+os.kill(os.getpid(), signal.SIGTERM)
+print("SURVIVED", flush=True)
+""".format(repo=str(REPO), out=str(tmp_path / "flight"))
+    p = subprocess.run([sys.executable, "-c", code],
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stderr
+    assert "SURVIVED" in p.stdout
+    header, samples, _ = read_flight_file(
+        flight_path(tmp_path / "flight", 7))
+    assert header["host"] == 7 and len(samples) == 1

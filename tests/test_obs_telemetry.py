@@ -1,0 +1,130 @@
+"""Device telemetry gauges (ISSUE 6): device_hbm_* exposition pins via
+a fake ``memory_stats`` device, the CPU absent-not-crashing path, live
+read-time values, and the jit_cache_programs source."""
+
+import urllib.request
+
+from tpucfn.obs import (MetricRegistry, ObsServer, device_memory_stats,
+                        register_device_gauges)
+
+
+class FakeDev:
+    """A device whose memory_stats the tests control (the TPU shape of
+    the dict: bytes_in_use / peak_bytes_in_use / bytes_limit)."""
+
+    def __init__(self, used=1024, peak=2048, limit=16 * 2**30):
+        self.stats = {"bytes_in_use": used, "peak_bytes_in_use": peak,
+                      "bytes_limit": limit}
+
+    def memory_stats(self):
+        return self.stats
+
+
+def test_device_memory_stats_none_safe():
+    # real first device on this image is CPU: stats are None, no raise
+    assert device_memory_stats() is None
+
+    class Raises:
+        def memory_stats(self):
+            raise RuntimeError("backend gone")
+
+    assert device_memory_stats(Raises()) is None
+
+    class NotADict:
+        def memory_stats(self):
+            return 42
+
+    assert device_memory_stats(NotADict()) is None
+
+
+def test_cpu_path_registers_nothing_and_metrics_still_serves():
+    reg = MetricRegistry(labels={"host": "0"})
+    reg.counter("alive_total").add()
+    assert register_device_gauges(reg) == []
+    srv = ObsServer(reg, port=0, host="127.0.0.1")
+    try:
+        with urllib.request.urlopen(srv.url("/metrics")) as r:
+            body = r.read().decode()
+    finally:
+        srv.close()
+    # absent, not zero: a dashboard must see "no HBM", not "empty HBM"
+    assert "device_hbm" not in body
+    assert "alive_total" in body
+
+
+def test_fake_device_gauges_pinned_in_exposition():
+    dev = FakeDev(used=111, peak=222, limit=333)
+    reg = MetricRegistry(labels={"host": "1", "role": "trainer"})
+    names = register_device_gauges(reg, device=dev)
+    assert names == ["device_hbm_used_bytes", "device_hbm_peak_bytes",
+                     "device_hbm_limit_bytes"]
+    body = reg.to_prometheus()
+    assert ('device_hbm_used_bytes{host="1",role="trainer"} 111.0'
+            in body.splitlines())
+    assert ('device_hbm_peak_bytes{host="1",role="trainer"} 222.0'
+            in body.splitlines())
+    assert ('device_hbm_limit_bytes{host="1",role="trainer"} 333.0'
+            in body.splitlines())
+    assert "# TYPE device_hbm_used_bytes gauge" in body
+
+
+def test_gauges_read_live_values_at_scrape_time():
+    dev = FakeDev(used=10)
+    reg = MetricRegistry()
+    register_device_gauges(reg, device=dev)
+    assert "device_hbm_used_bytes 10.0" in reg.to_prometheus()
+    dev.stats["bytes_in_use"] = 99  # the allocator grew between scrapes
+    assert "device_hbm_used_bytes 99.0" in reg.to_prometheus()
+    # a device that stops reporting mid-run degrades to 0, not a crash
+    dev.stats = None
+    dev.memory_stats = lambda: None
+    assert "device_hbm_used_bytes 0.0" in reg.to_prometheus()
+
+
+def test_partial_stats_register_only_present_keys():
+    class PartialDev:
+        def memory_stats(self):
+            return {"bytes_in_use": 5}  # no peak/limit on this backend
+
+    reg = MetricRegistry()
+    assert register_device_gauges(reg, device=PartialDev()) == [
+        "device_hbm_used_bytes"]
+    body = reg.to_prometheus()
+    assert "device_hbm_used_bytes 5.0" in body
+    assert "device_hbm_peak_bytes" not in body
+
+
+def test_jit_cache_programs_sums_sources_and_tolerates_unbuilt():
+    class FakeJit:
+        def __init__(self, n):
+            self.n = n
+
+        def _cache_size(self):
+            return self.n
+
+    reg = MetricRegistry()
+    built = {"step": FakeJit(3), "eval": None}  # eval not compiled yet
+    names = register_device_gauges(
+        reg, device=FakeDev(),
+        jit_sources=(lambda: built["step"], lambda: built["eval"]))
+    assert "jit_cache_programs" in names
+    assert "jit_cache_programs 3.0" in reg.to_prometheus()
+    built["eval"] = FakeJit(2)  # lazily compiled later
+    assert "jit_cache_programs 5.0" in reg.to_prometheus()
+
+    class Broken:
+        def _cache_size(self):
+            raise AttributeError("jax internals moved")
+
+    built["step"] = Broken()  # best-effort: broken source contributes 0
+    assert "jit_cache_programs 2.0" in reg.to_prometheus()
+
+
+def test_reregistration_rebinds_to_the_live_device():
+    # a rebuilt loop registering against the shared registry must leave
+    # the LIVE device backing the series (computed_gauge rebind rule)
+    old, new = FakeDev(used=1), FakeDev(used=7)
+    reg = MetricRegistry()
+    register_device_gauges(reg, device=old)
+    register_device_gauges(reg, device=new)
+    assert "device_hbm_used_bytes 7.0" in reg.to_prometheus()

@@ -1,0 +1,399 @@
+"""`tpucfn obs postmortem` (ISSUE 6): bundle assembly from a synthetic
+incident run, adversarial inputs (missing flight dumps, empty trace
+dir, unknown incident id, no ft events), and the goodput regression
+ledger + `tpucfn obs diff` satellite — all matching the
+test_obs_aggregate skip-and-count discipline."""
+
+import json
+import time
+
+import pytest
+
+from tpucfn.cli.main import main
+from tpucfn.obs import FlightRecorder
+from tpucfn.obs.goodput import (append_goodput_ledger, diff_goodput_rows,
+                                read_goodput_ledger)
+from tpucfn.obs.postmortem import (build_postmortem, render_postmortem,
+                                   select_incident, write_bundle)
+
+T0 = 1_000_000.0  # synthetic fleet wall clock
+
+
+def _jsonl(path, rows):
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def _incident_run(tmp_path, *, skew_host1=0.0):
+    """A two-host run with one gang-restart incident at T0+10: ft
+    events, heartbeats, trace spans, goodput ledgers, and flight dumps
+    (coordinator capture for host 1, process dump for host 0)."""
+    run = tmp_path / "run"
+    ft = run / "ft"
+    _jsonl(ft / "events.jsonl", [
+        {"ts": T0, "kind": "launch", "first": True, "hosts": 2},
+        {"ts": T0 + 10.0, "kind": "detect", "incident": 1, "failures": [
+            {"host": 0, "kind": "crash", "rc": -9, "step": 25,
+             "detail": ""}]},
+        {"ts": T0 + 10.1, "kind": "flight_capture", "incident": 1,
+         "hosts": [1], "errors": 0},
+        {"ts": T0 + 10.2, "kind": "decide", "incident": 1,
+         "action": "gang_restart", "hosts": [], "delay_s": 0,
+         "reason": "crash"},
+        {"ts": T0 + 12.0, "kind": "recovered", "incident": 1,
+         "action": "gang_restart", "mttr_s": 2.0},
+        {"ts": T0 + 12.0, "kind": "goodput_incident", "incident": 1,
+         "action": "gang_restart", "downtime_s": 2.0, "detection_s": 0.1,
+         "fleet_step": 25},
+        {"ts": T0 + 30.0, "kind": "done", "rc": 0},
+    ])
+    for host in (0, 1):
+        off = skew_host1 if host == 1 else 0.0
+        _jsonl(ft / f"hb-host{host:03d}.jsonl", [
+            {"host_id": host, "pid": 100 + host, "step": s,
+             "t": T0 + s * 0.4 + off, "seq": s, "role": "e2e"}
+            for s in range(1, 26)])
+        _jsonl(run / "trace" / f"trace-e2e-host{host:03d}.jsonl", [
+            {"kind": "span", "name": "step", "trace_id": s,
+             "span_id": s, "parent_id": None, "start": s * 0.4,
+             "dur_s": 0.4, "ts": T0 + s * 0.4 + off, "mono": s * 0.4,
+             "host": host, "role": "e2e", "attrs": {}}
+            for s in range(1, 26)])
+        _jsonl(run / "goodput" / f"goodput-host{host:03d}.jsonl", [
+            {"kind": "window", "host": host, "t": T0},
+            *[{"kind": "phase", "bucket": "step", "dur_s": 0.4,
+               "step": s, "t": T0 + s * 0.4, "host": host}
+              for s in range(1, 26)],
+        ])
+    # host 1 survived: the coordinator captured its ring at detect
+    fr = FlightRecorder(capacity=32, host_id=1, role="e2e",
+                        clock=lambda: T0 + 9.9)
+    for s in range(20, 26):
+        fr.record("step", step=s, dur_s=0.4)
+    from tpucfn.obs.flight import incident_flight_path, write_flight_dump
+
+    (ft / "flight").mkdir(parents=True)
+    write_flight_dump(incident_flight_path(ft / "flight", 1, 1),
+                      fr.snapshot())
+    # host 0 died: only its (older) process dump exists
+    fr0 = FlightRecorder(capacity=32, host_id=0, role="e2e",
+                         clock=lambda: T0 + 9.0)
+    fr0.record("step", step=24, dur_s=0.4)
+    fr0.dump(run / "flight")
+    return run
+
+
+# ---- assembly ------------------------------------------------------------
+
+def test_bundle_assembles_every_section(tmp_path):
+    run = _incident_run(tmp_path)
+    report = build_postmortem(run)
+    assert report["incident"]["incident"] == 1
+    assert report["incident"]["action"] == "gang_restart"
+    assert report["detect_ts"] == pytest.approx(T0 + 10.0)
+    # timeline: only events inside the window, all skew-annotated
+    assert report["timeline"], "no timeline events in window"
+    for e in report["timeline"]:
+        assert "ts_adj" in e
+        assert report["window"]["start"] <= e["ts_adj"] \
+            <= report["window"]["end"]
+    # goodput over the span decomposes into buckets
+    assert report["goodput"]["num_hosts"] == 2
+    assert report["goodput"]["buckets"]["productive_step"] > 0
+    # flight coverage: both sources, host 1's capture reaches detection
+    rows = {(r["source"], r["host"]): r for r in report["flight"]}
+    cap = rows[("incident-capture", 1)]
+    assert cap["samples"] == 6
+    assert cap["gap_to_detect_s"] == pytest.approx(0.1, abs=0.01)
+    assert ("process-dump", 0) in rows
+    # heartbeats: last beat before detect per host, aged
+    hb = {h["host"]: h for h in report["heartbeats"]}
+    assert hb[0]["step"] == 25
+    assert hb[0]["age_at_detect_s"] >= 0
+    assert report["notes"] == []
+
+
+def test_skew_corrected_timeline_window(tmp_path):
+    # host 1's wall clock runs 5s ahead; without correction its spans
+    # around the detect instant would land outside/misordered.  The
+    # estimator must recover the 5s and the window filter must operate
+    # on corrected time.
+    run = _incident_run(tmp_path, skew_host1=5.0)
+    report = build_postmortem(run, window_s=3.0)
+    assert report["clock_skew_s"]["host1"] == pytest.approx(2.5, abs=0.1)
+    assert report["clock_skew_s"]["host0"] == pytest.approx(-2.5, abs=0.1)
+    by_host = {}
+    for e in report["timeline"]:
+        by_host.setdefault(e["host"], []).append(e["trace_id"])
+    # both hosts contribute the SAME lockstep steps to the window once
+    # corrected — the raw-ts filter would have shifted host 1's set
+    assert by_host and set(by_host[0]) == set(by_host[1])
+
+
+def test_write_bundle_materializes_files(tmp_path):
+    run = _incident_run(tmp_path)
+    report = build_postmortem(run)
+    out = write_bundle(report, tmp_path / "bundle")
+    assert (out / "incident.json").is_file()
+    assert (out / "goodput.json").is_file()
+    assert (out / "heartbeats.json").is_file()
+    assert (out / "report.md").is_file()
+    lines = (out / "timeline.jsonl").read_text().splitlines()
+    assert len(lines) == len(report["timeline"])
+    copied = sorted(p.name for p in (out / "flight").iterdir())
+    assert copied == ["incident-capture-incident001-host001.jsonl",
+                      "process-dump-flight-host000.jsonl"]
+    md = (out / "report.md").read_text()
+    assert "incident 1" in md and "flight-recorder coverage" in md
+
+
+def test_select_incident_latest_and_by_id(tmp_path):
+    events = [
+        {"ts": 1.0, "kind": "detect", "incident": 1, "failures": []},
+        {"ts": 2.0, "kind": "recovered", "incident": 1,
+         "action": "gang_restart", "mttr_s": 1.0},
+        {"ts": 3.0, "kind": "detect", "incident": 2, "failures": []},
+        {"ts": 4.0, "kind": "recovered", "incident": 2,
+         "action": "solo_restart", "mttr_s": 1.0},
+    ]
+    assert select_incident(events)["incident"] == 2
+    assert select_incident(events, 1)["action"] == "gang_restart"
+    with pytest.raises(ValueError, match=r"unknown incident 9.*\[1, 2\]"):
+        select_incident(events, 9)
+
+
+# ---- adversarial CLI cases ----------------------------------------------
+
+def test_cli_postmortem_latest_json(tmp_path, capsys):
+    run = _incident_run(tmp_path)
+    assert main(["obs", "postmortem", "--run-dir", str(run), "--latest",
+                 "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["incident"]["incident"] == 1
+    bundle = rep["bundle"]
+    assert bundle.endswith("postmortem/incident-001")
+    assert (run / "postmortem" / "incident-001" / "report.md").is_file()
+
+
+def test_cli_unknown_incident_id_is_a_clean_error(tmp_path, capsys):
+    run = _incident_run(tmp_path)
+    assert main(["obs", "postmortem", "--run-dir", str(run),
+                 "--incident", "42"]) == 1
+    err = capsys.readouterr().err
+    assert "unknown incident 42" in err
+
+
+def test_cli_no_ft_events_is_a_clean_error(tmp_path, capsys):
+    run = tmp_path / "empty"
+    run.mkdir()
+    assert main(["obs", "postmortem", "--run-dir", str(run)]) == 1
+    assert "no ft events" in capsys.readouterr().err
+
+
+def test_missing_flight_dumps_noted_not_fatal(tmp_path):
+    run = _incident_run(tmp_path)
+    import shutil
+
+    shutil.rmtree(run / "ft" / "flight")
+    shutil.rmtree(run / "flight")
+    report = build_postmortem(run)
+    assert report["flight"] == []
+    assert any("flight" in n for n in report["notes"])
+    # rendering still works (the note is IN the report)
+    assert "NOTE:" in render_postmortem(report)
+
+
+def test_empty_trace_dir_yields_empty_timeline_not_crash(tmp_path):
+    run = _incident_run(tmp_path)
+    import shutil
+
+    shutil.rmtree(run / "trace")
+    (run / "trace").mkdir()
+    report = build_postmortem(run)
+    assert report["timeline"] == []
+    assert any("trace" in n for n in report["notes"])
+    out = write_bundle(report, tmp_path / "b2")
+    assert (out / "timeline.jsonl").read_text() == ""
+
+
+def test_incident_without_recovery_still_bundles(tmp_path):
+    # budget-exhausted give_up: no recovered event, downtime unknown —
+    # the postmortem of exactly this run must not hide the incident
+    run = tmp_path / "run"
+    _jsonl(run / "ft" / "events.jsonl", [
+        {"ts": T0, "kind": "detect", "incident": 1, "failures": [
+            {"host": 0, "kind": "crash", "rc": 1, "step": 3,
+             "detail": ""}]},
+        {"ts": T0 + 0.5, "kind": "give_up", "incident": 1, "rc": 1,
+         "reason": "budget exhausted"},
+    ])
+    report = build_postmortem(run)
+    assert report["incident"]["action"] == "give_up"
+    assert report["incident"]["downtime_s"] is None
+    assert report["detect_ts"] == pytest.approx(T0)
+
+
+# ---- goodput regression ledger + diff (satellite) ------------------------
+
+def _fake_report(ratio, shares_step):
+    wall = 100.0
+    return {"wall_s": wall, "goodput_ratio": ratio, "num_hosts": 2,
+            "productive_steps": 50, "lost_steps": 0, "incidents": [],
+            "buckets": {"productive_step": shares_step * wall,
+                        "data_wait": (1 - shares_step) * wall}}
+
+
+def test_ledger_append_read_diff_roundtrip(tmp_path):
+    ledger = tmp_path / "runs" / "goodput_ledger.jsonl"
+    append_goodput_ledger(ledger, _fake_report(0.8, 0.8), run_dir="runA")
+    append_goodput_ledger(ledger, _fake_report(0.6, 0.6), run_dir="runB")
+    rows, skipped = read_goodput_ledger(ledger)
+    assert len(rows) == 2 and skipped == 0
+    assert rows[0]["shares"]["productive_step"] == pytest.approx(0.8)
+    diff = diff_goodput_rows(rows[0], rows[1])
+    assert diff["goodput_ratio_delta"] == pytest.approx(-0.2)
+    by_bucket = {r["bucket"]: r for r in diff["buckets"]}
+    assert by_bucket["data_wait"]["delta"] == pytest.approx(0.2)
+    # REPORT_BUCKETS order first: productive_step before data_wait
+    assert [r["bucket"] for r in diff["buckets"]][0] == "productive_step"
+
+
+def test_cli_goodput_ledger_flag_and_diff(tmp_path, capsys):
+    run = _incident_run(tmp_path)
+    ledger = tmp_path / "ledger.jsonl"
+    for _ in range(2):
+        assert main(["obs", "goodput", "--run-dir", str(run), "--json",
+                     "--ledger", str(ledger)]) == 0
+    rows, _ = read_goodput_ledger(ledger)
+    assert len(rows) == 2
+    capsys.readouterr()
+    assert main(["obs", "diff", "--ledger", str(ledger), "--json"]) == 0
+    diff = json.loads(capsys.readouterr().out)
+    assert diff["goodput_ratio_delta"] == pytest.approx(0.0)
+    # human rendering
+    assert main(["obs", "diff", "--ledger", str(ledger)]) == 0
+    assert "goodput_ratio delta" in capsys.readouterr().out
+
+
+def test_cli_diff_needs_two_rows(tmp_path, capsys):
+    ledger = tmp_path / "ledger.jsonl"
+    append_goodput_ledger(ledger, _fake_report(0.8, 0.8))
+    assert main(["obs", "diff", "--ledger", str(ledger)]) == 1
+    assert "at least 2" in capsys.readouterr().err
+    assert main(["obs", "diff", "--ledger", str(tmp_path / "nope")]) == 1
+
+
+def test_ledger_reader_skips_foreign_rows(tmp_path):
+    ledger = tmp_path / "ledger.jsonl"
+    append_goodput_ledger(ledger, _fake_report(0.5, 0.5))
+    with open(ledger, "a") as f:
+        f.write('{"kind": "something_else"}\n')
+        f.write("torn{\n")
+    rows, skipped = read_goodput_ledger(ledger)
+    assert len(rows) == 1 and skipped == 2
+
+
+def test_process_dump_excluded_when_captured_or_post_detection(tmp_path):
+    # host 1 has an at-detect capture AND a (later, overwritten) exit
+    # dump: only the capture may speak for the incident.  A dump whose
+    # samples all POSTDATE detection (a later incarnation's ring) is
+    # excluded with a note, not attributed to the wrong failure.
+    run = _incident_run(tmp_path)
+    fr1 = FlightRecorder(capacity=8, host_id=1, role="e2e",
+                         clock=lambda: T0 + 25.0)  # after recovery
+    fr1.record("step", step=30)
+    fr1.dump(run / "flight")
+    fr2 = FlightRecorder(capacity=8, host_id=2, role="e2e",
+                         clock=lambda: T0 + 25.0)  # uncaptured host,
+    fr2.record("step", step=30)                    # post-detect dump
+    fr2.dump(run / "flight")
+    report = build_postmortem(run)
+    rows = {(r["source"], r["host"]) for r in report["flight"]}
+    assert ("incident-capture", 1) in rows
+    assert ("process-dump", 1) not in rows  # capture wins
+    assert ("process-dump", 2) not in rows  # post-detection ring
+    assert ("process-dump", 0) in rows      # pre-detect dump: kept
+    assert any("host 2" in n and "after detection" in n
+               for n in report["notes"])
+
+
+def test_post_detection_only_heartbeats_are_omitted_with_note(tmp_path):
+    run = _incident_run(tmp_path)
+    # host 2 joined after the incident (step-less beats, a serve
+    # host's shape — with lockstep step numbers the skew estimator
+    # would rightly read a late copy of the SAME steps as clock skew):
+    # every beat postdates detection
+    _jsonl(run / "ft" / "hb-host002.jsonl", [
+        {"host_id": 2, "pid": 300, "step": None, "t": T0 + 20.0 + s,
+         "seq": s, "role": "serve"} for s in range(1, 4)])
+    report = build_postmortem(run)
+    assert all(h["host"] != 2 for h in report["heartbeats"])
+    assert all(h["age_at_detect_s"] >= 0 for h in report["heartbeats"])
+    assert any("host 2" in n and "before detection" in n
+               for n in report["notes"])
+
+
+def test_cli_goodput_ledger_skips_empty_report(tmp_path, capsys):
+    empty = tmp_path / "nothing"
+    empty.mkdir()
+    ledger = tmp_path / "ledger.jsonl"
+    assert main(["obs", "goodput", "--run-dir", str(empty),
+                 "--ledger", str(ledger)]) == 0
+    assert "not appending" in capsys.readouterr().err
+    assert not ledger.exists()
+
+
+def test_heartbeat_and_flight_comparisons_use_fleet_clock(tmp_path):
+    # host 1's wall clock runs 5s ahead; its last pre-detect beat has
+    # raw t > t_detect, and its flight samples would read as negative
+    # coverage — both sections must compare on the corrected clock,
+    # like the timeline does
+    run = _incident_run(tmp_path, skew_host1=5.0)
+    report = build_postmortem(run)
+    hb = {h["host"]: h for h in report["heartbeats"]}
+    assert 1 in hb, "fast host must not vanish from the heartbeat table"
+    assert hb[1]["age_at_detect_s"] >= 0
+    # the capture in the fixture was recorded on host 1's (fast) clock
+    # at raw T0+9.9+0 — after correction its gap to detect stays small
+    # and non-negative-ish, never ~-5s
+    cap = next(r for r in report["flight"]
+               if r["source"] == "incident-capture" and r["host"] == 1)
+    assert cap["gap_to_detect_s"] > -1.0
+
+
+def test_goodput_section_is_scoped_to_the_incident(tmp_path):
+    # a second, later incident in events.jsonl must not leak into
+    # incident 1's bundle: the goodput section's incidents list carries
+    # exactly the incident under postmortem
+    run = _incident_run(tmp_path)
+    with open(run / "ft" / "events.jsonl", "a") as f:
+        for e in [
+            {"ts": T0 + 100.0, "kind": "detect", "incident": 2,
+             "failures": [{"host": 1, "kind": "crash", "rc": 1,
+                           "step": 50, "detail": ""}]},
+            {"ts": T0 + 103.0, "kind": "recovered", "incident": 2,
+             "action": "gang_restart", "mttr_s": 3.0},
+            {"ts": T0 + 103.0, "kind": "goodput_incident", "incident": 2,
+             "action": "gang_restart", "downtime_s": 3.0,
+             "detection_s": 0.1, "fleet_step": 50},
+        ]:
+            f.write(json.dumps(e) + "\n")
+    report = build_postmortem(run, incident_id=1)
+    assert [i["incident"] for i in report["goodput"]["incidents"]] == [1]
+    assert report["goodput"]["incident_downtime_s"] == pytest.approx(2.0)
+
+
+def test_cli_goodput_ledger_refused_under_watch(tmp_path, capsys,
+                                                monkeypatch):
+    run = _incident_run(tmp_path)
+    ledger = tmp_path / "ledger.jsonl"
+    # one watch tick then stop (the sleep raises out of the loop)
+    monkeypatch.setattr(time, "sleep",
+                        lambda s: (_ for _ in ()).throw(KeyboardInterrupt))
+    with pytest.raises(KeyboardInterrupt):
+        main(["obs", "goodput", "--run-dir", str(run), "--json",
+              "--watch", "5", "--ledger", str(ledger)])
+    assert "not appending" in capsys.readouterr().err
+    assert not ledger.exists()

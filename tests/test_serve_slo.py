@@ -7,7 +7,7 @@ import time
 import pytest
 
 from tpucfn.obs import MetricRegistry
-from tpucfn.serve import Server
+from tpucfn.serve import AdmissionError, Server
 from tpucfn.serve.frontend import SLOTracker
 
 
@@ -182,3 +182,105 @@ def test_server_tight_targets_burn_and_expired_counts_both():
     assert snap["ttft"]["violations_total"] == 2
     assert snap["tpot"]["violations_total"] == 2
     assert snap["ttft"]["burn_rate"] == pytest.approx(100.0)  # 0.99 objective
+
+
+# ---- SLO-aware early shedding (ISSUE 6 satellite) -------------------------
+
+def test_should_shed_needs_min_window_then_fires_on_burn():
+    clk = FakeClock()
+    t = SLOTracker(MetricRegistry(), ttft_slo_s=0.2, tpot_slo_s=10.0,
+                   objective=0.9, window_s=60.0, clock=clk)
+    for _ in range(7):
+        t.record(9.9, 0.0)  # every request violates TTFT
+    # burn is 10x, but 7 < min_window: one bad burst over a thin window
+    # must not shed
+    assert not t.should_shed(min_window=8)
+    t.record(9.9, 0.0)
+    assert t.should_shed(min_window=8)
+    # the window aging out re-admits traffic
+    clk.t = 61.0
+    assert not t.should_shed(min_window=8)
+
+
+def test_should_shed_false_while_burn_under_one():
+    t = SLOTracker(MetricRegistry(), ttft_slo_s=0.2, tpot_slo_s=10.0,
+                   objective=0.5, window_s=60.0)
+    # 10 requests, 3 TTFT violations: burn = 0.3 / 0.5 = 0.6 < 1
+    for i in range(10):
+        t.record(9.9 if i < 3 else 0.1, 0.0)
+    assert not t.should_shed(min_window=8)
+
+
+def test_server_slo_shed_rejects_429_and_counts():
+    server = Server(FakeEngine(), num_blocks=64, block_size=8,
+                    ttft_slo_s=1e-6, tpot_slo_s=1e-6,
+                    slo_shed=True, shed_min_window=2)
+    # burn the budget: two completed requests, both violating
+    reqs = [server.submit([1, 2, 3], max_new_tokens=2) for _ in range(2)]
+    server.run_until_idle()
+    assert all(r.error is None for r in reqs)
+    assert server.slo.should_shed(2)
+    with pytest.raises(AdmissionError) as e:
+        server.submit([4, 5, 6], max_new_tokens=2)
+    assert e.value.status == 429
+    assert "shedding" in str(e.value)
+    assert server.metrics.slo_shed.value == 1
+    assert server.metrics.snapshot()["slo_shed"] == 1
+    reg = server.metrics.registry
+    assert "serve_slo_shed_total 1.0" in reg.to_prometheus()
+
+
+def test_server_shed_off_by_default_under_burn():
+    server = Server(FakeEngine(), num_blocks=64, block_size=8,
+                    ttft_slo_s=1e-6, tpot_slo_s=1e-6)
+    for _ in range(10):
+        server.submit([1, 2, 3], max_new_tokens=2)
+    server.run_until_idle()
+    assert server.slo.should_shed(8)  # burn IS high...
+    server.submit([4, 5, 6], max_new_tokens=2)  # ...but nothing sheds
+    server.run_until_idle()
+    assert server.metrics.slo_shed.value == 0
+
+
+def test_window_counts_stay_consistent_under_eviction():
+    # the incremental window counters (O(evictions) _window_stats) must
+    # agree with a brute-force recount across append/evict churn
+    clk = FakeClock()
+    t = SLOTracker(MetricRegistry(), ttft_slo_s=0.2, tpot_slo_s=0.05,
+                   objective=0.9, window_s=10.0, clock=clk)
+    for i in range(50):
+        clk.t = i * 0.7
+        t.record(9.9 if i % 3 == 0 else 0.1,
+                 9.9 if i % 4 == 0 else 0.01)
+        n, ttft_bad, tpot_bad = t._window_stats()
+        assert n == len(t._window)
+        assert ttft_bad == sum(1 for _, ok, _x in t._window if not ok)
+        assert tpot_bad == sum(1 for _, _x, ok in t._window if not ok)
+    clk.t = 1000.0  # everything ages out
+    assert t._window_stats() == (0, 0, 0)
+
+
+def test_shed_admits_probe_requests_for_recovery_feedback():
+    # shed requests are never scored, so a frozen window would 429
+    # everything until the violations age out — every Nth arrival is
+    # admitted as a probe whose completion re-scores the window
+    server = Server(FakeEngine(), num_blocks=64, block_size=8,
+                    ttft_slo_s=1e-6, tpot_slo_s=1e-6,
+                    slo_shed=True, shed_min_window=2, shed_probe_every=3)
+    for _ in range(2):
+        server.submit([1, 2, 3], max_new_tokens=2)
+    server.run_until_idle()
+    assert server.slo.should_shed(2)
+    outcomes = []
+    for _ in range(6):
+        try:
+            server.submit([4, 5, 6], max_new_tokens=2)
+            outcomes.append("admit")
+        except AdmissionError:
+            outcomes.append("shed")
+    assert outcomes == ["shed", "shed", "admit", "shed", "shed", "admit"]
+    # probes really flow through to scoring
+    before = server.slo.requests.value
+    server.run_until_idle()
+    assert server.slo.requests.value == before + 2
+    assert server.metrics.slo_shed.value == 4

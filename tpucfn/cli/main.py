@@ -319,7 +319,9 @@ def cmd_serve(args) -> int:
               file=sys.stderr)
         return 2
 
-    from tpucfn.obs import MetricRegistry, Tracer, start_obs_server
+    from tpucfn.obs import (FlightRecorder, MetricRegistry, ProfileCapture,
+                            Tracer, register_device_gauges,
+                            start_obs_server)
 
     # Host identity: under `tpucfn launch` every rank carries
     # TPUCFN_HOST_ID — without it a serve gang's trace files collide on
@@ -327,6 +329,18 @@ def cmd_serve(args) -> int:
     host_id = int(os.environ.get("TPUCFN_HOST_ID", "0") or 0)
     registry = MetricRegistry(labels={"role": "server",
                                       "host": str(host_id)})
+    # The forensics plane for serve hosts (ISSUE 6): the ring feeds
+    # /flightrecorder (where the gang coordinator captures survivors at
+    # detect time) regardless of any on-disk dirs; the exit dump and
+    # the on-demand profiler need a place on disk, which the serve CLI
+    # only has when --trace-dir names the run's trace/ (their siblings
+    # flight/ and profile/ match what `obs postmortem` reads).
+    flight = FlightRecorder(host_id=host_id, role="server")
+    register_device_gauges(registry)
+    profiler = None
+    if args.trace_dir:
+        artifacts_root = Path(args.trace_dir).resolve().parent
+        flight.install_dump_handlers(artifacts_root / "flight")
     tracer = obs_srv = None
     try:
         # Inside the try from the first resource on: a failed port bind
@@ -335,12 +349,16 @@ def cmd_serve(args) -> int:
         # is actually going to happen).
         tracer = Tracer(args.trace_dir, host_id=host_id, role="server",
                         truncate=True) if args.trace_dir else Tracer(None)
+        if args.trace_dir:
+            profiler = ProfileCapture(artifacts_root / "profile",
+                                      tracer=tracer)
         # --obs-port wins; otherwise the launcher-assigned
         # TPUCFN_OBS_PORT applies (a serve gang under `tpucfn launch
         # --obs-port` must bind the ports the supervisor printed);
         # neither -> no endpoint.
         obs_srv = start_obs_server(registry, port=args.obs_port,
-                                   role="server", host_id=host_id)
+                                   role="server", host_id=host_id,
+                                   flight=flight, profiler=profiler)
         if obs_srv is not None:
             print(f"obs endpoint: {obs_srv.url()}", file=sys.stderr)
         server = Server(engine, num_blocks=args.num_blocks,
@@ -350,7 +368,9 @@ def cmd_serve(args) -> int:
                         prefix_cache=args.prefix_cache,
                         max_prefill_batch=args.max_prefill_batch,
                         ttft_slo_s=args.slo_ttft, tpot_slo_s=args.slo_tpot,
-                        slo_objective=args.slo_objective)
+                        slo_objective=args.slo_objective,
+                        slo_shed=args.slo_shed,
+                        flight=flight)
         reqs = []
         for p in prompts:
             try:
@@ -626,10 +646,139 @@ def cmd_obs_goodput(args) -> int:
             print(render_goodput(report))
 
     show(one_pass())
+    if getattr(args, "ledger", None):
+        # Cross-run regression ledger (ISSUE 6 satellite): one BENCH-
+        # row-style line per invocation; `tpucfn obs diff` compares the
+        # last two.  Refused under --watch — a watch starts while the
+        # run is LIVE, so the row would freeze the opening seconds'
+        # compile-dominated shares and poison every later diff; append
+        # from a one-shot invocation after the run.  An EMPTY report is
+        # never appended either: a mistyped --run-dir writing
+        # {wall_s: 0} would make the next diff compare a real run
+        # against nothing and mask a real regression.
+        if args.watch:
+            print("not appending to the goodput ledger under --watch "
+                  "(the run is still in progress — append with a "
+                  "one-shot `tpucfn obs goodput --ledger` after it "
+                  "ends)", file=sys.stderr)
+        elif cache["report"]["num_hosts"] == 0:
+            print("not appending to the goodput ledger: no ledgers "
+                  "found (wrong --run-dir?)", file=sys.stderr)
+        else:
+            from tpucfn.obs.goodput import append_goodput_ledger
+
+            path = append_goodput_ledger(
+                args.ledger, cache["report"],
+                run_dir=str(run_dir if run_dir else goodput_dir))
+            print(f"appended goodput row to {path}", file=sys.stderr)
     while args.watch:
         _time.sleep(args.watch)
         print()
         show(one_pass())
+    return 0
+
+
+def cmd_obs_postmortem(args) -> int:
+    """Assemble one incident's forensic bundle (ISSUE 6 tentpole): the
+    enriched incident row, the skew-corrected timeline windowed around
+    detection, the window's goodput buckets, every host's flight-
+    recorder tail, and the last heartbeat per host — as a bundle
+    directory + rendered report."""
+    import json as _json
+
+    from tpucfn.obs.postmortem import (build_postmortem, render_postmortem,
+                                       write_bundle)
+
+    if not args.run_dir:
+        print("error: --run-dir required", file=sys.stderr)
+        return 2
+    run_dir = Path(args.run_dir).expanduser()
+    try:
+        report = build_postmortem(
+            run_dir, incident_id=args.incident, window_s=args.window,
+            ft_dir=args.ft_dir)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    inc = report["incident"]["incident"]
+    out = (Path(args.out) if args.out
+           else run_dir / "postmortem" / f"incident-{inc:03d}")
+    bundle = write_bundle(report, out)
+    if args.json:
+        print(_json.dumps({**report, "bundle": str(bundle)}))
+    else:
+        print(render_postmortem(report))
+        print(f"\nbundle: {bundle}")
+    return 0
+
+
+def cmd_obs_profile(args) -> int:
+    """Client for the on-demand profiler capture (ISSUE 6): POST
+    /profile?seconds=S against a host's obs endpoint; prints the JSON
+    body naming the artifact directory (an XProf/TensorBoard trace on
+    that host)."""
+    import urllib.error
+    import urllib.request
+
+    host = args.host
+    if ":" not in host:
+        if not args.port:
+            print("error: --port required when --host has no :port",
+                  file=sys.stderr)
+            return 2
+        host = f"{host}:{args.port}"
+    url = f"http://{host}/profile?seconds={args.seconds:g}"
+    req = urllib.request.Request(url, data=b"", method="POST")
+    timeout = args.timeout or args.seconds + 120.0
+    try:
+        # The server blocks for the capture duration; pad the client
+        # timeout generously — profiler session setup alone can take
+        # tens of seconds on a busy host (a timed-out client does NOT
+        # cancel the server-side capture; it completes and the artifact
+        # still lands in the profile dir).
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            body = r.read().decode()
+    except urllib.error.HTTPError as e:
+        detail = e.read().decode(errors="replace").strip()
+        print(f"error: {url} -> {e.code}: {detail}", file=sys.stderr)
+        return 1
+    except (urllib.error.URLError, OSError) as e:
+        print(f"error: {url} unreachable: {e}", file=sys.stderr)
+        return 1
+    print(body.strip())
+    return 0
+
+
+def cmd_obs_diff(args) -> int:
+    """Compare the last two rows of the cross-run goodput ledger
+    (ISSUE 6 satellite): goodput_ratio and per-bucket share deltas —
+    the regression check MFU alone cannot do."""
+    import json as _json
+
+    from tpucfn.obs.aggregate import render_table
+    from tpucfn.obs.goodput import diff_goodput_rows, read_goodput_ledger
+
+    rows, skipped = read_goodput_ledger(args.ledger)
+    if len(rows) < 2:
+        print(f"error: need at least 2 goodput_run rows in {args.ledger} "
+              f"(have {len(rows)}; append with `tpucfn obs goodput "
+              "--run-dir R --ledger`)", file=sys.stderr)
+        return 1
+    diff = diff_goodput_rows(rows[-2], rows[-1])
+    if args.json:
+        print(_json.dumps({**diff, "skipped_lines": skipped}))
+        return 0
+    print(f"# goodput diff  {args.ledger}  (last two of {len(rows)} rows)")
+    print(f"prev: {diff['prev']['run_dir']}  "
+          f"ratio={diff['prev']['goodput_ratio']}")
+    print(f"last: {diff['last']['run_dir']}  "
+          f"ratio={diff['last']['goodput_ratio']}")
+    d = diff["goodput_ratio_delta"]
+    print("goodput_ratio delta: "
+          + (f"{d:+.4f}" if d is not None else "n/a"))
+    print()
+    print(render_table(diff["buckets"],
+                       ["bucket", "prev_share", "last_share", "delta"]))
     return 0
 
 
@@ -910,6 +1059,10 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--slo-objective", type=_slo_objective, default=0.99,
                     help="fraction of requests that must meet each target "
                          "(exclusive (0, 1))")
+    sv.add_argument("--slo-shed", action="store_true",
+                    help="SLO-aware early shedding: 429 new requests while "
+                         "the rolling-window burn rate is sustained above "
+                         "1 (sheds counted in serve_slo_shed_total)")
     sv.add_argument("--seed", type=int, default=0)
     sv.add_argument("--obs-port", type=int, default=None, metavar="PORT",
                     help="serve /metrics, /healthz, /varz on PORT while the "
@@ -960,7 +1113,67 @@ def build_parser() -> argparse.ArgumentParser:
     og.add_argument("--watch", type=float, default=argparse.SUPPRESS,
                     metavar="SECONDS",
                     help="re-read and re-render every N seconds")
+    og.add_argument("--ledger", nargs="?", metavar="PATH",
+                    const="runs/goodput_ledger.jsonl", default=None,
+                    help="also append this run's report as one JSON row "
+                         "to the cross-run regression ledger (default "
+                         "runs/goodput_ledger.jsonl); diff with "
+                         "`tpucfn obs diff`")
     og.set_defaults(fn=cmd_obs_goodput)
+
+    pm = obsub.add_parser(
+        "postmortem",
+        help="assemble one incident's forensic bundle: incident row, "
+             "skew-corrected timeline window, goodput span, per-host "
+             "flight-recorder tails, last heartbeats")
+    pm.add_argument("--run-dir", default=argparse.SUPPRESS,
+                    help="the training --run-dir (expects ft/, trace/, "
+                         "goodput/, flight/ beneath)")
+    pm.add_argument("--ft-dir", default=None,
+                    help="explicit ft dir (default RUN/ft)")
+    which = pm.add_mutually_exclusive_group()
+    which.add_argument("--incident", type=int, default=None,
+                       help="incident number (from events.jsonl / "
+                            "`tpucfn ft status`)")
+    which.add_argument("--latest", action="store_true",
+                       help="the newest incident (the default)")
+    pm.add_argument("--window", type=float, default=15.0, metavar="SECONDS",
+                    help="timeline/goodput window padding around "
+                         "detection..recovery")
+    pm.add_argument("--out", metavar="DIR",
+                    help="bundle directory (default "
+                         "RUN/postmortem/incident-NNN)")
+    pm.add_argument("--json", action="store_true", default=argparse.SUPPRESS,
+                    help="emit the full report (+ bundle path) as JSON")
+    pm.set_defaults(fn=cmd_obs_postmortem)
+
+    pf = obsub.add_parser(
+        "profile",
+        help="trigger an on-demand jax.profiler capture on a host via "
+             "its obs endpoint (POST /profile)")
+    pf.add_argument("--host", required=True, metavar="HOST[:PORT]",
+                    help="obs endpoint address (the launch banner prints "
+                         "each host's port)")
+    pf.add_argument("--port", type=int, default=0,
+                    help="port when --host has none")
+    pf.add_argument("--seconds", type=float, default=2.0,
+                    help="capture duration")
+    pf.add_argument("--timeout", type=float, default=0.0,
+                    help="client timeout (default: seconds + 120 — "
+                         "profiler session setup can take tens of "
+                         "seconds on a busy host)")
+    pf.set_defaults(fn=cmd_obs_profile)
+
+    df = obsub.add_parser(
+        "diff",
+        help="compare goodput_ratio + bucket shares between the last "
+             "two rows of the cross-run goodput ledger")
+    df.add_argument("--ledger", default="runs/goodput_ledger.jsonl",
+                    help="ledger path (written by `tpucfn obs goodput "
+                         "--ledger`)")
+    df.add_argument("--json", action="store_true", default=argparse.SUPPRESS,
+                    help="emit the diff as one JSON object")
+    df.set_defaults(fn=cmd_obs_diff)
 
     return p
 

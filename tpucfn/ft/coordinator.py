@@ -72,6 +72,8 @@ class GangCoordinator(ChaosTarget):
         ckpt_dir: str | Path | None = None,
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
+        capture_flight: bool = True,
+        flight_timeout_s: float = 2.0,
     ):
         self.launcher = launcher
         self.argv = list(argv)
@@ -86,6 +88,8 @@ class GangCoordinator(ChaosTarget):
         self.ckpt_dir = Path(ckpt_dir) if ckpt_dir is not None else None
         self.clock = clock
         self.sleep = sleep
+        self.capture_flight = capture_flight
+        self.flight_timeout_s = flight_timeout_s
 
         if registry is None:
             # Throwaway registry: identical flow, nothing exported —
@@ -197,6 +201,79 @@ class GangCoordinator(ChaosTarget):
         victim = corrupt_latest_checkpoint(self.ckpt_dir, rng)
         self._event("chaos_ckpt_corrupted",
                     path=None if victim is None else str(victim))
+
+    # -- flight capture (ISSUE 6) -----------------------------------------
+
+    def _capture_flight(self, incident: int, failed: set[int]) -> None:
+        """Pull every surviving host's flight-recorder ring over its obs
+        endpoint BEFORE the gang is stopped — the dead host's last
+        seconds are in its own signal/atexit dump, but the survivors'
+        rings live only in memory and the restart is about to erase
+        them.  Best-effort and CONCURRENT with one shared deadline:
+        MTTR includes this call by design (forensics are part of
+        incident handling), so its cost must be ~``flight_timeout_s``
+        total, not per survivor — a 32-host gang with several
+        unreachable endpoints must not serialize 2s timeouts while the
+        doomed gang keeps executing steps that will be rewound."""
+        base = getattr(self.launcher, "obs_base_port", None)
+        if not base or self.ft_dir is None or not self.capture_flight:
+            return
+        import concurrent.futures
+        import urllib.request
+
+        from tpucfn.obs.flight import incident_flight_path, write_flight_dump
+
+        hosts = self.launcher.contract.hosts()[
+            : self.launcher.contract.workers_count]
+        targets = [(h, hosts[h].rsplit(":", 1)[0])
+                   for h, p in sorted(self._procs.items())
+                   if h not in failed and p.poll() is None]
+        if not targets:
+            return
+
+        def fetch(host_id: int, addr: str):
+            url = f"http://{addr}:{base + 1 + host_id}/flightrecorder"
+            with urllib.request.urlopen(
+                    url, timeout=self.flight_timeout_s) as r:
+                return json.loads(r.read().decode())
+
+        out_dir = self.ft_dir / "flight"
+        captured, errors = [], 0
+        # One worker PER survivor, not a smaller pool: with a capped
+        # pool, >=cap hung endpoints (plausibly the incident itself)
+        # would hold every worker for the whole deadline and the
+        # healthy hosts' queued fetches would never start — losing the
+        # captures for exactly the hosts that could answer.
+        pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=len(targets),
+            thread_name_prefix="flight-capture")
+        try:
+            futs = {pool.submit(fetch, h, addr): h for h, addr in targets}
+            done, pending = concurrent.futures.wait(
+                futs, timeout=self.flight_timeout_s + 0.5)
+            errors += len(pending)
+            for f in done:
+                host_id = futs[f]
+                try:
+                    body = f.result()
+                except Exception:  # noqa: BLE001 — best-effort
+                    errors += 1
+                    continue
+                if not isinstance(body, dict):
+                    errors += 1
+                    continue
+                out_dir.mkdir(parents=True, exist_ok=True)
+                write_flight_dump(
+                    incident_flight_path(out_dir, incident, host_id), body)
+                captured.append(host_id)
+        finally:
+            # don't block recovery on stragglers: per-request socket
+            # timeouts bound the leaked workers' lifetimes anyway
+            pool.shutdown(wait=False)
+        captured.sort()
+        if captured or errors:
+            self._event("flight_capture", incident=incident,
+                        hosts=captured, errors=errors)
 
     # -- event / snapshot plumbing ---------------------------------------
 
@@ -392,6 +469,10 @@ class GangCoordinator(ChaosTarget):
         if self.tracer is not None:
             self.tracer.event("ft_detect", trace_id=incident,
                               failures=fail_json)
+        if real:
+            # Forensics before recovery: the survivors' flight rings are
+            # about to be killed with the gang (ISSUE 6 tentpole).
+            self._capture_flight(incident, {f.host_id for f in real})
         decision = self.policy.decide(failures)
         self._event("decide", incident=incident,
                     action=decision.action.value,

@@ -4,6 +4,13 @@ from tpucfn.obs.metrics import (  # noqa: F401
     MetricLogger,
     StepTimer,
     Summary,
+    device_memory_stats,
+    register_device_gauges,
+)
+from tpucfn.obs.flight import (  # noqa: F401
+    FlightRecorder,
+    read_flight_dir,
+    read_flight_file,
 )
 from tpucfn.obs.goodput import (  # noqa: F401
     GoodputLedger,
@@ -12,6 +19,9 @@ from tpucfn.obs.goodput import (  # noqa: F401
     read_goodput_dir,
 )
 from tpucfn.obs.profiler import (  # noqa: F401
+    CompileCacheProbe,
+    ProfileCapture,
+    ProfilerBusy,
     enable_compile_cache,
     profile_steps,
     start_profiler_server,

@@ -305,6 +305,17 @@ def apply_clock_skew(events: list[dict],
     return out
 
 
+def window_events(events: Iterable[dict], start: float,
+                  end: float) -> list[dict]:
+    """The skew-corrected events inside ``[start, end]`` — the slice a
+    postmortem renders around an incident.  Operates on ``ts_adj`` (the
+    :func:`apply_clock_skew` annotation) so the window means the same
+    instant on every host; events without one (no wall clock recorded)
+    cannot be placed and are excluded."""
+    return [e for e in events
+            if e.get("ts_adj") is not None and start <= e["ts_adj"] <= end]
+
+
 class JsonlTailer:
     """Incremental multi-file JSONL reader for ``--watch`` mode.
 

@@ -62,11 +62,18 @@ from pathlib import Path
 from typing import Iterable
 
 # Buckets the writer records explicitly; idle / lost_work /
-# restart_downtime are derived by the merge.
-RECORDED_BUCKETS = ("step", "compile", "data_wait", "ckpt")
+# restart_downtime are derived by the merge.  ``compile_cached`` is the
+# warm-restart refinement (ISSUE 6 satellite): a first step served from
+# the persistent compile cache pays deserialization + warmup, not a real
+# XLA compile — ``TrainerObs`` splits the two via CompileCacheProbe so
+# warm restarts stop inflating ``compile`` (old ledgers that only ever
+# wrote ``compile`` merge unchanged).
+RECORDED_BUCKETS = ("step", "compile", "compile_cached", "data_wait",
+                    "ckpt")
 DERIVED_BUCKETS = ("idle", "lost_work", "restart_downtime")
-REPORT_BUCKETS = ("productive_step", "compile", "data_wait", "ckpt",
-                  "lost_work", "idle", "restart_downtime")
+REPORT_BUCKETS = ("productive_step", "compile", "compile_cached",
+                  "data_wait", "ckpt", "lost_work", "idle",
+                  "restart_downtime")
 
 LEDGER_GLOB = "goodput-host*.jsonl"
 
@@ -360,11 +367,12 @@ def host_goodput(records: Iterable[dict]) -> dict:
                 if step is not None:
                     max_step = step if max_step is None else max(max_step,
                                                                  step)
-            else:  # compile / data_wait / ckpt
+            else:  # compile / compile_cached / data_wait / ckpt
                 buckets[bucket] += dur
                 # compile of a re-run window still advances max_step so
                 # the re-run detector has the right horizon
-                if bucket == "compile" and step is not None:
+                if bucket in ("compile", "compile_cached") \
+                        and step is not None:
                     max_step = step if max_step is None else max(max_step,
                                                                  step)
         elif kind == "close":
@@ -549,6 +557,73 @@ def goodput_report(goodput_dir: str | Path,
         events, ev_skipped = read_ft_events(ft_events_path)
         skipped += ev_skipped
     return merge_goodput(by_host, events, skipped_lines=skipped)
+
+
+def append_goodput_ledger(path: str | Path, report: dict, *,
+                          run_dir: str = "", extra: dict | None = None
+                          ) -> Path:
+    """Cross-run regression ledger (ISSUE 6 satellite): append ONE
+    BENCH-row-style JSON line per run to ``path`` so goodput_ratio and
+    bucket shares can be diffed across PRs — a perf change that trades
+    step time for data stalls is invisible to MFU alone but obvious
+    here.  ``tpucfn obs diff`` compares the last two rows."""
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    wall = report.get("wall_s") or 0.0
+    buckets = report.get("buckets") or {}
+    row = {
+        "kind": "goodput_run",
+        "t": time.time(),
+        "run_dir": run_dir,
+        "wall_s": wall,
+        "goodput_ratio": report.get("goodput_ratio"),
+        "num_hosts": report.get("num_hosts"),
+        "productive_steps": report.get("productive_steps"),
+        "lost_steps": report.get("lost_steps"),
+        "incidents": len(report.get("incidents") or ()),
+        "buckets": dict(buckets),
+        "shares": {b: (v / wall if wall > 0 else None)
+                   for b, v in buckets.items()},
+        **(extra or {}),
+    }
+    with open(p, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    return p
+
+
+def read_goodput_ledger(path: str | Path) -> tuple[list[dict], int]:
+    """The ledger's ``goodput_run`` rows in file order (torn/foreign
+    lines skipped and counted — the file is append-shared)."""
+    recs, skipped = read_jsonl_counting(path)
+    rows = [r for r in recs if r.get("kind") == "goodput_run"]
+    skipped += len(recs) - len(rows)
+    return rows, skipped
+
+
+def diff_goodput_rows(prev: dict, last: dict) -> dict:
+    """Bucket-share and goodput-ratio deltas between two ledger rows
+    (``last - prev``; positive share delta = that bucket ate MORE of the
+    wall).  Buckets are the union of both rows, REPORT_BUCKETS order
+    first so the table reads the same as ``tpucfn obs goodput``."""
+    ps, ls = prev.get("shares") or {}, last.get("shares") or {}
+    names = [b for b in REPORT_BUCKETS if b in ps or b in ls]
+    names += sorted((set(ps) | set(ls)) - set(names))
+    rows = []
+    for b in names:
+        a, z = ps.get(b), ls.get(b)
+        rows.append({"bucket": b, "prev_share": a, "last_share": z,
+                     "delta": (z - a) if (a is not None and z is not None)
+                     else None})
+    pr, lr = prev.get("goodput_ratio"), last.get("goodput_ratio")
+    return {
+        "prev": {"t": prev.get("t"), "run_dir": prev.get("run_dir"),
+                 "goodput_ratio": pr, "wall_s": prev.get("wall_s")},
+        "last": {"t": last.get("t"), "run_dir": last.get("run_dir"),
+                 "goodput_ratio": lr, "wall_s": last.get("wall_s")},
+        "goodput_ratio_delta": (lr - pr) if (pr is not None
+                                             and lr is not None) else None,
+        "buckets": rows,
+    }
 
 
 def render_goodput(report: dict) -> str:

@@ -92,6 +92,84 @@ class ComputedGauge(Gauge):
                 "via computed_gauge() elsewhere")
 
 
+def device_memory_stats(device=None) -> dict | None:
+    """``device.memory_stats()`` with the None-safety every caller
+    needs: CPU backends (and mocked devices) return ``None`` or raise —
+    both become ``None`` here, so telemetry callers sample-or-skip
+    instead of crashing the loop they ride on.  ``device=None`` reads
+    the process's first device."""
+    try:
+        if device is None:
+            device = jax.devices()[0]
+        stats = device.memory_stats()
+    except Exception:  # noqa: BLE001 — telemetry is best-effort
+        return None
+    return stats if isinstance(stats, dict) else None
+
+
+# Exposition name -> memory_stats() key.  Registered only when the
+# backend actually reports the key: a CPU host's /metrics simply lacks
+# the series (absent beats a lying 0 — dashboards treat 0 as "empty
+# HBM", absence as "no HBM").
+_HBM_GAUGES = (
+    ("device_hbm_used_bytes", "bytes_in_use",
+     "device memory in use right now"),
+    ("device_hbm_peak_bytes", "peak_bytes_in_use",
+     "high-water device memory since process start"),
+    ("device_hbm_limit_bytes", "bytes_limit",
+     "device memory capacity visible to the allocator"),
+)
+
+
+def register_device_gauges(registry, device=None, *,
+                           jit_sources=()) -> list[str]:
+    """Live device telemetry on ``registry`` (ISSUE 6): ``device_hbm_*``
+    computed gauges reading ``memory_stats()`` at scrape time, plus
+    ``jit_cache_programs`` summing the compiled-program counts of the
+    jitted entry points in ``jit_sources`` (callables returning the
+    jitted function, or None while it is not built yet — the trainer
+    compiles lazily).  Returns the registered names; empty on backends
+    with no memory stats and no jit sources."""
+    names: list[str] = []
+    if device is None:
+        try:
+            device = jax.devices()[0]
+        except Exception:  # noqa: BLE001 — no backend, no telemetry
+            device = None
+    stats = device_memory_stats(device) if device is not None else None
+    if stats is not None:
+        for name, key, help_ in _HBM_GAUGES:
+            if key not in stats:
+                continue
+
+            def _read(key=key, device=device) -> float:
+                v = (device_memory_stats(device) or {}).get(key)
+                try:
+                    return float(v)
+                except (TypeError, ValueError):
+                    return 0.0
+
+            registry.computed_gauge(name, _read, help_)
+            names.append(name)
+    if jit_sources:
+        def _jit_programs(sources=tuple(jit_sources)) -> float:
+            total = 0
+            for get in sources:
+                try:
+                    f = get()
+                    if f is not None:
+                        total += int(f._cache_size())
+                except Exception:  # noqa: BLE001 — jax internals may move
+                    continue
+            return float(total)
+
+        registry.computed_gauge(
+            "jit_cache_programs", _jit_programs,
+            "compiled programs held by the process's jit caches")
+        names.append("jit_cache_programs")
+    return names
+
+
 class Summary:
     """Streaming distribution (TTFT, per-request latency): count/sum
     always exact; percentiles over a bounded reservoir of the most

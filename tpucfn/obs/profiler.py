@@ -9,7 +9,11 @@ range trace a flag on every example: traces capture XLA op timelines
 from __future__ import annotations
 
 import contextlib
+import itertools
+import math
 import os
+import threading
+import time
 from pathlib import Path
 
 import jax
@@ -48,6 +52,131 @@ def enable_compile_cache(cache_dir: str | None = None) -> str:
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     return cache_dir
+
+
+class ProfilerBusy(RuntimeError):
+    """A capture is already running (jax allows one active trace per
+    process); the obs server maps this to HTTP 409."""
+
+
+class ProfileCapture:
+    """On-demand profiler capture behind ``POST /profile`` (ISSUE 6).
+
+    Each call traces everything the process does for ``seconds`` into a
+    fresh numbered subdirectory of ``log_dir`` and — when a ``tracer``
+    is attached — records a ``profile_capture`` span whose attrs link
+    the artifact path into the merged ``tpucfn obs`` timeline (the
+    operator sees *when* the capture ran relative to steps/incidents,
+    and where the XProf trace landed).
+
+    One capture at a time: jax owns a single global trace, so a second
+    concurrent request raises :class:`ProfilerBusy` instead of silently
+    corrupting the first capture.  ``capture_fn`` is injectable (tests
+    swap the real ``jax.profiler`` start/stop for a recorder).
+    """
+
+    MAX_SECONDS = 600.0
+
+    def __init__(self, log_dir: str | Path, *, tracer=None,
+                 capture_fn=None, sleep=time.sleep):
+        self.log_dir = Path(log_dir)
+        self.tracer = tracer
+        self.sleep = sleep
+        self._capture_fn = capture_fn
+        self._lock = threading.Lock()
+        self._n = itertools.count(1)
+
+    def _capture(self, d: Path, seconds: float) -> None:
+        if self._capture_fn is not None:
+            self._capture_fn(d, seconds)
+            return
+        jax.profiler.start_trace(str(d))
+        try:
+            self.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+
+    def __call__(self, seconds: float) -> dict:
+        if not math.isfinite(seconds) or not 0 < seconds <= self.MAX_SECONDS:
+            raise ValueError(
+                f"seconds must be in (0, {self.MAX_SECONDS:g}], "
+                f"got {seconds}")
+        if not self._lock.acquire(blocking=False):
+            raise ProfilerBusy("a profiler capture is already running")
+        try:
+            d = self.log_dir / f"capture-{os.getpid()}-{next(self._n):03d}"
+            d.mkdir(parents=True, exist_ok=True)
+            t0 = time.monotonic()
+            self._capture(d, seconds)
+            t1 = time.monotonic()
+            if self.tracer is not None:
+                self.tracer.record("profile_capture", start=t0, end=t1,
+                                   artifact=str(d), seconds=seconds)
+            return {"artifact": str(d), "seconds": seconds,
+                    "dur_s": round(t1 - t0, 4)}
+        finally:
+            self._lock.release()
+
+
+class CompileCacheProbe:
+    """Did the first step's XLA compile come from the persistent cache?
+
+    The goodput ledger charges the whole first step of each incarnation
+    to ``compile``; a warm restart (persistent cache hit via
+    :func:`enable_compile_cache`) pays deserialization + warmup instead
+    of a real compile, and lumping the two inflates the bucket (ISSUE 6
+    satellite).  The signal is the cache directory itself, observed
+    over the first step (arm/:meth:`rearm` before, :meth:`hit` after):
+
+    * new entries appeared -> the compiler ran and persisted: **miss**;
+    * an existing ``*-atime`` sidecar was rewritten -> jax's cache
+      ``get`` unconditionally stamps the access-time file on every
+      read, so a served-from-cache load leaves exactly this trace:
+      **hit**;
+    * neither -> **unknown** — the cache is disabled, the layout has no
+      atime sidecars, or the compile ran under the min-compile-time
+      persistence threshold (nothing read, nothing written) — charge
+      plain ``compile``; no number beats a wrong number.  Notably a
+      SHARED non-empty cache dir holding none of this run's programs
+      stays unknown, not a phantom hit.
+    """
+
+    def __init__(self, cache_dir: str | Path):
+        self.cache_dir = Path(cache_dir)
+        self._before = self._snapshot()
+
+    def _snapshot(self) -> tuple[int, int]:
+        """(entry count, newest ``*-atime`` mtime_ns): persists move
+        the first, cache reads move the second."""
+        count, atime_ns = 0, 0
+        try:
+            for p in self.cache_dir.iterdir():
+                count += 1
+                if p.name.endswith("-atime"):
+                    try:
+                        atime_ns = max(atime_ns, p.stat().st_mtime_ns)
+                    except OSError:
+                        continue  # racing eviction
+        except OSError:
+            pass
+        return count, atime_ns
+
+    def rearm(self) -> None:
+        """Re-snapshot both signals.  TrainerObs calls this at the
+        FIRST step's entry: programs compiled (or cache-loaded) between
+        enabling the cache and the loop reaching step 1 — checkpoint
+        restore's re-materialize copy, eval_shape probes — move them
+        too, and counting those against the step would misread every
+        resumed run."""
+        self._before = self._snapshot()
+
+    def hit(self) -> bool | None:
+        count, atime_ns = self._snapshot()
+        if count > self._before[0]:
+            return False
+        if atime_ns > self._before[1]:
+            return True
+        return None
 
 
 @contextlib.contextmanager

@@ -11,6 +11,15 @@ scrapeable (ISSUE 2: the reference's answer was ssh + tail over
   balancers and the restart supervisor probe).
 * ``GET /varz``    — the registry's full JSON snapshot (counters plus
   summary/histogram decompositions), for humans and ``tpucfn obs``.
+* ``GET /flightrecorder`` — the attached
+  :class:`~tpucfn.obs.flight.FlightRecorder`'s ring as JSON (ISSUE 6):
+  the last-N-seconds snapshot the gang coordinator pulls from surviving
+  hosts at detect time, and operators pull ad hoc.  404 when no
+  recorder is attached.
+* ``POST /profile?seconds=S`` — on-demand ``jax.profiler`` capture via
+  the attached :class:`~tpucfn.obs.profiler.ProfileCapture`: blocks for
+  S seconds, returns the artifact directory as JSON (409 while another
+  capture runs, 404 when none is attached).
 
 Port convention: ``TPUCFN_OBS_PORT`` carries each process's assigned
 port (the launcher assigns ``base + 1 + host_id`` per host, keeping
@@ -28,6 +37,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
+from tpucfn.obs.profiler import ProfilerBusy
 from tpucfn.obs.registry import MetricRegistry, default_registry
 
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -41,11 +51,20 @@ class ObsServer:
 
     def __init__(self, registry: MetricRegistry | None = None, *,
                  port: int = 0, host: str = "0.0.0.0", role: str = "",
-                 host_id: int | None = None, health_fn: HealthFn | None = None):
+                 host_id: int | None = None, health_fn: HealthFn | None = None,
+                 flight=None, profiler=None):
+        """``flight`` is a :class:`~tpucfn.obs.flight.FlightRecorder`
+        (or anything with ``snapshot() -> dict``) behind
+        ``/flightrecorder``; ``profiler`` is a callable
+        ``(seconds) -> dict`` (normally
+        :class:`~tpucfn.obs.profiler.ProfileCapture`) behind
+        ``POST /profile``.  Either None leaves its route 404."""
         self.registry = registry if registry is not None else default_registry()
         self.role = role
         self.host_id = host_id
         self.health_fn = health_fn
+        self.flight = flight
+        self.profiler = profiler
         self._t0 = time.monotonic()
         obs = self
 
@@ -72,10 +91,50 @@ class ObsServer:
                 elif path == "/varz":
                     self._send(200, json.dumps(obs.registry.varz()).encode(),
                                "application/json")
+                elif path == "/flightrecorder":
+                    if obs.flight is None:
+                        self._send(404, b"no flight recorder attached\n",
+                                   "text/plain")
+                    else:
+                        self._send(200,
+                                   json.dumps(obs.flight.snapshot()).encode(),
+                                   "application/json")
                 elif path == "/":
-                    self._send(200, b"/metrics /healthz /varz\n", "text/plain")
+                    self._send(200,
+                               b"/metrics /healthz /varz /flightrecorder "
+                               b"POST /profile\n", "text/plain")
                 else:
                     self._send(404, b"not found\n", "text/plain")
+
+            def do_POST(self):
+                path, _, query = self.path.partition("?")
+                path = path.rstrip("/") or "/"
+                if path != "/profile":
+                    self._send(404, b"not found\n", "text/plain")
+                    return
+                if obs.profiler is None:
+                    self._send(404, b"no profiler attached\n", "text/plain")
+                    return
+                from urllib.parse import parse_qs
+
+                raw = parse_qs(query).get("seconds", ["1.0"])[0]
+                try:
+                    seconds = float(raw)
+                except ValueError:
+                    self._send(400, f"seconds={raw!r} is not a number\n"
+                               .encode(), "text/plain")
+                    return
+                try:
+                    result = obs.profiler(seconds)
+                except ValueError as e:  # bad duration (<=0, non-finite...)
+                    self._send(400, (str(e) + "\n").encode(), "text/plain")
+                except ProfilerBusy as e:
+                    self._send(409, (str(e) + "\n").encode(), "text/plain")
+                except Exception as e:  # noqa: BLE001 — capture failed
+                    self._send(500, (repr(e) + "\n").encode(), "text/plain")
+                else:
+                    self._send(200, json.dumps(result).encode(),
+                               "application/json")
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
@@ -131,7 +190,8 @@ def start_obs_server(registry: MetricRegistry | None = None, *,
                      port: int | None = None, role: str = "",
                      host: str = "0.0.0.0",
                      host_id: int | None = None,
-                     health_fn: HealthFn | None = None) -> ObsServer | None:
+                     health_fn: HealthFn | None = None,
+                     flight=None, profiler=None) -> ObsServer | None:
     """Start the endpoint for this process; ``port=None`` consults
     ``TPUCFN_OBS_PORT`` and returns None when the env opted out — the
     one-liner every role calls unconditionally."""
@@ -140,4 +200,5 @@ def start_obs_server(registry: MetricRegistry | None = None, *,
         if port is None:
             return None
     return ObsServer(registry, port=port, host=host, role=role,
-                     host_id=host_id, health_fn=health_fn)
+                     host_id=host_id, health_fn=health_fn,
+                     flight=flight, profiler=profiler)

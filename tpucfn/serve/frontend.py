@@ -145,6 +145,10 @@ class ServingMetrics:
             "prompt tokens served by copy_prefix instead of prefill")
         self.prefill_batch_size = r.summary(
             "serve_prefill_batch_size", "sequences per prefill call")
+        self.slo_shed = r.counter(
+            "serve_slo_shed_total",
+            "requests shed at submit because the SLO burn rate was "
+            "sustained above 1 (--slo-shed)")
         self.queue_depth = r.gauge(
             "serve_queue_depth", "requests waiting (frontend + scheduler)")
         self.running = r.gauge(
@@ -171,6 +175,7 @@ class ServingMetrics:
             "prefix_hit_requests": self.prefix_hit_requests.value,
             "prefix_hit_tokens": self.prefix_hit_tokens.value,
             "prefill_batch_size": self.prefill_batch_size.snapshot(),
+            "slo_shed": self.slo_shed.value,
             "queue_depth": self.queue_depth.value,
             "running_sequences": self.running.value,
             "kv_cache_occupancy": self.cache_occupancy.value,
@@ -213,6 +218,14 @@ class SLOTracker:
         self.clock = clock
         self._lock = threading.Lock()
         self._window: deque[tuple[float, bool, bool]] = deque()
+        # Running violation counts for the CURRENT window, maintained
+        # incrementally by record()/_evict(): the burn gauges and the
+        # shed check run per scrape / per submit, and re-summing a
+        # 60s-of-traffic deque under the lock each time would make
+        # admission cost grow linearly with throughput — worst exactly
+        # under the overload shedding exists for.
+        self._win_ttft_bad = 0
+        self._win_tpot_bad = 0
         self.requests = r.counter(
             "serve_slo_requests_total", "requests scored against the SLOs")
         self.ttft_violations = r.counter(
@@ -258,23 +271,31 @@ class SLOTracker:
             self.tpot_violations.add()
         with self._lock:
             self._window.append((now, ttft_ok, tpot_ok))
+            if not ttft_ok:
+                self._win_ttft_bad += 1
+            if not tpot_ok:
+                self._win_tpot_bad += 1
             self._evict(now)
 
     def _evict(self, now: float) -> None:
         cutoff = now - self.window_s
         while self._window and self._window[0][0] < cutoff:
-            self._window.popleft()
+            _, ttft_ok, tpot_ok = self._window.popleft()
+            if not ttft_ok:
+                self._win_ttft_bad -= 1
+            if not tpot_ok:
+                self._win_tpot_bad -= 1
 
     def _window_stats(self) -> tuple[int, int, int]:
         """``(requests, ttft_violations, tpot_violations)`` in the
         rolling window AS OF NOW — evicts first, so idle time decays the
-        window between requests (the computed gauges read this)."""
+        window between requests (the computed gauges read this).
+        O(evictions), not O(window): the counts are maintained
+        incrementally by record()/_evict()."""
         with self._lock:
             self._evict(self.clock())
-            n = len(self._window)
-            ttft_bad = sum(1 for _, ok, _t in self._window if not ok)
-            tpot_bad = sum(1 for _, _f, ok in self._window if not ok)
-        return n, ttft_bad, tpot_bad
+            return len(self._window), self._win_ttft_bad, \
+                self._win_tpot_bad
 
     def _burn(self, bad: int, n: int) -> float:
         """Burn rate = window violation rate / error budget.  The ONE
@@ -282,6 +303,20 @@ class SLOTracker:
         /metrics series and serve_bench's BENCH row must never
         disagree."""
         return bad / n / (1.0 - self.objective) if n else 0.0
+
+    def should_shed(self, min_window: int = 8) -> bool:
+        """Shed-load verdict for the frontend's admission path (ISSUE 6
+        satellite): True when EITHER burn rate is above 1 over the
+        rolling window — the error budget is being consumed faster than
+        it refills, so rejecting now beats breaching the SLO later.
+        ``min_window`` scored requests are required before any verdict:
+        one bad request over an empty window is noise, and the window
+        itself is what makes the burn "sustained"."""
+        n, ttft_bad, tpot_bad = self._window_stats()
+        if n < min_window:
+            return False
+        return (self._burn(ttft_bad, n) > 1.0
+                or self._burn(tpot_bad, n) > 1.0)
 
     def _ttft_burn_now(self) -> float:
         n, ttft_bad, _ = self._window_stats()
@@ -327,7 +362,22 @@ class Server:
                  prefix_cache: bool = True,
                  max_prefill_batch: int | None = None,
                  ttft_slo_s: float = 0.5, tpot_slo_s: float = 0.05,
-                 slo_objective: float = 0.99, slo_window_s: float = 60.0):
+                 slo_objective: float = 0.99, slo_window_s: float = 60.0,
+                 slo_shed: bool = False, shed_min_window: int = 8,
+                 shed_probe_every: int = 10,
+                 flight=None):
+        """``slo_shed`` arms SLO-aware early shedding: submit() rejects
+        with 429 while the rolling-window burn rate is sustained above 1
+        (``SLOTracker.should_shed``), shedding load BEFORE the SLO is
+        breached instead of after; sheds are counted in
+        ``serve_slo_shed_total``.  Every ``shed_probe_every``-th request
+        is admitted anyway as a PROBE: shed requests are never scored,
+        so without fresh scores the window would freeze and a transient
+        blip would 429 everything for the full window — probes that
+        complete healthily decay the burn and end the shed episode as
+        soon as the engine actually recovers.  ``flight`` is a
+        :class:`~tpucfn.obs.flight.FlightRecorder` receiving queue
+        depth / batch occupancy / scheduler-decision samples (ISSUE 6)."""
         self.engine = engine
         # Both ISSUE-3 fast paths are duck-typed off the engine so fakes
         # (and any decode-protocol engine without the batched entry
@@ -345,15 +395,20 @@ class Server:
         self.kv = KVCacheManager(
             num_blocks, block_size,
             prefix_cache=prefix_cache and self._can_copy_prefix)
+        self.flight = flight
         self.scheduler = ContinuousBatchingScheduler(
             self.kv, max_batch=engine.max_batch,
             cache_len=engine.cache_len, eos_id=eos_id,
-            max_prefill_batch=k)
+            max_prefill_batch=k, flight=flight)
         self.metrics = ServingMetrics(registry)
         self.slo = SLOTracker(self.metrics.registry, ttft_slo_s=ttft_slo_s,
                               tpot_slo_s=tpot_slo_s,
                               objective=slo_objective,
                               window_s=slo_window_s)
+        self.slo_shed_enabled = slo_shed
+        self.shed_min_window = shed_min_window
+        self.shed_probe_every = max(2, shed_probe_every)
+        self._shed_seen = 0  # requests arriving during a shed episode
         self.tracer = tracer if tracer is not None else Tracer(None)
         self.max_queued_tokens = max_queued_tokens
         self._lock = threading.Lock()
@@ -382,6 +437,28 @@ class Server:
                 f"prompt {len(prompt)} + max_new {max_new_tokens} exceeds "
                 f"engine capacity (cache_len {self.engine.cache_len}, "
                 f"{self.kv.allocator.num_blocks} KV blocks)", status=400)
+        if self.slo_shed_enabled and self.slo.should_shed(
+                self.shed_min_window):
+            # SLO-aware early shedding (ISSUE 6 satellite): the burn
+            # rate says the error budget is being consumed faster than
+            # it refills — reject NOW so in-flight traffic recovers,
+            # instead of admitting work that will breach the SLO.
+            # Every Nth arrival is admitted as a probe (see __init__):
+            # its completion score is the recovery signal that ends the
+            # episode.
+            with self._lock:  # submit() is any-thread: cadence must not race
+                self._shed_seen += 1
+                probe = self._shed_seen % self.shed_probe_every == 0
+            if not probe:
+                self.metrics.rejected.add()
+                self.metrics.slo_shed.add()
+                raise AdmissionError(
+                    "shedding load: SLO burn rate sustained above 1 over "
+                    f"the rolling {self.slo.window_s:g}s window (back off "
+                    "and retry)", status=429)
+        elif self.slo_shed_enabled:  # healthy again: reset the cadence
+            with self._lock:
+                self._shed_seen = 0
         with self._lock:
             if self._outstanding_tokens + budget > self.max_queued_tokens:
                 self.metrics.rejected.add()
@@ -516,6 +593,11 @@ class Server:
             t_pf1 = time.monotonic()
             self.metrics.prefill_calls.add()
             self.metrics.prefill_batch_size.observe(len(items))
+            if self.flight is not None:
+                self.flight.record(
+                    "sched", work="prefill", batch=len(items),
+                    bucket=work.bucket, dur_s=round(t_pf1 - t_pf0, 6),
+                    cached=sum(1 for it in items if it.cached_len))
             for it in items:
                 req = self._by_seq[it.seq.seq_id]
                 first = req.t_first_token is None
@@ -551,6 +633,10 @@ class Server:
             t_dec0 = time.monotonic()
             out = self.engine.decode(
                 {slot: seq.last_token for slot, seq in work.slots.items()})
+            if self.flight is not None:
+                self.flight.record(
+                    "sched", work="decode", batch=len(work.slots),
+                    dur_s=round(time.monotonic() - t_dec0, 6))
             if self.tracer.enabled:
                 self.tracer.record(
                     "decode_round", start=t_dec0, end=time.monotonic(),
@@ -572,10 +658,18 @@ class Server:
             self._complete(req, tokens=list(seq.generated))
 
     def _refresh_gauges(self) -> None:
-        self.metrics.queue_depth.set(len(self._incoming)
-                                     + self.scheduler.num_waiting)
-        self.metrics.running.set(self.scheduler.num_running)
-        self.metrics.cache_occupancy.set(self.kv.occupancy())
+        queue = len(self._incoming) + self.scheduler.num_waiting
+        running = self.scheduler.num_running
+        occupancy = self.kv.occupancy()
+        self.metrics.queue_depth.set(queue)
+        self.metrics.running.set(running)
+        self.metrics.cache_occupancy.set(occupancy)
+        if self.flight is not None:
+            # One ring sample per serve iteration: queue depth + batch
+            # occupancy are exactly the "what was the engine doing in
+            # its final seconds" series a postmortem reads (ISSUE 6).
+            self.flight.record("serve", queue=queue, running=running,
+                               occupancy=round(occupancy, 4))
 
     # -- driving modes -----------------------------------------------------
     def run_until_idle(self) -> None:

@@ -185,7 +185,12 @@ class ContinuousBatchingScheduler:
     def __init__(self, kv: KVCacheManager, *, max_batch: int, cache_len: int,
                  eos_id: int | None = None,
                  min_bucket: int = MIN_PREFILL_BUCKET,
-                 max_prefill_batch: int = 1):
+                 max_prefill_batch: int = 1,
+                 flight=None):
+        """``flight`` is a :class:`~tpucfn.obs.flight.FlightRecorder`
+        (or None): admissions and preemptions — the scheduler decisions
+        a postmortem wants in the final seconds — land in the ring as
+        ``admit``/``preempt`` samples (ISSUE 6)."""
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_prefill_batch < 1:
@@ -197,6 +202,7 @@ class ContinuousBatchingScheduler:
         self.eos_id = eos_id
         self.min_bucket = min_bucket
         self.max_prefill_batch = max_prefill_batch
+        self.flight = flight
         self.waiting: deque[Sequence] = deque()
         self.running: dict[int, Sequence] = {}
         self._free_slots = list(range(max_batch - 1, -1, -1))
@@ -357,6 +363,11 @@ class ContinuousBatchingScheduler:
         seq.state = SequenceState.RUNNING
         seq.prefilled = False
         self.running[slot] = seq
+        if self.flight is not None:
+            self.flight.record("admit", seq=seq.seq_id, slot=slot,
+                               bucket=plan.bucket,
+                               cached_len=plan.cached_len,
+                               preemptions=seq.preemptions)
         return PrefillItem(seq, slot, plan.cached_len, plan.src_slot)
 
     def _reserve_all(self) -> dict[int, Sequence]:
@@ -425,6 +436,9 @@ class ContinuousBatchingScheduler:
         seq.state = SequenceState.WAITING
         seq.preemptions += 1
         self.waiting.appendleft(seq)
+        if self.flight is not None:
+            self.flight.record("preempt", seq=seq.seq_id, slot=slot,
+                               generated=len(seq.generated))
         return seq
 
     def _vacate(self, slot: int, *, evicted: bool = False) -> None:

@@ -312,14 +312,24 @@ class TrainerObs:
 
     def __init__(self, registry=None, tracer=None, *, prefix: str = "train",
                  ledger=None, peak_flops: float | None = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, flight=None, compile_probe=None):
         """``ledger`` is a :class:`tpucfn.obs.goodput.GoodputLedger` (or
         None): every phase the loop reports is also attributed to the
         per-host goodput JSONL so ``tpucfn obs goodput`` can decompose
         the run's wall clock (ISSUE 5).  ``peak_flops``/:meth:`
         set_model_flops` arm the live ``{prefix}_mfu`` gauge; ``clock``
         is injectable so the gauges are pinned with a fake clock and no
-        TPU."""
+        TPU.
+
+        ``flight`` is a :class:`tpucfn.obs.flight.FlightRecorder` (or
+        None): every phase also lands one sample in the in-memory ring,
+        plus an ``hbm`` device-memory sample per step — the last-N-
+        seconds record a postmortem reads (ISSUE 6).  ``compile_probe``
+        is a :class:`tpucfn.obs.profiler.CompileCacheProbe` (or None):
+        when it reports the first step was served from the persistent
+        compile cache, the ledger charges ``compile_cached`` instead of
+        ``compile``, so warm restarts stop inflating the compile
+        bucket."""
         from tpucfn.obs.goodput import GoodputLedger
         from tpucfn.obs.registry import default_registry
         from tpucfn.obs.trace import Tracer
@@ -329,6 +339,8 @@ class TrainerObs:
         self.tracer = tracer if tracer is not None else Tracer(None)
         self.ledger = ledger if ledger is not None else GoodputLedger(None)
         self.clock = clock
+        self.flight = flight
+        self.compile_probe = compile_probe
         self.step_time = r.histogram(
             f"{prefix}_step_seconds", "host-observed step wall time")
         self.data_wait_time = r.histogram(
@@ -379,15 +391,36 @@ class TrainerObs:
             self.tracer.record(name, start=t0, dur_s=dt, trace_id=step)
             if name != "step":  # step attribution happens in step()
                 self.ledger.account(name, dt, step=step)
+                if self.flight is not None:
+                    self.flight.record(name, step=step, dur_s=dt)
+
+    def _compile_bucket(self) -> str:
+        """``compile`` vs ``compile_cached`` for the first step (ISSUE 6
+        satellite): the probe's verdict decides; no probe, or an
+        unknown/throwing probe, keeps the plain ``compile`` charge."""
+        if self.compile_probe is None:
+            return "compile"
+        try:
+            hit = self.compile_probe.hit()
+        except Exception:  # noqa: BLE001 — the probe is best-effort
+            hit = None
+        if hit is not None:
+            self.tracer.event("compile_cache", hit=hit)
+        return "compile_cached" if hit else "compile"
 
     def _record_step(self, step: int | None, dur_s: float) -> None:
         """Shared post-step bookkeeping: the first step of a process is
-        compile-dominated and lands in the ``compile`` bucket (the
-        StepTimer warmup-exclusion rule applied to accounting); steady
-        steps are ``step`` and feed the live efficiency gauges."""
+        compile-dominated and lands in the ``compile`` bucket — or
+        ``compile_cached`` when the probe says the persistent cache
+        served it (the StepTimer warmup-exclusion rule applied to
+        accounting); steady steps are ``step`` and feed the live
+        efficiency gauges."""
         self._steps_seen += 1
+        if self.flight is not None:
+            self.flight.record("step", step=step, dur_s=dur_s)
+            self.flight.sample_device()
         if self._steps_seen == 1:
-            self.ledger.account("compile", dur_s, step=step)
+            self.ledger.account(self._compile_bucket(), dur_s, step=step)
             return
         self.ledger.account("step", dur_s, step=step)
         self._productive_s += dur_s
@@ -413,10 +446,21 @@ class TrainerObs:
         self.tracer.record("data_wait", start=start, dur_s=dur_s,
                            trace_id=step)
         self.ledger.account("data_wait", dur_s, step=step)
+        if self.flight is not None:
+            self.flight.record("data_wait", step=step, dur_s=dur_s)
 
     def step(self, step: int | None = None):
         @contextlib.contextmanager
         def _span():
+            if self._steps_seen == 0 and self.compile_probe is not None:
+                # Arm the hit/miss baseline at the first step's ENTRY:
+                # anything the pre-loop path compiled (restore, probes)
+                # has already written its cache entries by now, so only
+                # this step's own compile moves the count.
+                try:
+                    self.compile_probe.rearm()
+                except Exception:  # noqa: BLE001 — probe is best-effort
+                    pass
             t0 = self.clock()
             try:
                 with self._phase("step", self.step_time, step):
@@ -440,3 +484,5 @@ class TrainerObs:
         self.ckpt_time.observe(dur_s)
         self.tracer.record("ckpt", start=start, dur_s=dur_s, trace_id=step)
         self.ledger.account("ckpt", dur_s, step=step)
+        if self.flight is not None:
+            self.flight.record("ckpt", step=step, dur_s=dur_s)
