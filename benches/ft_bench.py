@@ -1,19 +1,20 @@
 #!/usr/bin/env python
-"""Fault-tolerance plane benchmark: one scripted kill-and-recover on the
-local transport, ONE JSON line out in the standard BENCH row schema.
+"""Fault-tolerance plane benchmark: scripted recoveries on the local
+transport, ONE JSON line out in the standard BENCH row schema.
 
-Scenario (deterministic, seeded): a gang of ``--hosts`` stdlib-only
-workers heartbeats into TPUCFN_FT_DIR; a ChaosSpec SIGKILLs host 0 at
-``--kill-after`` seconds; the GangCoordinator detects the crash,
-gang-restarts under a budget of 1, and the relaunched workers finish
-clean.  Reported numbers:
+Two scenarios (both deterministic, seeded), reported as the
+planned-vs-unplanned MTTR split (ISSUE 7):
 
-* **ft_mttr_seconds** (the headline) — detect → relaunch-complete, as
-  observed by the coordinator's own ``ft_mttr_seconds`` metric.
-* **detection_latency_s** — wall time from the chaos kill actually
-  firing to the coordinator's detect event; bounded by the supervision
-  ``--poll-interval``, NOT by the heartbeat interval (process exits are
-  caught by the poll loop; heartbeats exist for hangs).
+* **unplanned** (the headline): a ChaosSpec SIGKILLs host 0 at
+  ``--kill-after`` seconds; the GangCoordinator detects the crash,
+  gang-restarts under a budget of 1, and the relaunched workers finish
+  clean.  Reports ``ft_mttr_seconds`` (detect → relaunch-complete) and
+  ``detection_latency_s`` (kill firing → detect event; bounded by the
+  supervision ``--poll-interval``, not the heartbeat interval).
+* **planned**: a ``preempt_notice`` chaos event at the same instant;
+  the coordinator drains the gang cleanly and relaunches with a budget
+  of ZERO — proving a drained preemption needs no restart budget — and
+  reports ``ft_planned_mttr_seconds`` in ``detail.planned``.
 
 Workers are pure stdlib (no jax import) so the run measures the
 recovery plane, not interpreter+XLA startup.  ``vs_baseline`` is 0.0:
@@ -36,8 +37,10 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 # Stdlib-only worker: beat every BENCH_HB_S; first attempt runs until
-# killed (30s safety cap), post-restart attempts finish clean after a
-# few beats.  Per-host attempt flags — no cross-host races.
+# killed or drained (30s safety cap), post-restart attempts finish clean
+# after a few beats.  Per-host attempt flags — no cross-host races.  The
+# drain check mirrors the trainer protocol: stop clean once the drain
+# file exists and this host reached its target step.
 WORKER = """
 import json, os, pathlib, sys, time
 d = os.environ['TPUCFN_FT_DIR']; h = int(os.environ['TPUCFN_HOST_ID'])
@@ -46,6 +49,7 @@ os.makedirs(d, exist_ok=True)
 flag = pathlib.Path(os.environ['FT_BENCH_FLAG_DIR']) / f'attempt2_{h}'
 second = flag.exists()
 flag.write_text('x')
+drain = pathlib.Path(d) / 'drain.json'
 seq = 0
 t_end = time.time() + (3 * hb_s if second else 30.0)
 while time.time() < t_end:
@@ -53,25 +57,23 @@ while time.time() < t_end:
     with open(f'{d}/hb-host{h:03d}.jsonl', 'a') as f:
         f.write(json.dumps({'host_id': h, 'pid': os.getpid(), 'step': seq,
                             't': time.time(), 'seq': seq}) + '\\n')
+    if drain.exists():
+        try:
+            tgt = json.loads(drain.read_text()).get('step')
+        except Exception:
+            tgt = None
+        if tgt is None or seq >= tgt:
+            sys.exit(0)
     time.sleep(hb_s)
 sys.exit(0 if second else 1)
 """
 
 
-def main() -> int:
-    p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--hosts", type=int, default=2)
-    p.add_argument("--kill-after", type=float, default=1.0,
-                   help="chaos kill of host 0, seconds after launch")
-    p.add_argument("--heartbeat-interval", type=float, default=0.05)
-    p.add_argument("--poll-interval", type=float, default=0.01)
-    p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--out-dir", default=None,
-                   help="scratch dir (default: a fresh temp dir)")
-    args = p.parse_args()
-
-    import tempfile
-
+def _run_scenario(args, work: Path, *, planned: bool):
+    """One coordinator run; returns (rc, wall_s, metrics, events,
+    kill_wall_t).  Unplanned = scripted SIGKILL under budget 1; planned
+    = preemption notice drained under budget ZERO (a drain must not
+    need a restart slot)."""
     from tpucfn.bootstrap import EnvContract
     from tpucfn.ft import (ChaosEvent, ChaosSpec, GangCoordinator,
                            GangRestart, HeartbeatMonitor, MonitorConfig,
@@ -79,7 +81,6 @@ def main() -> int:
     from tpucfn.launch import Launcher, LocalTransport
     from tpucfn.obs import MetricRegistry
 
-    work = Path(args.out_dir or tempfile.mkdtemp(prefix="ft-bench-"))
     work.mkdir(parents=True, exist_ok=True)
     ft_dir = work / "ft"
     flag_dir = work / "flags"
@@ -99,14 +100,16 @@ def main() -> int:
         ft_dir, expected_hosts=args.hosts,
         config=MonitorConfig(interval_s=args.heartbeat_interval,
                              startup_grace_s=30.0))
+    action = "preempt_notice" if planned else "kill"
     chaos = ChaosSpec(events=(
-        ChaosEvent(action="kill", at_s=args.kill_after, host=0),),
+        ChaosEvent(action=action, at_s=args.kill_after, host=0,
+                   duration_s=10.0 if planned else 0.0),),
         seed=args.seed)
     coord = GangCoordinator(
         launcher, [sys.executable, "-c", WORKER],
-        policy=GangRestart(RestartBudget(1)), monitor=monitor,
-        registry=registry, ft_dir=ft_dir, poll_interval=args.poll_interval,
-        term_grace_s=1.0, chaos=chaos)
+        policy=GangRestart(RestartBudget(0 if planned else 1)),
+        monitor=monitor, registry=registry, ft_dir=ft_dir,
+        poll_interval=args.poll_interval, term_grace_s=1.0, chaos=chaos)
 
     # Clock instrumentation: wall time of the kill actually firing vs the
     # coordinator's detect event (events.jsonl stamps wall time).
@@ -122,21 +125,52 @@ def main() -> int:
     t0 = time.perf_counter()
     rc = coord.run()
     wall = time.perf_counter() - t0
-
     events = [json.loads(s) for s in
               (ft_dir / "events.jsonl").read_text().splitlines()]
+    return rc, wall, registry.varz()["metrics"], events, kill_wall.get("t")
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--hosts", type=int, default=2)
+    p.add_argument("--kill-after", type=float, default=1.0,
+                   help="chaos kill (or preempt notice), seconds after "
+                        "launch")
+    p.add_argument("--heartbeat-interval", type=float, default=0.05)
+    p.add_argument("--poll-interval", type=float, default=0.01)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out-dir", default=None,
+                   help="scratch dir (default: a fresh temp dir)")
+    args = p.parse_args()
+
+    import tempfile
+
+    root = Path(args.out_dir or tempfile.mkdtemp(prefix="ft-bench-"))
+
+    rc, wall, m, events, kill_t = _run_scenario(
+        args, root / "unplanned", planned=False)
     detect = next((e for e in events if e["kind"] == "detect"), None)
     recovered = next((e for e in events if e["kind"] == "recovered"), None)
-    m = registry.varz()["metrics"]
     mttr = (m["ft_mttr_seconds"].get("mean") or 0.0) if isinstance(
         m.get("ft_mttr_seconds"), dict) else 0.0
-    detection = (detect["ts"] - kill_wall["t"]
-                 if detect and "t" in kill_wall else None)
+    detection = (detect["ts"] - kill_t
+                 if detect and kill_t is not None else None)
+
+    prc, pwall, pm, pevents, _ = _run_scenario(
+        args, root / "planned", planned=True)
+    pmttr = (pm["ft_planned_mttr_seconds"].get("mean") or 0.0) if isinstance(
+        pm.get("ft_planned_mttr_seconds"), dict) else 0.0
+    planned_ok = (prc == 0
+                  and pm.get("ft_preempt_drains_total") == 1
+                  and pm.get("ft_restarts_total", 0) == 0
+                  and any(e["kind"] == "recovered" and e.get("planned")
+                          for e in pevents))
 
     ok = (rc == 0 and detect is not None and recovered is not None
-          and m.get("ft_restarts_total") == 1)
+          and m.get("ft_restarts_total") == 1 and planned_ok)
     print(f"# ft_bench rc={rc} wall={wall:.2f}s detect={detection} "
-          f"mttr={mttr}", file=sys.stderr)
+          f"mttr={mttr} planned_mttr={pmttr} planned_ok={planned_ok}",
+          file=sys.stderr)
     row = {
         "metric": "ft_mttr_seconds",
         "value": round(mttr, 4),
@@ -162,6 +196,18 @@ def main() -> int:
             "restarts": m.get("ft_restarts_total"),
             "gang_restarts": m.get("ft_gang_restarts_total"),
             "events": [e["kind"] for e in events],
+            # planned-vs-unplanned MTTR split (ISSUE 7): the same
+            # interruption handled via advance notice — drained clean,
+            # zero restart budget consumed.
+            "planned": {
+                "ok": planned_ok,
+                "rc": prc,
+                "wall_s": round(pwall, 3),
+                "mttr_s": round(pmttr, 4),
+                "drains": pm.get("ft_preempt_drains_total"),
+                "restart_budget_used": pm.get("ft_restarts_total", 0),
+                "events": [e["kind"] for e in pevents],
+            },
         },
     }
     print(json.dumps(row))

@@ -287,14 +287,25 @@ def _train_loop_body(trainer, ds, mesh, args, items_per_step, extra_axes,
     from tpucfn.data import prefetch_to_mesh
     from tpucfn.obs import profile_steps
 
+    from tpucfn.ft import RESTORE_FAILED_RC, drain_requested
+    from tpucfn.train.trainer import RestoreFailure
+
+    ft_dir = os.environ.get("TPUCFN_FT_DIR", "").strip()
     with CheckpointManager(run_dir / "ckpt",
                            save_interval_steps=args.ckpt_every) as ckpt:
         # Restart implies resume: a relaunched job (restart supervisor,
         # operator re-run) picks up at its latest checkpoint without the
         # caller remembering --resume; --fresh opts out (SURVEY.md §5
         # failure row — recovery must not silently retrain from step 0).
-        state, resumed = trainer.init_or_resume(
-            jax.random.key(args.seed), ckpt, fresh=args.fresh)
+        try:
+            state, resumed = trainer.init_or_resume(
+                jax.random.key(args.seed), ckpt, fresh=args.fresh)
+        except RestoreFailure as e:
+            # Distinguishable rc (ISSUE 7): the coordinator catches it,
+            # blacklists the bad step, and retries from the previous
+            # finalized one instead of crash-looping into give_up.
+            print(f"checkpoint restore failed: {e}", flush=True)
+            raise SystemExit(RESTORE_FAILED_RC)
         if resumed is not None:
             print(f"resumed from step {int(state.step)}", flush=True)
 
@@ -371,6 +382,13 @@ def _train_loop_body(trainer, ds, mesh, args, items_per_step, extra_axes,
                 if ckpt.save(step, state):
                     obs.record_ckpt(step, t0_ckpt,
                                     time.monotonic() - t0_ckpt)
+                # Preemption drain (ISSUE 7): the coordinator asked the
+                # gang to stop cleanly at a step boundary; the final
+                # force-save below is the drain's zero-lost-work save.
+                if ft_dir and drain_requested(ft_dir, step):
+                    print(f"preemption drain: stopping cleanly at step "
+                          f"{step}", flush=True)
+                    break
         run_eval(state, int(state.step))
         t0_ckpt = time.monotonic()
         if ckpt.save(int(state.step), state, force=True):

@@ -21,7 +21,11 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import numpy as np  # noqa: E402
 
 from tpucfn.ckpt import CheckpointManager  # noqa: E402  (imports jax/orbax)
-from tpucfn.ft import HeartbeatWriter  # noqa: E402
+from tpucfn.ft import (  # noqa: E402
+    RESTORE_FAILED_RC,
+    HeartbeatWriter,
+    drain_requested,
+)
 from tpucfn.obs.goodput import GoodputLedger  # noqa: E402
 
 
@@ -86,7 +90,15 @@ def main() -> int:
                                save_interval_steps=ckpt_every) as ckpt:
             latest = ckpt.latest_step()
             if latest is not None:
-                state = ckpt.restore(template)
+                try:
+                    state = ckpt.restore(template)
+                except Exception as e:  # noqa: BLE001 — corrupt artifact
+                    # Distinguishable rc (ISSUE 7): the coordinator
+                    # blacklists the bad step and retries from the
+                    # previous finalized one.
+                    print(f"restore of step {latest} failed: {e}",
+                          flush=True)
+                    sys.exit(RESTORE_FAILED_RC)
                 print(f"resumed from step {int(state['step'])}", flush=True)
             else:
                 state = {k: v.copy() for k, v in template.items()}
@@ -135,6 +147,13 @@ def main() -> int:
                             ledger.account(
                                 "ckpt", time.monotonic() - t0_ckpt,
                                 step=step)
+                    # Preemption drain (ISSUE 7): every host runs UP TO
+                    # the drain file's target step and stops; the
+                    # force-save below lands exactly there, so the
+                    # relaunch re-executes nothing (lost_work == 0).
+                    if ft_dir and drain_requested(ft_dir, step):
+                        print(f"drained at step {step}", flush=True)
+                        break
             if host == 0:
                 t0_ckpt = time.monotonic()
                 if ckpt.save(step, {"step": np.asarray(step, np.int64),

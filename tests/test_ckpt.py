@@ -200,6 +200,46 @@ def test_latest_step_and_missing(tmp_path, mesh_dp8):
         assert mgr.latest_step() == 2
 
 
+def test_blacklist_steers_latest_and_restore(tmp_path, mesh_dp8):
+    """Step blacklist (ISSUE 7): the manager treats blacklisted steps as
+    nonexistent for latest-step selection, so the coordinator's
+    corruption retry resumes from the PREVIOUS finalized step — and a
+    relaunched rank picks the set up from TPUCFN_CKPT_BLACKLIST."""
+    import os
+
+    trainer = _trainer(mesh_dp8)
+    state = trainer.init(jax.random.key(0))
+    states = {}
+    with CheckpointManager(tmp_path / "c") as mgr:
+        for s in [1, 2, 3]:
+            mgr.save(s, state)
+            states[s] = state
+            state, _ = trainer.step(state, _batch(mesh_dp8))
+        mgr.wait()
+    with CheckpointManager(tmp_path / "c", blacklist_steps=[3]) as mgr:
+        assert mgr.latest_step() == 2
+        restored = mgr.restore(trainer.abstract_state())
+        assert int(restored.step) == int(states[2].step)
+        # naming a blacklisted step explicitly is still honored — the
+        # blacklist steers selection, it does not hide data
+        assert int(mgr.restore(trainer.abstract_state(), step=3).step) \
+            == int(states[3].step)
+    # env fan-out form (what the coordinator's relaunch uses)
+    os.environ["TPUCFN_CKPT_BLACKLIST"] = "3, 2,junk"
+    try:
+        with CheckpointManager(tmp_path / "c") as mgr:
+            assert mgr.blacklist_steps == frozenset({2, 3})
+            assert mgr.latest_step() == 1
+    finally:
+        del os.environ["TPUCFN_CKPT_BLACKLIST"]
+    # everything blacklisted -> no restore target left
+    with CheckpointManager(tmp_path / "c",
+                           blacklist_steps=[1, 2, 3]) as mgr:
+        assert mgr.latest_step() is None
+        with pytest.raises(FileNotFoundError):
+            mgr.restore(trainer.abstract_state())
+
+
 def test_max_to_keep_gc(tmp_path, mesh_dp8):
     trainer = _trainer(mesh_dp8)
     state = trainer.init(jax.random.key(0))

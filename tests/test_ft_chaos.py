@@ -39,8 +39,14 @@ class Recorder(ChaosTarget):
     def delay_heartbeats(self, host_id, duration_s):
         self.calls.append(("delay", host_id, duration_s))
 
-    def corrupt_latest_checkpoint(self, rng):
-        self.calls.append(("corrupt",))
+    def preempt_notice(self, host_id, lead_s):
+        self.calls.append(("preempt", host_id, lead_s))
+
+    def lose_host(self, host_id):
+        self.calls.append(("lose", host_id))
+
+    def corrupt_latest_checkpoint(self, rng, step=None):
+        self.calls.append(("corrupt", step))
 
 
 def test_spec_json_roundtrip_and_validation():
@@ -137,3 +143,54 @@ def test_corrupt_latest_checkpoint_empty_dirs(tmp_path):
     assert corrupt_latest_checkpoint(tmp_path / "nope", random.Random(0)) is None
     (tmp_path / "ckpt").mkdir()
     assert corrupt_latest_checkpoint(tmp_path / "ckpt", random.Random(0)) is None
+
+
+# -- graceful-degradation ops (ISSUE 7) ------------------------------------
+
+
+def test_engine_fires_preempt_notice_and_lose_host():
+    """The two new ops: preempt_notice carries its lead seconds via
+    duration_s; lose_host fires like a kill but through the dedicated
+    target hook (kill AND refuse re-acquire).  Both replay seeded."""
+    spec = ChaosSpec(events=(
+        ChaosEvent(action="preempt_notice", at_s=1.0, host=2,
+                   duration_s=30.0),
+        ChaosEvent(action="lose_host", at_step=50, host=1),
+    ), seed=3)
+    again = ChaosSpec.from_json(json.dumps(spec.to_json()))
+    assert again == spec  # roundtrip incl. the new actions
+    t = Recorder()
+    eng = ChaosEngine(spec, t)
+    eng.tick(1.5, fleet_step=10)
+    assert t.calls == [("preempt", 2, 30.0)]
+    eng.tick(1.6, fleet_step=50)
+    assert t.calls[-1] == ("lose", 1)
+    assert eng.done()
+    # unpinned victims draw from the seeded rng, same as kill
+    t1, t2 = Recorder(4), Recorder(4)
+    unpinned = ChaosSpec(events=(
+        ChaosEvent(action="lose_host", at_s=0.5),), seed=11)
+    ChaosEngine(unpinned, t1).tick(1.0)
+    ChaosEngine(ChaosSpec.from_json(unpinned.to_json()), t2).tick(1.0)
+    assert t1.calls == t2.calls
+
+
+def test_corrupt_ckpt_targets_a_specific_step(tmp_path):
+    """``corrupt_ckpt`` with a step field hits exactly that finalized
+    step (the deterministic drill needs to corrupt the checkpoint the
+    retry path will blacklist), and a missing target is a no-op."""
+    d = tmp_path / "ckpt"
+    for step in (5, 10):
+        sub = d / str(step) / "default"
+        sub.mkdir(parents=True)
+        (sub / "data.bin").write_bytes(b"A" * 4096)
+    victim = corrupt_latest_checkpoint(d, random.Random(0), step=5)
+    assert victim is not None and victim.parts[-3] == "5"
+    assert (d / "10" / "default" / "data.bin").read_bytes() == b"A" * 4096
+    assert corrupt_latest_checkpoint(d, random.Random(0), step=99) is None
+    # engine path: the event's step reaches the target
+    t = Recorder()
+    eng = ChaosEngine(ChaosSpec(events=(
+        ChaosEvent(action="corrupt_ckpt", at_s=1.0, step=20),)), t)
+    eng.tick(1.0)
+    assert t.calls == [("corrupt", 20)]

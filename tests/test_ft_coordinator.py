@@ -319,6 +319,388 @@ def test_observe_only_hang_is_one_incident(tmp_path):
     assert v["ft_restarts_total"] == 0
 
 
+# -- graceful degradation (ISSUE 7): fast subprocess pins ------------------
+
+# Stdlib drain-aware worker: first attempt beats and waits for the
+# drain file (mirroring the trainer protocol: stop once `step` reaches
+# the drain target, or immediately when the target is null); the
+# relaunched attempt exits clean at once.
+DRAIN_WORKER = (
+    "import json, os, pathlib, sys, time\n"
+    "d = os.environ['TPUCFN_FT_DIR']; h = int(os.environ['TPUCFN_HOST_ID'])\n"
+    "os.makedirs(d, exist_ok=True)\n"
+    "flag = pathlib.Path(os.environ['FLAG_DIR']) / f'second_{h}'\n"
+    "if flag.exists(): sys.exit(0)\n"
+    "flag.write_text('x')\n"
+    "drain = pathlib.Path(d) / 'drain.json'\n"
+    "seq = 0\n"
+    "t_end = time.time() + 30\n"
+    "while time.time() < t_end:\n"
+    "    seq += 1\n"
+    "    with open(f'{d}/hb-host{h:03d}.jsonl', 'a') as f:\n"
+    "        f.write(json.dumps({'host_id': h, 'pid': os.getpid(),"
+    " 'step': seq, 't': time.time(), 'seq': seq}) + '\\n')\n"
+    "    if drain.exists():\n"
+    "        try: tgt = json.loads(drain.read_text()).get('step')\n"
+    "        except Exception: tgt = None\n"
+    "        if tgt is None or seq >= tgt: sys.exit(0)\n"
+    "    time.sleep(0.02)\n"
+    "sys.exit(1)\n")
+
+
+def test_preempt_notice_drains_into_planned_restart(tmp_path):
+    """An external preemption notice (the preempt.json sentinel — the
+    cloud-daemon hook) becomes a drain: clean exits, a relaunch, rc 0 —
+    all with a budget of ZERO, because a planned restart must not need
+    a restart slot."""
+    import os
+
+    from tpucfn.ft import write_notice
+    from tpucfn.ft.preempt import drain_path
+
+    ft_dir = tmp_path / "ft"
+    os.environ["FLAG_DIR"] = str(tmp_path)
+    try:
+        import threading
+
+        registry = MetricRegistry()
+        launcher = _launcher(tmp_path, n=2, ft_dir=str(ft_dir),
+                             ft_heartbeat_s=0.05)
+        coord = GangCoordinator(
+            launcher, [sys.executable, "-c", DRAIN_WORKER],
+            policy=GangRestart(RestartBudget(0)), registry=registry,
+            ft_dir=ft_dir, poll_interval=0.01, term_grace_s=0.5)
+        # delivered mid-run, as a real notice daemon would: a notice
+        # already on disk at startup is purged as stale (see below)
+        t = threading.Timer(0.3, write_notice, args=(ft_dir,),
+                            kwargs={"host": 1, "lead_s": 20.0})
+        t.start()
+        try:
+            assert coord.run() == 0
+        finally:
+            t.cancel()
+    finally:
+        del os.environ["FLAG_DIR"]
+    v = registry.varz()["metrics"]
+    assert v["ft_preempt_drains_total"] == 1
+    assert v["ft_planned_restarts_total"] == 1
+    assert v["ft_restarts_total"] == 0  # budget untouched
+    assert v["ft_planned_mttr_seconds"]["count"] == 1
+    events = _events(ft_dir)
+    detect = next(e for e in events if e["kind"] == "detect")
+    assert detect["failures"][0]["kind"] == "preempt"
+    assert detect["failures"][0]["lead_s"] == 20.0
+    decide = next(e for e in events if e["kind"] == "decide")
+    assert decide["action"] == "drain_restart" and decide["planned"]
+    drain = next(e for e in events if e["kind"] == "drain")
+    assert drain["hosts"] == [1]
+    recovered = next(e for e in events if e["kind"] == "recovered")
+    assert recovered["planned"] and recovered["escalated"] == 0
+    gp = next(e for e in events if e["kind"] == "goodput_incident")
+    assert gp["planned"] is True
+    # the drain file must not survive into the relaunched gang
+    assert not drain_path(ft_dir).exists()
+    # the notice fired exactly once
+    assert sum(1 for e in events if e["kind"] == "detect") == 1
+
+
+def test_stale_drain_and_notice_purged_at_startup(tmp_path):
+    """A supervisor killed mid-drain leaves drain.json/preempt.json in
+    the persistent ft dir; a fresh launch must purge them — or every
+    rank self-drains at its first boundary and a multi-hour job
+    'finishes' rc 0 having trained nothing."""
+    import os
+
+    from tpucfn.ft import write_notice
+    from tpucfn.ft.preempt import drain_path, request_drain
+
+    ft_dir = tmp_path / "ft"
+    request_drain(ft_dir, step=None)   # stale: would drain instantly
+    write_notice(ft_dir, host=0, lead_s=1.0)
+    os.environ["FLAG_DIR"] = str(tmp_path)
+    try:
+        registry = MetricRegistry()
+        launcher = _launcher(tmp_path, n=1, ft_dir=str(ft_dir),
+                             ft_heartbeat_s=0.05)
+        # the worker EXITS 1 on drain-without-target unless it ran at
+        # least 5 steps first — so a surviving stale file fails the run
+        worker = (
+            "import json, os, pathlib, sys, time\n"
+            "d = os.environ['TPUCFN_FT_DIR']\n"
+            "drain = pathlib.Path(d) / 'drain.json'\n"
+            "for i in range(5):\n"
+            "    if drain.exists(): sys.exit(1)\n"
+            "    time.sleep(0.02)\n"
+            "sys.exit(0)\n")
+        coord = GangCoordinator(
+            launcher, [sys.executable, "-c", worker],
+            policy=GangRestart(RestartBudget(0)), registry=registry,
+            ft_dir=ft_dir, poll_interval=0.01, term_grace_s=0.3)
+        assert coord.run() == 0
+    finally:
+        del os.environ["FLAG_DIR"]
+    assert not drain_path(ft_dir).exists()
+    # the stale notice never became an incident
+    assert registry.varz()["metrics"]["ft_incidents_total"] == 0
+    assert not any(e["kind"] == "detect" for e in _events(ft_dir))
+
+
+def test_ckpt_blacklist_expires_once_a_newer_step_lands(tmp_path):
+    """The corruption blacklist must die once the run finalizes a step
+    NEWER than everything on it — a stale blacklist would make every
+    later ordinary restart skip the good re-saved checkpoint and
+    silently rewind real work."""
+    from tpucfn.ft import CKPT_BLACKLIST_ENV
+
+    ckpt_dir = tmp_path / "ckpt"
+    (ckpt_dir / "10").mkdir(parents=True)
+    launcher = _launcher(tmp_path, n=1)
+    coord = GangCoordinator(
+        launcher, [sys.executable, "-c", "pass"],
+        policy=GangRestart(RestartBudget(0)),
+        ft_dir=tmp_path / "ft", ckpt_dir=ckpt_dir, poll_interval=0.01)
+    coord._ckpt_blacklist = {20}
+    coord._ckpt_retries = 1
+    launcher.extra_env[CKPT_BLACKLIST_ENV] = "20"
+    # nothing newer than 20 finalized yet: the blacklist stands
+    coord._refresh_ckpt_blacklist()
+    assert coord._ckpt_blacklist == {20}
+    assert launcher.extra_env[CKPT_BLACKLIST_ENV] == "20"
+    # the re-run finalized step 30: the bad artifact is history
+    (ckpt_dir / "30").mkdir()
+    coord._refresh_ckpt_blacklist()
+    assert coord._ckpt_blacklist == set()
+    assert coord._ckpt_retries == 0
+    assert CKPT_BLACKLIST_ENV not in launcher.extra_env
+    assert any(e["kind"] == "ckpt_blacklist_expired"
+               for e in _events(tmp_path / "ft"))
+
+
+def test_lose_host_shrinks_gang_to_n_minus_one(tmp_path):
+    """Chaos lose_host: the killed host cannot be re-acquired, so the
+    recovery re-converges the contract at N-1 (new generation) and
+    relaunches the smaller gang instead of crash-looping a ghost."""
+    import os
+
+    from tpucfn.ft import ChaosEvent, ChaosSpec
+
+    ft_dir = tmp_path / "ft"
+    worker = (
+        "import os, pathlib, sys, time\n"
+        "flag = pathlib.Path(os.environ['FLAG_DIR']) / ("
+        "'second_' + os.environ['TPUCFN_HOST_ID'])\n"
+        "if flag.exists(): sys.exit(0)\n"
+        "flag.write_text('x')\n"
+        "time.sleep(30)\n")
+    os.environ["FLAG_DIR"] = str(tmp_path)
+    try:
+        registry = MetricRegistry()
+        launcher = _launcher(tmp_path, n=2)
+        coord = GangCoordinator(
+            launcher, [sys.executable, "-c", worker],
+            policy=GangRestart(RestartBudget(1)), registry=registry,
+            ft_dir=ft_dir, poll_interval=0.01, term_grace_s=0.3,
+            chaos=ChaosSpec(events=(
+                ChaosEvent(action="lose_host", at_s=0.3, host=1),)))
+        assert coord.run() == 0
+    finally:
+        del os.environ["FLAG_DIR"]
+    v = registry.varz()["metrics"]
+    assert v["ft_shrinks_total"] == 1
+    assert v["ft_gang_restarts_total"] == 1
+    assert v["supervisor_gang_hosts"] == 1  # relaunched at N-1
+    events = _events(ft_dir)
+    assert any(e["kind"] == "host_lost" and e["host"] == 1 for e in events)
+    shrink = next(e for e in events if e["kind"] == "shrink")
+    assert shrink["from_hosts"] == 2 and shrink["to_hosts"] == 1
+    assert shrink["lost"] == [1]
+    assert shrink["generation"] == 2  # contract generation bumped (was 1)
+    recovered = next(e for e in events if e["kind"] == "recovered")
+    assert recovered["shrink"]["to_hosts"] == 1
+    gp = next(e for e in events if e["kind"] == "goodput_incident")
+    assert gp["shrink"]["generation"] == 2 and gp["planned"] is False
+    # the launcher now holds the shrunk contract
+    assert coord.launcher.contract.workers_count == 1
+    assert coord.launcher.contract.generation == 2
+
+
+def test_restore_failure_rc_retries_from_previous_step(tmp_path):
+    """A gang exiting with RESTORE_FAILED_RC is a bad artifact, not a
+    fleet failure: the coordinator blacklists + quarantines the latest
+    finalized step, fans the blacklist out, and relaunches — without
+    burning the restart budget."""
+    from tpucfn.ft import CKPT_BLACKLIST_ENV, RESTORE_FAILED_RC
+
+    ft_dir = tmp_path / "ft"
+    ckpt_dir = tmp_path / "ckpt"
+    for step in (10, 20):
+        (ckpt_dir / str(step)).mkdir(parents=True)
+        (ckpt_dir / str(step) / "data.bin").write_bytes(b"x" * 64)
+    worker = (
+        "import os, sys\n"
+        "bl = os.environ.get('TPUCFN_CKPT_BLACKLIST', '')\n"
+        f"sys.exit(0 if '20' in bl.split(',') else {RESTORE_FAILED_RC})\n")
+    registry = MetricRegistry()
+    coord = GangCoordinator(
+        _launcher(tmp_path, n=2), [sys.executable, "-c", worker],
+        policy=GangRestart(RestartBudget(0)),  # zero budget: none needed
+        registry=registry, ft_dir=ft_dir, ckpt_dir=ckpt_dir,
+        poll_interval=0.01, term_grace_s=0.3)
+    assert coord.run() == 0
+    v = registry.varz()["metrics"]
+    assert v["ft_ckpt_retries_total"] == 1
+    assert v["ft_give_ups_total"] == 0
+    events = _events(ft_dir)
+    retry = next(e for e in events if e["kind"] == "ckpt_retry")
+    assert retry["bad_step"] == 20 and retry["retry_from"] == 10
+    assert retry["blacklist"] == [20]
+    recovered = next(e for e in events if e["kind"] == "recovered")
+    assert recovered["action"] == "ckpt_retry"
+    gp = next(e for e in events if e["kind"] == "goodput_incident")
+    assert gp["ckpt"] == {"bad_step": 20, "retry_from": 10}
+    # quarantined, not deleted: the bad artifact is kept for forensics
+    assert not (ckpt_dir / "20").exists()
+    assert (ckpt_dir / "corrupt" / "20" / "data.bin").is_file()
+    assert coord.launcher.extra_env[CKPT_BLACKLIST_ENV] == "20"
+
+
+def test_ckpt_retry_refused_without_a_previous_step(tmp_path):
+    """Only ONE finalized checkpoint exists: quarantining it would make
+    the relaunch init fresh and 'succeed' from step 0.  The coordinator
+    must decline the retry and fail loudly through the normal table
+    instead of silently retraining."""
+    from tpucfn.ft import RESTORE_FAILED_RC
+
+    ckpt_dir = tmp_path / "ckpt"
+    (ckpt_dir / "20").mkdir(parents=True)
+    (ckpt_dir / "20" / "data.bin").write_bytes(b"x")
+    worker = f"import sys; sys.exit({RESTORE_FAILED_RC})\n"
+    registry = MetricRegistry()
+    coord = GangCoordinator(
+        _launcher(tmp_path, n=1), [sys.executable, "-c", worker],
+        policy=GangRestart(RestartBudget(0)), registry=registry,
+        ft_dir=tmp_path / "ft", ckpt_dir=ckpt_dir, poll_interval=0.01,
+        term_grace_s=0.3)
+    assert coord.run() == RESTORE_FAILED_RC  # loud, not a phantom rc 0
+    v = registry.varz()["metrics"]
+    assert v["ft_ckpt_retries_total"] == 0
+    assert v["ft_give_ups_total"] == 1
+    assert (ckpt_dir / "20").is_dir()  # nothing quarantined
+
+
+def test_concurrent_notice_and_crash_requeues_the_notice(tmp_path):
+    """A preemption notice landing in the same detect tick as a real
+    failure loses the decision to the restart — but the machine is
+    still going away: the consumed notice must be re-queued so the
+    relaunched gang still gets its drain."""
+    from tpucfn.ft import Failure, FailureKind
+
+    worker = "import time; time.sleep(30)\n"
+    registry = MetricRegistry()
+    coord = GangCoordinator(
+        _launcher(tmp_path, n=2), [sys.executable, "-c", worker],
+        policy=GangRestart(RestartBudget(1)), registry=registry,
+        ft_dir=tmp_path / "ft", poll_interval=0.01, term_grace_s=0.3)
+    try:
+        coord._launch_gang(first=True)
+        rc = coord._handle_incident([
+            Failure(0, FailureKind.CRASH, rc=1),
+            Failure(1, FailureKind.PREEMPT, lead_s=9.0)])
+        assert rc is None  # gang restarted under budget
+        assert [(n.host, n.lead_s) for n in coord._pending_notices] \
+            == [(1, 9.0)]
+        assert registry.varz()["metrics"]["ft_gang_restarts_total"] == 1
+    finally:
+        coord.launcher.stop_all(list(coord._procs.values()),
+                                grace_s=0.3, poll_interval=0.01)
+
+
+def test_ckpt_retries_exhaust_to_normal_policy(tmp_path):
+    """Past max_ckpt_retries the normal table decides — a run whose
+    every checkpoint is rotten must still end, with the real rc."""
+    from tpucfn.ft import RESTORE_FAILED_RC
+
+    ckpt_dir = tmp_path / "ckpt"
+    for step in (10, 20):
+        (ckpt_dir / str(step)).mkdir(parents=True)
+        (ckpt_dir / str(step) / "data.bin").write_bytes(b"x")
+    worker = f"import sys; sys.exit({RESTORE_FAILED_RC})\n"
+    registry = MetricRegistry()
+    coord = GangCoordinator(
+        _launcher(tmp_path, n=1), [sys.executable, "-c", worker],
+        policy=GangRestart(RestartBudget(0)), registry=registry,
+        ft_dir=tmp_path / "ft", ckpt_dir=ckpt_dir, poll_interval=0.01,
+        term_grace_s=0.3, max_ckpt_retries=1)
+    assert coord.run() == RESTORE_FAILED_RC
+    v = registry.varz()["metrics"]
+    assert v["ft_ckpt_retries_total"] == 1  # capped
+    assert v["ft_give_ups_total"] == 1      # then the budget-0 table
+
+
+def test_straggler_evicted_after_hysteresis(tmp_path):
+    """Sustained step lag past the hysteresis window earns a targeted
+    solo restart of the straggler (the safe-by-default eviction row);
+    the relaunched host catches up and the run finishes clean."""
+    import os
+
+    from tpucfn.ft import StragglerGuard
+
+    ft_dir = tmp_path / "ft"
+    worker = (
+        "import json, os, pathlib, sys, time\n"
+        "d = os.environ['TPUCFN_FT_DIR']; h = int(os.environ['TPUCFN_HOST_ID'])\n"
+        "os.makedirs(d, exist_ok=True)\n"
+        "fd = pathlib.Path(os.environ['FLAG_DIR'])\n"
+        "def beat(step, seq):\n"
+        "    with open(f'{d}/hb-host{h:03d}.jsonl', 'a') as f:\n"
+        "        f.write(json.dumps({'host_id': h, 'pid': os.getpid(),"
+        " 'step': step, 't': time.time(), 'seq': seq}) + '\\n')\n"
+        "if h == 1 and (fd / 'second_1').exists():\n"
+        "    beat(10**6, 1)\n"  # relaunched straggler: caught up
+        "    (fd / 'done').write_text('x')\n"
+        "    sys.exit(0)\n"
+        "if h == 1: (fd / 'second_1').write_text('x')\n"
+        "t_end = time.time() + 20\n"
+        "i = 0\n"
+        "while time.time() < t_end:\n"
+        "    i += 1\n"
+        "    beat(1 if h == 1 else 100 + i, i)\n"
+        "    if h == 0 and (fd / 'done').exists(): sys.exit(0)\n"
+        "    time.sleep(0.05)\n"
+        "sys.exit(1)\n")
+    os.environ["FLAG_DIR"] = str(tmp_path)
+    try:
+        registry = MetricRegistry()
+        launcher = _launcher(tmp_path, n=2, ft_dir=str(ft_dir),
+                             ft_heartbeat_s=0.05)
+        coord = GangCoordinator(
+            launcher, [sys.executable, "-c", worker],
+            policy=GangRestart(RestartBudget(2)),
+            monitor=HeartbeatMonitor(
+                ft_dir, expected_hosts=2,
+                config=MonitorConfig(interval_s=0.05, startup_grace_s=5.0,
+                                     straggler_step_lag=20)),
+            registry=registry, ft_dir=ft_dir, poll_interval=0.01,
+            term_grace_s=0.3,
+            straggler_guard=StragglerGuard(hysteresis_s=0.4,
+                                           flap_budget=3))
+        t0 = time.monotonic()
+        assert coord.run() == 0
+        assert time.monotonic() - t0 < 15
+    finally:
+        del os.environ["FLAG_DIR"]
+    v = registry.varz()["metrics"]
+    assert v["ft_straggler_evictions_total"] == 1
+    assert v["ft_solo_restarts_total"] == 1
+    assert v["ft_gang_restarts_total"] == 0
+    events = _events(ft_dir)
+    detect = next(e for e in events if e["kind"] == "detect")
+    assert detect["failures"][0]["kind"] == "straggler"
+    assert detect["failures"][0]["host"] == 1
+    decide = next(e for e in events if e["kind"] == "decide")
+    assert decide["action"] == "solo_restart" and decide["hosts"] == [1]
+
+
 def test_dead_process_detection_latency(tmp_path):
     """Kill-victim path under the coordinator: the built-in fault
     injection SIGKILLs host 0 at t=0.2s and the supervision loop must

@@ -176,6 +176,35 @@ def test_retired_host_not_judged_and_healthz_stays_green(tmp_path):
     assert mon.observe().by_host()[0].state is HostState.DEAD
 
 
+def test_set_expected_hosts_rescopes_after_shrink(tmp_path):
+    """Elastic shrink (ISSUE 7): after the gang re-converges at N-1 the
+    old highest id's heartbeat file is still on disk — re-scoping the
+    monitor (plus retiring the dropped id) must stop it being judged,
+    or its aging beat reads as a phantom hang of a host the contract no
+    longer has."""
+    clock = Clock()
+    for h in (0, 1, 2):
+        w = _writer(tmp_path, h, clock)
+        w.beat(step=10)
+        w.stop()
+    mon = HeartbeatMonitor(tmp_path / "ft", expected_hosts=3,
+                           config=MonitorConfig(interval_s=1.0), clock=clock)
+    assert set(mon.observe().by_host()) == {0, 1, 2}
+    # shrink 3 -> 2: host 2's slot is gone from the contract
+    mon.set_expected_hosts(2)
+    mon.retire_host(2)
+    clock.advance(7.0)  # all original beats now past dead_s
+    for h in (0, 1):  # survivors keep beating
+        w = _writer(tmp_path, h, clock)
+        w.beat(step=11)
+        w.stop()
+    view = mon.observe()
+    assert set(view.by_host()) == {0, 1}
+    healthy, detail = view.healthy()
+    assert healthy, "a dropped host's stale file must not 503 the fleet"
+    assert detail["fleet"]["DEAD"] == 0
+
+
 def test_monitor_feeds_obs_healthz(tmp_path):
     """The monitor's health() IS an obs-server health_fn: /healthz flips
     200 → 503 when a host goes DEAD (ISSUE 4 tentpole wiring)."""

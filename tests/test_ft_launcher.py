@@ -105,3 +105,63 @@ def test_host_env_without_ft_has_no_ft_vars(tmp_path):
     env = launcher.host_env(0)
     assert "TPUCFN_FT_DIR" not in env
     assert "TPUCFN_FT_HEARTBEAT_S" not in env
+
+
+def test_extra_env_reaches_every_launch_shape(tmp_path):
+    """The coordinator's degradation state (ckpt blacklist) rides
+    extra_env into both gang launches and solo relaunches, and wins
+    over contract-derived vars."""
+    launcher = Launcher(_contract(tmp_path, n=2), LocalTransport())
+    launcher.extra_env["TPUCFN_CKPT_BLACKLIST"] = "20,30"
+    env = launcher.host_env(1)
+    assert env["TPUCFN_CKPT_BLACKLIST"] == "20,30"
+    out = tmp_path / "out"
+    out.mkdir()
+    code = ("import os, pathlib\n"
+            "h = os.environ['TPUCFN_HOST_ID']\n"
+            f"pathlib.Path(r'{out}', f'bl-{{h}}').write_text("
+            "os.environ.get('TPUCFN_CKPT_BLACKLIST', 'MISSING'))\n")
+    procs = launcher.launch([sys.executable, "-c", code])
+    assert launcher.wait(procs) == 0
+    solo = launcher.launch_host([sys.executable, "-c", code], 0)
+    assert solo.wait(timeout=30) == 0
+    assert (out / "bl-0").read_text() == "20,30"
+    assert (out / "bl-1").read_text() == "20,30"
+
+
+def test_shrink_contract_bumps_generation_and_renumbers(tmp_path):
+    """Elastic shrink (ISSUE 7): dropping a lost host re-converges at
+    N-1 with a NEW contract generation, a new hostfile next to the old
+    one, and the coordinator address following the new host 0."""
+    from tpucfn.bootstrap import shrink_contract
+
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("10.0.0.1:8471\n10.0.0.2:8471\n10.0.0.3:8471\n")
+    c = EnvContract(
+        workers_path=str(hostfile), workers_count=3, worker_chip_count=4,
+        coordinator="10.0.0.1:8476", host_id=0, storage="/shared",
+        generation=5)
+    s = shrink_contract(c, [0])  # host 0 (the coordinator host!) lost
+    assert s.generation == 6
+    assert s.workers_count == 2
+    assert s.hosts() == ["10.0.0.2:8471", "10.0.0.3:8471"]
+    assert s.coordinator == "10.0.0.2:8476"  # follows new host 0
+    assert s.worker_chip_count == 4 and s.storage == "/shared"
+    # the new hostfile is a sibling; the old generation's is untouched
+    assert s.workers_path != c.workers_path
+    assert hostfile.read_text().count("\n") == 3
+    # env fan-out carries the new generation
+    assert s.to_env()["TPUCFN_GENERATION"] == "6"
+    # per-host re-converge: each survivor's own id shifts down by the
+    # lost ids below it — distinct slots, no collisions
+    c1 = EnvContract(**{**c.__dict__, "host_id": 1})
+    assert shrink_contract(c1, [0]).host_id == 0
+    c2 = EnvContract(**{**c.__dict__, "host_id": 2})
+    assert shrink_contract(c2, [0]).host_id == 1
+    assert shrink_contract(c2, [1]).host_id == 1
+    assert shrink_contract(c2, [0, 1]).host_id == 0
+    # shrinking away everything is a give-up, not a shrink
+    with pytest.raises(ValueError):
+        shrink_contract(s, [0, 1])
+    with pytest.raises(ValueError):
+        shrink_contract(s, [7])  # out of range

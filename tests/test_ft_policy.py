@@ -1,6 +1,9 @@
 """Recovery policy semantics (tpucfn.ft.policy): budget accounting,
-deterministic backoff+jitter, the failure-class decision table, and the
-gang-vs-solo restart shapes."""
+deterministic backoff+jitter, the failure-class decision table, the
+gang-vs-solo restart shapes, and the graceful-degradation rows
+(ISSUE 7): planned preemption drains that never burn budget, the
+default straggler-eviction row, and the StragglerGuard
+hysteresis/flap-budget state machine on a fake clock."""
 
 import random
 
@@ -13,6 +16,7 @@ from tpucfn.ft import (
     GangRestart,
     RestartBudget,
     SoloRestart,
+    StragglerGuard,
     policy_from_name,
 )
 
@@ -67,14 +71,53 @@ def test_gang_policy_restarts_whole_gang_for_crash():
     assert p.budget.used == 1
 
 
-def test_clean_exit_and_straggler_burn_no_budget():
+def test_clean_exit_burns_no_budget():
     p = GangRestart(RestartBudget(1))
-    d = p.decide([Failure(0, FailureKind.CLEAN_EXIT, rc=0),
-                  Failure(1, FailureKind.STRAGGLER, step=5)])
+    d = p.decide([Failure(0, FailureKind.CLEAN_EXIT, rc=0)])
     assert d.action is Action.NONE
     assert p.budget.used == 0  # the exit-cause-accounting satellite
     # the budget slot is still there for a real failure
     assert p.decide([_crash(1)]).action is Action.GANG_RESTART
+
+
+def test_preempt_drain_is_planned_and_burns_no_budget():
+    """The PREEMPT row (ISSUE 7): an advance notice becomes a PLANNED
+    drain-restart that never consumes a budget slot — even with the
+    budget already exhausted, an orderly drain must not become a
+    give_up."""
+    p = GangRestart(RestartBudget(0))  # zero budget: nothing to burn
+    d = p.decide([Failure(1, FailureKind.PREEMPT, lead_s=30.0)])
+    assert d.action is Action.DRAIN_RESTART
+    assert d.planned and d.hosts == (1,)
+    assert p.budget.used == 0
+    # a clean exit alongside the notice changes nothing
+    d = p.decide([Failure(0, FailureKind.CLEAN_EXIT, rc=0),
+                  Failure(1, FailureKind.PREEMPT)])
+    assert d.action is Action.DRAIN_RESTART and d.planned
+    assert p.budget.used == 0
+
+
+def test_preempt_with_real_failure_escalates_to_restart():
+    """A crash arriving with a notice wins: the restart it earns
+    relaunches the preempted host anyway — and THAT consumes budget."""
+    p = GangRestart(RestartBudget(1))
+    d = p.decide([Failure(1, FailureKind.PREEMPT, lead_s=5.0),
+                  _crash(0, rc=137)])
+    assert d.action is Action.GANG_RESTART and not d.planned
+    assert p.budget.used == 1
+
+
+def test_straggler_eviction_is_default_and_targeted():
+    """The STRAGGLER→SOLO_RESTART row is on by default (ISSUE 7) and
+    pins the shape: even a GangRestart fleet evicts one straggler solo
+    instead of bouncing the whole gang."""
+    p = GangRestart(RestartBudget(2))
+    d = p.decide([Failure(2, FailureKind.STRAGGLER, step=5)])
+    assert d.action is Action.SOLO_RESTART and d.hosts == (2,)
+    assert p.budget.used == 1  # eviction is a real restart
+    # straggler + crash together: the policy's own shape arbitrates
+    d = p.decide([Failure(2, FailureKind.STRAGGLER, step=5), _crash(0)])
+    assert d.action is Action.GANG_RESTART
 
 
 def test_budget_exhaustion_gives_up_with_reason():
@@ -83,6 +126,20 @@ def test_budget_exhaustion_gives_up_with_reason():
     d = p.decide([_crash(0)])
     assert d.action is Action.GIVE_UP
     assert "budget exhausted" in d.reason
+
+
+def test_exhausted_budget_degrades_stragglers_to_observe_only():
+    """An eviction is an optimization, not a rescue: out of budget, a
+    straggler-only incident must become observe-only — killing a gang
+    that is still making progress over a slow host would be strictly
+    worse than the pre-eviction behavior."""
+    p = GangRestart(RestartBudget(0))
+    d = p.decide([Failure(2, FailureKind.STRAGGLER, step=5)])
+    assert d.action is Action.NONE
+    assert "observe-only" in d.reason
+    # a real failure out of budget still gives up
+    d = p.decide([Failure(2, FailureKind.STRAGGLER, step=5), _crash(0)])
+    assert d.action is Action.GIVE_UP
 
 
 def test_solo_policy_singles_vs_correlated_failures():
@@ -107,3 +164,63 @@ def test_policy_from_name():
     assert isinstance(policy_from_name("solo", RestartBudget(0)), SoloRestart)
     with pytest.raises(ValueError):
         policy_from_name("yolo", RestartBudget(0))
+
+
+# -- StragglerGuard: hysteresis + flap budget on a fake clock (ISSUE 7) ----
+
+
+def test_guard_fires_once_after_sustained_hysteresis():
+    g = StragglerGuard(hysteresis_s=10.0, flap_budget=3,
+                       clock=lambda: 0.0)
+    assert not g.observe(1, True, now=0.0)    # episode opens
+    assert not g.observe(1, True, now=9.99)   # inside the window
+    assert g.observe(1, True, now=10.0)       # sustained: evict
+    assert not g.observe(1, True, now=11.0)   # latched: once per episode
+
+
+def test_guard_flap_under_budget_never_fires_and_rearm_on_live():
+    """The acceptance pin: brief lag episodes that recover before the
+    window are flaps — tolerated up to the budget, with the hysteresis
+    window re-armed on every return to LIVE."""
+    g = StragglerGuard(hysteresis_s=10.0, flap_budget=3)
+    t = 0.0
+    for _ in range(3):  # three flaps, budget 3: all tolerated
+        assert not g.observe(7, True, now=t)
+        assert not g.observe(7, True, now=t + 9.0)  # almost sustained...
+        assert not g.observe(7, False, now=t + 9.5)  # ...recovers: flap
+        t += 20.0
+    assert g.flaps[7] == 3
+    # the 4th episode starts over budget: chronic flapper, no more grace
+    assert g.observe(7, True, now=t)
+
+
+def test_guard_rearms_hysteresis_on_live_return():
+    """A host that recovers must NOT be evicted for two half-windows of
+    lag: the return to LIVE re-arms the full hysteresis window."""
+    g = StragglerGuard(hysteresis_s=10.0, flap_budget=5)
+    assert not g.observe(2, True, now=0.0)
+    assert not g.observe(2, False, now=6.0)   # recovered at 6s: flap 1
+    assert not g.observe(2, True, now=7.0)    # new episode from 7.0
+    assert not g.observe(2, True, now=12.0)   # 5s in: NOT 12s cumulative
+    assert g.observe(2, True, now=17.0)       # 10s sustained from 7.0
+
+
+def test_guard_fired_episode_is_not_a_flap_and_reset_forgets():
+    g = StragglerGuard(hysteresis_s=5.0, flap_budget=1)
+    g.observe(3, True, now=0.0)
+    assert g.observe(3, True, now=5.0)        # fired
+    assert not g.observe(3, False, now=6.0)   # ending a FIRED episode
+    assert g.flaps.get(3, 0) == 0             # ...is not a flap
+    # reset (the host was relaunched): fresh budget, fresh window
+    g.observe(3, True, now=7.0)
+    assert not g.observe(3, False, now=8.0)   # flap 1 (budget 1)
+    g.reset(3)
+    assert not g.observe(3, True, now=9.0)    # would fire if not reset
+    assert g.flaps.get(3, 0) == 0
+
+
+def test_guard_validation():
+    with pytest.raises(ValueError):
+        StragglerGuard(hysteresis_s=-1.0)
+    with pytest.raises(ValueError):
+        StragglerGuard(flap_budget=-1)

@@ -146,11 +146,43 @@ def test_incidents_merge_from_ft_events(interrupted_ledger, tmp_path):
     # from the event — the coordinator can't know it at recovery time.
     assert inc == {"incident": 1, "action": "gang", "ts": 1.5,
                    "downtime_s": 0.5, "detection_s": 0.05,
-                   "fleet_step": 5, "lost_steps": 1}
+                   "fleet_step": 5, "lost_steps": 1,
+                   "planned": False, "shrink": None, "ckpt": None}
     assert rep["incident_downtime_s"] == pytest.approx(0.5)
     # older event files without the enriched record fall back to mttr_s
     rep2 = merge_goodput(by_host, events[:2])
     assert rep2["incidents"][0]["downtime_s"] == 0.5
+
+
+def test_planned_incidents_are_flagged_and_split(interrupted_ledger):
+    """Graceful-degradation fields (ISSUE 7): a drained preemption's
+    incident row carries planned=true, shrink/ckpt detail passes
+    through, and unplanned_downtime_s excludes the planned rows — a
+    chosen restart must not read as a downtime regression."""
+    events = [
+        {"ts": 1.0, "kind": "detect", "incident": 1,
+         "failures": [{"host": 1, "kind": "preempt", "lead_s": 30.0}]},
+        {"ts": 1.4, "kind": "goodput_incident", "incident": 1,
+         "action": "drain_restart", "planned": True, "downtime_s": 0.4,
+         "detection_s": 0.01, "fleet_step": 5},
+        {"ts": 2.0, "kind": "detect", "incident": 2,
+         "failures": [{"host": 0, "kind": "crash", "rc": -9}]},
+        {"ts": 2.6, "kind": "goodput_incident", "incident": 2,
+         "action": "gang_restart", "planned": False, "downtime_s": 0.6,
+         "detection_s": 0.02, "fleet_step": 7,
+         "shrink": {"from_hosts": 2, "to_hosts": 1, "lost": [0],
+                    "generation": 3}},
+    ]
+    by_host, _ = read_goodput_dir(interrupted_ledger)
+    rep = merge_goodput(by_host, events)
+    planned, unplanned = rep["incidents"]
+    assert planned["planned"] is True and planned["action"] == "drain_restart"
+    assert unplanned["planned"] is False
+    assert unplanned["shrink"]["to_hosts"] == 1
+    assert rep["incident_downtime_s"] == pytest.approx(1.0)
+    assert rep["unplanned_downtime_s"] == pytest.approx(0.6)
+    text = render_goodput(rep)
+    assert "planned" in text  # the incident table names the split
 
 
 def test_give_up_incident_still_gets_a_row(interrupted_ledger):

@@ -237,6 +237,34 @@ def test_incident_without_recovery_still_bundles(tmp_path):
 
 # ---- goodput regression ledger + diff (satellite) ------------------------
 
+def test_degradation_incidents_render_their_kind(tmp_path):
+    """ISSUE 7 satellite: a drained preemption / shrink / ckpt retry
+    must read as what it is in the postmortem, not as a generic gang
+    restart."""
+    run = _incident_run(tmp_path)
+    with open(run / "ft" / "events.jsonl", "a") as f:
+        for row in [
+            {"ts": T0 + 40.0, "kind": "detect", "incident": 2,
+             "failures": [{"host": 1, "kind": "preempt", "lead_s": 30.0}]},
+            {"ts": T0 + 41.0, "kind": "recovered", "incident": 2,
+             "action": "drain_restart", "planned": True, "mttr_s": 1.0,
+             "escalated": 0, "dirty_exits": []},
+            {"ts": T0 + 41.0, "kind": "goodput_incident", "incident": 2,
+             "action": "drain_restart", "planned": True,
+             "downtime_s": 1.0, "detection_s": 0.01, "fleet_step": 30,
+             "shrink": {"from_hosts": 2, "to_hosts": 1, "lost": [1],
+                        "generation": 4},
+             "ckpt": {"bad_step": 20, "retry_from": 10}},
+        ]:
+            f.write(json.dumps(row) + "\n")
+    report = build_postmortem(run, incident_id=2)
+    assert report["incident"]["planned"] is True
+    text = render_postmortem(report)
+    assert "planned" in text
+    assert "2 -> 1 hosts" in text and "generation 4" in text
+    assert "step 20 failed to restore" in text and "from 10" in text
+
+
 def _fake_report(ratio, shares_step):
     wall = 100.0
     return {"wall_s": wall, "goodput_ratio": ratio, "num_hosts": 2,

@@ -1,1 +1,6 @@
-from tpucfn.bootstrap.contract import COORDINATOR_PORT, EnvContract, converge  # noqa: F401
+from tpucfn.bootstrap.contract import (  # noqa: F401
+    COORDINATOR_PORT,
+    EnvContract,
+    converge,
+    shrink_contract,
+)

@@ -106,3 +106,55 @@ def converge(record: ClusterRecord, run_dir: str | Path, host_id: int = 0) -> En
         "".join(f"export {k}={v!r}\n" for k, v in sorted(contract.to_env().items()))
     )
     return contract
+
+
+def shrink_contract(contract: EnvContract,
+                    lost_host_ids: list[int] | set[int],
+                    hostfile_path: str | Path | None = None) -> EnvContract:
+    """Re-converge at N-k hosts (elastic shrink, ISSUE 7): drop the lost
+    hosts from the launched slice, renumber the survivors 0..N-k-1, bump
+    the contract generation, and write the new hostfile next to the old
+    one (``<hostfile>.gen<G>`` — the previous generation's file is left
+    untouched for forensics).  The coordinator address follows the new
+    host 0 (on the original coordinator port) in case host 0 itself was
+    the one lost.
+
+    Raises ``ValueError`` when nothing would remain — a gang of zero is
+    not a shrink, it is a give-up, and the caller must decide that."""
+    hosts = contract.hosts()[: contract.workers_count]
+    lost = {int(h) for h in lost_host_ids}
+    bad = lost - set(range(len(hosts)))
+    if bad:
+        raise ValueError(
+            f"lost host id(s) {sorted(bad)} out of range for "
+            f"{len(hosts)} launched hosts")
+    keep = [h for i, h in enumerate(hosts) if i not in lost]
+    if not keep:
+        raise ValueError(
+            f"shrink would remove all {len(hosts)} hosts — nothing left "
+            "to re-converge")
+    generation = contract.generation + 1
+    old = Path(contract.workers_path)
+    path = (Path(hostfile_path) if hostfile_path is not None
+            else old.with_name(f"{old.name}.gen{generation}"))
+    path.write_text("".join(f"{h}\n" for h in keep))
+    coord_port = contract.coordinator.rsplit(":", 1)[1]
+    # This host's own new id: old id minus the lost ids below it — the
+    # same renumbering every survivor applies, so a per-host
+    # re-converge lands each machine in a distinct slot.  A caller
+    # whose own host was lost (shouldn't happen — the lost host has no
+    # business re-converging) clamps to 0.
+    if contract.host_id in lost:
+        new_host_id = 0
+    else:
+        new_host_id = contract.host_id - sum(
+            1 for i in lost if i < contract.host_id)
+    return EnvContract(
+        workers_path=str(path),
+        workers_count=len(keep),
+        worker_chip_count=contract.worker_chip_count,
+        coordinator=f"{keep[0].rsplit(':', 1)[0]}:{coord_port}",
+        host_id=new_host_id,
+        storage=contract.storage,
+        generation=generation,
+    )

@@ -12,14 +12,17 @@ item 2).
 
 from __future__ import annotations
 
+import os
 import shutil
 import time
 from pathlib import Path
-from typing import Any
+from typing import Any, Iterable
 
 import jax
 import jax.numpy as jnp
 import orbax.checkpoint as ocp
+
+from tpucfn.ft.policy import CKPT_BLACKLIST_ENV, parse_ckpt_blacklist
 
 
 def _is_key(x: Any) -> bool:
@@ -107,7 +110,20 @@ class CheckpointManager:
         max_to_keep: int = 3,
         save_interval_steps: int = 1,
         async_save: bool = True,
+        blacklist_steps: Iterable[int] | None = None,
     ):
+        """``blacklist_steps`` (ISSUE 7): step numbers the manager must
+        treat as nonexistent when picking the latest restore target —
+        the coordinator's checkpoint-corruption retry fans the set out
+        via ``TPUCFN_CKPT_BLACKLIST`` (the default read here), so a
+        relaunched gang resumes from the previous finalized step instead
+        of crash-looping the corrupt one.  Explicit saves/restores that
+        name a blacklisted step directly are still honored — the
+        blacklist steers selection, it does not hide data."""
+        if blacklist_steps is None:
+            blacklist_steps = parse_ckpt_blacklist(
+                os.environ.get(CKPT_BLACKLIST_ENV))
+        self.blacklist_steps = frozenset(int(s) for s in blacklist_steps)
         self.directory = Path(directory).absolute()
         self.directory.mkdir(parents=True, exist_ok=True)
         options = ocp.CheckpointManagerOptions(
@@ -171,7 +187,12 @@ class CheckpointManager:
         return rewrap_prng_keys(_rematerialize(restored), abstract_state)
 
     def latest_step(self) -> int | None:
-        return self._mgr.latest_step()
+        latest = self._mgr.latest_step()
+        if latest is None or latest not in self.blacklist_steps:
+            return latest
+        steps = [s for s in self._mgr.all_steps()
+                 if s not in self.blacklist_steps]
+        return max(steps, default=None)
 
     def wait(self) -> None:
         """Block until in-flight async saves are durable (call before

@@ -199,16 +199,50 @@ def cmd_launch(args) -> int:
               f"{args.obs_port + n_launched})", file=sys.stderr)
     try:
         if args.ft:
+            from tpucfn.ft import StragglerGuard
+
             budget = RestartBudget(
                 args.ft_restart_budget if args.ft_restart_budget is not None
                 else args.restarts,
                 backoff_s=args.ft_backoff, rng=random.Random(args.ft_seed))
+
+            # Elastic shrink (ISSUE 7): before relaunching a failed
+            # host, ask the control plane whether it still owns a
+            # healthy machine at that address — `tpucfn kill-host` (or
+            # a real backend losing capacity) makes the next recovery
+            # re-converge at N-1 instead of relaunching a ghost.
+            cp = _control_plane(args)
+
+            import time as _time
+
+            _reacquire_cache: dict = {"t": -10.0, "healthy": frozenset()}
+
+            def _reacquire(addr: str, _name=args.name, _cp=cp) -> bool:
+                # One describe() snapshot per incident burst (1s TTL),
+                # not one per probed host: the coordinator checks every
+                # host during a drain, and on a real backend that would
+                # be N API round-trips inside the preemption lead time.
+                now = _time.monotonic()
+                if now - _reacquire_cache["t"] > 1.0:
+                    _reacquire_cache["healthy"] = frozenset(
+                        h.address for h in _cp.describe(_name).hosts
+                        if h.healthy)
+                    _reacquire_cache["t"] = now
+                return addr in _reacquire_cache["healthy"]
+
             coordinator = GangCoordinator(
                 launcher, argv,
                 policy=policy_from_name(args.ft_policy, budget),
                 monitor=monitor, ft_dir=ft_dir, registry=registry,
                 kill_host_after=inject,
-                ckpt_dir=_run_dir(args, args.name) / "ckpt")
+                ckpt_dir=_run_dir(args, args.name) / "ckpt",
+                drain_grace_s=args.ft_drain_grace,
+                allow_shrink=not args.ft_no_shrink,
+                reacquire_check=_reacquire,
+                max_ckpt_retries=args.ft_max_ckpt_retries,
+                straggler_guard=StragglerGuard(
+                    hysteresis_s=args.ft_straggler_hysteresis,
+                    flap_budget=args.ft_straggler_flap_budget))
             rc = coordinator.run()
         else:
             rc = run_with_restarts(launcher, argv, max_restarts=args.restarts,
@@ -863,6 +897,20 @@ def cmd_ft_status(args) -> int:
               f"solo={m.get('ft_solo_restarts_total', 0)}) "
               f"failures_detected={m.get('ft_failures_detected_total', 0)} "
               f"mttr_p50={(mttr.get('p50') if isinstance(mttr, dict) else None)}")
+        # The graceful-degradation surface (ISSUE 7): only when any of
+        # the four paths actually fired — a quiet fleet stays terse.
+        degrade = {"planned_drains": m.get("ft_preempt_drains_total", 0),
+                   "shrinks": m.get("ft_shrinks_total", 0),
+                   "ckpt_retries": m.get("ft_ckpt_retries_total", 0),
+                   "evictions": m.get("ft_straggler_evictions_total", 0)}
+        if any(degrade.values()):
+            pm = m.get("ft_planned_mttr_seconds") or {}
+            planned_p50 = (pm.get("p50")
+                           if isinstance(pm, dict) else None)
+            print("degradation: "
+                  + " ".join(f"{k}={v}" for k, v in degrade.items())
+                  + (f" planned_mttr_p50={planned_p50}"
+                     if degrade["planned_drains"] else ""))
         if report["budget"]:
             b = report["budget"]
             print(f"policy={report['policy']} budget "
@@ -871,7 +919,20 @@ def cmd_ft_status(args) -> int:
         print("\n== recent events ==")
         for e in report["events"]:
             extra = {k: v for k, v in e.items() if k not in ("ts", "kind")}
-            print(f"  {e.get('ts', 0):.3f} {e.get('kind', '?'):12s} {extra}")
+            # Lead with the story, not the raw dict, for the new kinds:
+            # a drained preemption / shrink / ckpt retry must be
+            # recognizable at a glance, not read as a generic restart.
+            kind = e.get("kind", "?")
+            tag = ""
+            if kind == "recovered" and e.get("planned"):
+                tag = " [planned]"
+            elif kind == "shrink":
+                tag = (f" [{e.get('from_hosts')}->{e.get('to_hosts')} "
+                       f"gen {e.get('generation')}]")
+            elif kind == "ckpt_retry":
+                tag = (f" [bad step {e.get('bad_step')} -> retry from "
+                       f"{e.get('retry_from')}]")
+            print(f"  {e.get('ts', 0):.3f} {kind:12s}{tag} {extra}")
     return 0
 
 
@@ -957,6 +1018,29 @@ def build_parser() -> argparse.ArgumentParser:
     l.add_argument("--ft-seed", type=int, default=0,
                    help="seed for backoff jitter (determinism: same seed "
                         "replays the same delays)")
+    l.add_argument("--ft-drain-grace", type=float, default=30.0,
+                   metavar="SECONDS",
+                   help="preemption drain: how long to wait for clean "
+                        "exits when the notice carries no lead time (a "
+                        "shorter notice lead wins)")
+    l.add_argument("--ft-no-shrink", action="store_true",
+                   help="disable elastic N-1 shrink: a host the control "
+                        "plane lost gives up instead of re-converging "
+                        "the contract at fewer hosts")
+    l.add_argument("--ft-straggler-hysteresis", type=float, default=30.0,
+                   metavar="SECONDS",
+                   help="sustained step-lag required before a straggler "
+                        "is evicted (solo-restarted)")
+    l.add_argument("--ft-straggler-flap-budget", type=int, default=3,
+                   metavar="N",
+                   help="brief lag episodes tolerated per host before a "
+                        "chronic flapper is evicted without waiting out "
+                        "the hysteresis window")
+    l.add_argument("--ft-max-ckpt-retries", type=int, default=3,
+                   metavar="N",
+                   help="checkpoint-corruption retries (each blacklists "
+                        "one bad step and resumes from the previous) "
+                        "before the normal restart policy decides")
     l.add_argument("cmd", nargs=argparse.REMAINDER)
     l.set_defaults(fn=cmd_launch)
 

@@ -1,10 +1,12 @@
-"""tpucfn.ft — the fleet fault-tolerance plane (ISSUE 4).
+"""tpucfn.ft — the fleet fault-tolerance plane (ISSUE 4 + ISSUE 7).
 
 Heartbeat failure detection (``heartbeat``), recovery policies with
 budgets and backoff (``policy``), the gang coordinator that executes
-them over the launcher's process table (``coordinator``), and the
+them over the launcher's process table (``coordinator``), the
 deterministic chaos harness that proves the whole loop works
-(``chaos``).
+(``chaos``), and the graceful-degradation protocol — preemption
+notices + drain files (``preempt``), elastic N-1 shrink,
+checkpoint-corruption retry, straggler eviction guard (ISSUE 7).
 """
 
 from tpucfn.ft.chaos import (  # noqa: F401
@@ -27,6 +29,8 @@ from tpucfn.ft.heartbeat import (  # noqa: F401
     read_heartbeats,
 )
 from tpucfn.ft.policy import (  # noqa: F401
+    CKPT_BLACKLIST_ENV,
+    RESTORE_FAILED_RC,
     Action,
     Decision,
     Failure,
@@ -35,5 +39,15 @@ from tpucfn.ft.policy import (  # noqa: F401
     RecoveryPolicy,
     RestartBudget,
     SoloRestart,
+    StragglerGuard,
+    format_ckpt_blacklist,
+    parse_ckpt_blacklist,
     policy_from_name,
+)
+from tpucfn.ft.preempt import (  # noqa: F401
+    PreemptNotice,
+    consume_notice,
+    drain_requested,
+    request_drain,
+    write_notice,
 )

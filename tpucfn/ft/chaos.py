@@ -28,7 +28,8 @@ import re
 from pathlib import Path
 from typing import Any
 
-ACTIONS = ("kill", "hang", "delay_heartbeats", "corrupt_ckpt")
+ACTIONS = ("kill", "hang", "delay_heartbeats", "corrupt_ckpt",
+           "preempt_notice", "lose_host")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -36,13 +37,21 @@ class ChaosEvent:
     """One scheduled fault.  Fires when EITHER trigger is reached:
     ``at_s`` (seconds since the engine's first tick) or ``at_step``
     (fleet max step).  ``host=None`` lets the seeded RNG pick a victim
-    at fire time."""
+    at fire time.
+
+    Graceful-degradation ops (ISSUE 7): ``preempt_notice`` raises an
+    advance preemption notice for the host (``duration_s`` doubles as
+    the notice's lead seconds); ``lose_host`` kills the host AND marks
+    it un-reacquirable, so the coordinator's next relaunch must shrink
+    to N-1 instead of bringing it back; ``corrupt_ckpt`` with ``step``
+    set corrupts that specific step instead of the latest."""
 
     action: str
     at_s: float | None = None
     at_step: int | None = None
     host: int | None = None
-    duration_s: float = 0.0  # hang / delay_heartbeats length
+    duration_s: float = 0.0  # hang / delay_heartbeats / preempt lead
+    step: int | None = None  # corrupt_ckpt: target step (None = latest)
 
     def __post_init__(self):
         if self.action not in ACTIONS:
@@ -97,7 +106,18 @@ class ChaosTarget:
         touching the process (detector-side fault)."""
         raise NotImplementedError
 
-    def corrupt_latest_checkpoint(self, rng: random.Random) -> None:
+    def preempt_notice(self, host_id: int, lead_s: float) -> None:
+        """Raise an advance preemption notice for the host — the
+        graceful path: the coordinator should drain, not die."""
+        raise NotImplementedError
+
+    def lose_host(self, host_id: int) -> None:
+        """Kill the host AND refuse to ever give it back (a permanently
+        revoked machine) — the elastic-shrink trigger."""
+        raise NotImplementedError
+
+    def corrupt_latest_checkpoint(self, rng: random.Random,
+                                  step: int | None = None) -> None:
         raise NotImplementedError
 
 
@@ -114,6 +134,11 @@ class ControlPlaneChaosTarget(ChaosTarget):
         return len(self.cp.describe(self.name).hosts)
 
     def kill_host(self, host_id: int) -> None:
+        self.cp.kill_host(self.name, host_id)
+
+    def lose_host(self, host_id: int) -> None:
+        # On the control plane a kill IS a loss: the record flips
+        # unhealthy and stays so until a re-acquire replaces the slice.
         self.cp.kill_host(self.name, host_id)
 
 
@@ -173,8 +198,12 @@ class ChaosEngine:
                     self._resumes.append((elapsed_s + ev.duration_s, host))
             elif ev.action == "delay_heartbeats":
                 self.target.delay_heartbeats(host, ev.duration_s)
+            elif ev.action == "preempt_notice":
+                self.target.preempt_notice(host, ev.duration_s)
+            elif ev.action == "lose_host":
+                self.target.lose_host(host)
             elif ev.action == "corrupt_ckpt":
-                self.target.corrupt_latest_checkpoint(self.rng)
+                self.target.corrupt_latest_checkpoint(self.rng, step=ev.step)
             self.fired.append(rec)
             fired_now.append(rec)
         self._pending = still
@@ -190,12 +219,15 @@ _STEP_DIR = re.compile(r"^\d+$")
 
 
 def corrupt_latest_checkpoint(ckpt_dir: str | Path, rng: random.Random,
-                              *, garbage_bytes: int = 256) -> Path | None:
+                              *, garbage_bytes: int = 256,
+                              step: int | None = None) -> Path | None:
     """Overwrite the head of the largest file under the latest step's
     checkpoint directory with RNG garbage (and truncate there), so a
     subsequent restore fails loudly instead of resuming from silently
-    wrong state.  Returns the corrupted path, or None when there is no
-    checkpoint to corrupt.
+    wrong state.  ``step`` targets a specific finalized step instead of
+    the latest (ISSUE 7: deterministic drills need to hit the exact
+    checkpoint the retry path will blacklist).  Returns the corrupted
+    path, or None when there is no matching checkpoint.
 
     Works on the Orbax layout (``<dir>/<step>/...``) but only assumes
     "numeric step subdirectories containing files".
@@ -205,6 +237,8 @@ def corrupt_latest_checkpoint(ckpt_dir: str | Path, rng: random.Random,
         return None
     steps = sorted((int(p.name), p) for p in d.iterdir()
                    if p.is_dir() and _STEP_DIR.match(p.name))
+    if step is not None:
+        steps = [(s, p) for s, p in steps if s == step]
     if not steps:
         return None
     _, latest = steps[-1]

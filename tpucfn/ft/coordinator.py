@@ -24,11 +24,35 @@ the whole fault-tolerance loop in one place (ISSUE 4 tentpole):
 policy, no monitor), preserving its signature and its ``supervisor_*``
 metric names.
 
+Graceful degradation (ISSUE 7) — four paths beyond restart-at-same-size:
+
+* **preemption drain** — an advance notice (chaos op, or an external
+  daemon writing ``<ft_dir>/preempt.json``) raises ``FailureKind.
+  PREEMPT``; the decision table maps it to a *planned* drain: every
+  rank runs to one converged step boundary (``<ft_dir>/drain.json``),
+  force-saves through its own ckpt layer, exits clean, and the gang is
+  relaunched with zero lost work and zero budget consumed.
+* **elastic shrink** — a failed host that cannot be re-acquired (chaos
+  ``lose_host``, or ``reacquire_check`` says the control plane lost it)
+  shrinks the gang: the ``EnvContract`` re-converges at N-1 with a new
+  generation and the smaller gang resumes cross-topology from the
+  latest checkpoint.
+* **checkpoint-corruption retry** — a rank exiting with
+  ``RESTORE_FAILED_RC`` means the latest checkpoint would not restore;
+  instead of crash-looping the same artifact into give_up, the
+  coordinator quarantines the bad step, blacklists it for the ranks
+  (``TPUCFN_CKPT_BLACKLIST`` fan-out), and relaunches to resume from
+  the previous finalized step — without touching the restart budget.
+* **straggler eviction** — STRAGGLER verdicts pass through a
+  :class:`~tpucfn.ft.policy.StragglerGuard` (hysteresis window +
+  per-host flap budget, re-armed on return to LIVE) before the
+  STRAGGLER→SOLO_RESTART row — on by default since ISSUE 7 — may evict.
+
 The coordinator is also a :class:`~tpucfn.ft.chaos.ChaosTarget`: a
 :class:`~tpucfn.ft.chaos.ChaosSpec` passed in is replayed against the
-real subprocess table (SIGKILL / SIGSTOP / heartbeat delay / checkpoint
-corruption) on the same supervision clock, which is what makes the
-end-to-end recovery drill deterministic.
+real subprocess table (SIGKILL / SIGSTOP / heartbeat delay / preemption
+notice / host loss / checkpoint corruption) on the same supervision
+clock, which is what makes the end-to-end recovery drills deterministic.
 """
 
 from __future__ import annotations
@@ -40,10 +64,13 @@ import time
 from pathlib import Path
 from typing import Callable, Sequence
 
+from tpucfn.bootstrap import shrink_contract
 from tpucfn.ft.chaos import ChaosEngine, ChaosSpec, ChaosTarget, \
     corrupt_latest_checkpoint
 from tpucfn.ft.heartbeat import HeartbeatMonitor, HostState
 from tpucfn.ft.policy import (
+    CKPT_BLACKLIST_ENV,
+    RESTORE_FAILED_RC,
     Action,
     Decision,
     Failure,
@@ -51,6 +78,14 @@ from tpucfn.ft.policy import (
     GangRestart,
     RecoveryPolicy,
     RestartBudget,
+    StragglerGuard,
+    format_ckpt_blacklist,
+)
+from tpucfn.ft.preempt import (
+    PreemptNotice,
+    clear_drain,
+    consume_notice,
+    request_drain,
 )
 
 
@@ -74,7 +109,26 @@ class GangCoordinator(ChaosTarget):
         sleep: Callable[[float], None] = time.sleep,
         capture_flight: bool = True,
         flight_timeout_s: float = 2.0,
+        drain_grace_s: float = 30.0,
+        drain_step_margin: int = 2,
+        allow_shrink: bool = True,
+        reacquire_check: Callable[[str], bool] | None = None,
+        max_ckpt_retries: int = 3,
+        straggler_guard: StragglerGuard | None = None,
     ):
+        """Graceful-degradation knobs (ISSUE 7): ``drain_grace_s`` caps
+        how long a preemption drain waits for clean exits when the
+        notice carried no lead time (a notice's ``lead_s`` wins when
+        shorter — the drain must beat the preemption); the drain target
+        step is fleet max + ``drain_step_margin`` so every rank can
+        still converge on it.  ``reacquire_check(address) -> bool`` asks
+        the control plane whether a failed host is coming back; False
+        (or a chaos ``lose_host``) routes the restart through an
+        elastic N-1 shrink when ``allow_shrink``.  ``max_ckpt_retries``
+        bounds the corruption retry-from-previous loop (each retry
+        blacklists one more step; past the cap the normal policy
+        decides).  ``straggler_guard`` defaults to a 30s-hysteresis,
+        3-flap guard on this coordinator's clock."""
         self.launcher = launcher
         self.argv = list(argv)
         self.policy = policy if policy is not None else GangRestart(
@@ -90,6 +144,13 @@ class GangCoordinator(ChaosTarget):
         self.sleep = sleep
         self.capture_flight = capture_flight
         self.flight_timeout_s = flight_timeout_s
+        self.drain_grace_s = drain_grace_s
+        self.drain_step_margin = drain_step_margin
+        self.allow_shrink = allow_shrink
+        self.reacquire_check = reacquire_check
+        self.max_ckpt_retries = max_ckpt_retries
+        self.straggler_guard = (straggler_guard if straggler_guard is not None
+                                else StragglerGuard(clock=clock))
 
         if registry is None:
             # Throwaway registry: identical flow, nothing exported —
@@ -132,6 +193,25 @@ class GangCoordinator(ChaosTarget):
             "ft_hosts_live", "hosts LIVE per the heartbeat monitor")
         self.ft_stragglers_g = r.gauge(
             "ft_stragglers", "hosts flagged STRAGGLER by step lag")
+        # Graceful-degradation surface (ISSUE 7)
+        self.ft_preempt_drains_c = r.counter(
+            "ft_preempt_drains_total",
+            "preemption notices drained into planned restarts")
+        self.ft_planned_restarts_c = r.counter(
+            "ft_planned_restarts_total",
+            "planned relaunches (drains) — budget untouched")
+        self.ft_planned_mttr_s = r.summary(
+            "ft_planned_mttr_seconds",
+            "notice → drained-and-relaunched time for planned restarts")
+        self.ft_shrinks_c = r.counter(
+            "ft_shrinks_total",
+            "elastic shrinks (gang re-converged at fewer hosts)")
+        self.ft_ckpt_retries_c = r.counter(
+            "ft_ckpt_retries_total",
+            "checkpoint-corruption retries from a previous step")
+        self.ft_evictions_c = r.counter(
+            "ft_straggler_evictions_total",
+            "stragglers evicted past hysteresis/flap budget")
 
         hosts = self.launcher.contract.hosts()[
             : self.launcher.contract.workers_count]
@@ -145,11 +225,15 @@ class GangCoordinator(ChaosTarget):
         self._blind_until: dict[int, float] = {}
         self._next_observe = 0.0  # monitor read throttle (see _detect)
         self._last_fleet_step: int | None = None
-        self._reported_stragglers: set[int] = set()
         # HANG/DEAD verdicts the policy already declined to act on
         # (observe-only tables): suppressed until the host beats again,
         # or the detect loop would re-open the same incident every tick.
         self._suppressed_hangs: set[int] = set()
+        # Graceful-degradation state (ISSUE 7)
+        self._pending_notices: list[PreemptNotice] = []
+        self._lost_hosts: set[int] = set()   # chaos lose_host / reacquire
+        self._ckpt_blacklist: set[int] = set()
+        self._ckpt_retries = 0
         if isinstance(chaos, ChaosSpec):
             chaos = ChaosEngine(chaos, self)
         self.chaos = chaos
@@ -193,12 +277,23 @@ class GangCoordinator(ChaosTarget):
         self.monitor.inject_heartbeat_delay(
             host_id, extra_age_s=duration_s, duration_s=duration_s)
 
-    def corrupt_latest_checkpoint(self, rng) -> None:
+    def preempt_notice(self, host_id: int, lead_s: float) -> None:
+        self._pending_notices.append(
+            PreemptNotice(host=host_id,
+                          lead_s=lead_s if lead_s > 0 else None))
+        self._event("chaos_preempt_notice", host=host_id, lead_s=lead_s)
+
+    def lose_host(self, host_id: int) -> None:
+        self._lost_hosts.add(host_id)
+        self.kill_host(host_id)
+        self._event("host_lost", host=host_id)
+
+    def corrupt_latest_checkpoint(self, rng, step=None) -> None:
         if self.ckpt_dir is None:
             raise ValueError(
                 "chaos corrupt_ckpt fired but GangCoordinator has no "
                 "ckpt_dir configured")
-        victim = corrupt_latest_checkpoint(self.ckpt_dir, rng)
+        victim = corrupt_latest_checkpoint(self.ckpt_dir, rng, step=step)
         self._event("chaos_ckpt_corrupted",
                     path=None if victim is None else str(victim))
 
@@ -313,7 +408,7 @@ class GangCoordinator(ChaosTarget):
         procs = self.launcher.launch(self.argv, kill_host_after=inject)
         self._procs = dict(zip(self.host_ids, procs))
         self._finished.clear()
-        self._reported_stragglers.clear()
+        self.straggler_guard.reset_all()
         self._suppressed_hangs.clear()
         self.attempts_c.add()
         self.hosts_g.set(len(procs))
@@ -332,7 +427,7 @@ class GangCoordinator(ChaosTarget):
         self._procs[host_id] = self.launcher.launch_host(self.argv, host_id)
         self._finished.pop(host_id, None)
         self._suppressed_hangs.discard(host_id)
-        self._reported_stragglers.discard(host_id)
+        self.straggler_guard.reset(host_id)
         if self.monitor is not None:
             self.monitor.activate_host(host_id)
             # Blind only the replaced host: its stale heartbeat must not
@@ -349,6 +444,21 @@ class GangCoordinator(ChaosTarget):
 
     def _detect(self, now: float) -> list[Failure]:
         failures: list[Failure] = []
+        # Preemption notices (ISSUE 7): chaos-delivered plus the external
+        # sentinel file an out-of-band notice daemon writes.  Consumed
+        # here so one notice raises exactly one PREEMPT failure; a
+        # notice for a host that already exited is moot.
+        if self.ft_dir is not None:
+            n = consume_notice(self.ft_dir)
+            if n is not None:
+                self._pending_notices.append(n)
+        if self._pending_notices:
+            notices, self._pending_notices = self._pending_notices, []
+            for n in notices:
+                if n.host in self._procs:
+                    failures.append(Failure(
+                        n.host, FailureKind.PREEMPT, lead_s=n.lead_s,
+                        detail="preemption notice"))
         for host_id, p in list(self._procs.items()):
             rc = p.poll()
             if rc is None:
@@ -392,14 +502,15 @@ class GangCoordinator(ChaosTarget):
                 else:
                     # the host came back (fresh beat): re-arm reporting
                     self._suppressed_hangs.discard(v.host_id)
-                    if v.state is HostState.LIVE:
-                        # caught back up: a later straggle is a NEW
-                        # episode and must be reported again
-                        self._reported_stragglers.discard(v.host_id)
-                    if (v.state is HostState.STRAGGLER
+                    # Straggler verdicts go through the guard (ISSUE 7):
+                    # hysteresis + flap budget decide when lag becomes
+                    # an eviction.  A SUSPECT host (stale beat) freezes
+                    # the episode — neither lag evidence nor recovery.
+                    if (v.state in (HostState.LIVE, HostState.STRAGGLER)
                             and self._straggler_actionable()
-                            and v.host_id not in self._reported_stragglers):
-                        self._reported_stragglers.add(v.host_id)
+                            and self.straggler_guard.observe(
+                                v.host_id,
+                                v.state is HostState.STRAGGLER, now=now)):
                         failures.append(
                             Failure(v.host_id, FailureKind.STRAGGLER,
                                     step=v.step, detail=v.reason))
@@ -423,6 +534,14 @@ class GangCoordinator(ChaosTarget):
         exhausts the policy budget (the failing rc), or the policy
         declines to act on a fatal class."""
         try:
+            if self.ft_dir is not None:
+                # A previous incarnation aborted mid-drain (supervisor
+                # SIGKILLed inside the wait loop) leaves drain.json /
+                # preempt.json behind; the fresh gang would self-drain
+                # at its first boundary and "finish" rc 0 having
+                # trained nothing.  Stale protocol files die here.
+                clear_drain(self.ft_dir)
+                consume_notice(self.ft_dir)
             self._launch_gang(first=True)
             start = self.clock()
             while True:
@@ -457,6 +576,7 @@ class GangCoordinator(ChaosTarget):
         self._incident += 1
         incident = self._incident
         self.ft_incidents_c.add()
+        self._refresh_ckpt_blacklist()
         real = [f for f in failures if f.kind in (FailureKind.CRASH,
                                                   FailureKind.HANG)]
         if real:
@@ -464,7 +584,9 @@ class GangCoordinator(ChaosTarget):
             self.failures_c.add()
             self.rc_g.set(self._failure_rc(real))
         fail_json = [{"host": f.host_id, "kind": f.kind.value, "rc": f.rc,
-                      "step": f.step, "detail": f.detail} for f in failures]
+                      "step": f.step, "detail": f.detail,
+                      **({"lead_s": f.lead_s} if f.lead_s is not None
+                         else {})} for f in failures]
         self._event("detect", incident=incident, failures=fail_json)
         if self.tracer is not None:
             self.tracer.event("ft_detect", trace_id=incident,
@@ -473,11 +595,37 @@ class GangCoordinator(ChaosTarget):
             # Forensics before recovery: the survivors' flight rings are
             # about to be killed with the gang (ISSUE 6 tentpole).
             self._capture_flight(incident, {f.host_id for f in real})
+        # Checkpoint-corruption retry (ISSUE 7): a gang whose ranks exit
+        # with the restore-failure rc is not a fleet failure — the
+        # artifact is bad.  Retry from the previous finalized step
+        # instead of crash-looping the same corrupt checkpoint through
+        # the restart budget into give_up.  Handled before the policy so
+        # the budget is untouched; past max_ckpt_retries (or with no
+        # finalized step left to blacklist) the normal table decides.
+        if (real and self.ckpt_dir is not None
+                and self._ckpt_retries < self.max_ckpt_retries
+                and all(f.kind is FailureKind.CRASH
+                        and f.rc == RESTORE_FAILED_RC for f in real)):
+            bad = _latest_finalized_step(self.ckpt_dir,
+                                         exclude=self._ckpt_blacklist)
+            # Retry only when there is BOTH a step to blacklist and an
+            # earlier finalized step to resume from.  Quarantining the
+            # last remaining checkpoint would make the relaunch init
+            # fresh and "succeed" from step 0 — recovery must not
+            # silently retrain; crash-looping into a loud give_up (the
+            # restore-failure rc) is the honest outcome, and the
+            # quarantined steps are plain renames under corrupt/ the
+            # operator can move back.
+            if bad is not None and _latest_finalized_step(
+                    self.ckpt_dir,
+                    exclude=self._ckpt_blacklist | {bad}) is not None:
+                return self._ckpt_retry(incident, bad, t_detect)
         decision = self.policy.decide(failures)
         self._event("decide", incident=incident,
                     action=decision.action.value,
                     hosts=list(decision.hosts),
                     delay_s=round(decision.delay_s, 3),
+                    planned=decision.planned,
                     reason=decision.reason)
 
         if decision.action is Action.NONE:
@@ -505,12 +653,62 @@ class GangCoordinator(ChaosTarget):
                                    rc=rc)
             return rc
 
+        if decision.action is Action.DRAIN_RESTART:
+            return self._drain_restart(incident, decision, failures,
+                                       t_detect)
+
         if decision.delay_s > 0:
             self.sleep(decision.delay_s)
-        if decision.action is Action.SOLO_RESTART:
+        # A preemption notice that arrived in the same tick as a real
+        # failure lost the decision to the restart — but the machine is
+        # still going away, and the notice was already one-shot
+        # consumed.  Re-queue it so the next tick raises a PREEMPT-only
+        # incident against the relaunched gang and the drain still
+        # happens ahead of the actual preemption.  (Only on restart
+        # shapes: an observe-only NONE table would re-fire forever, and
+        # after GIVE_UP there is nothing left to drain.)
+        for f in failures:
+            if f.kind is FailureKind.PREEMPT:
+                self._pending_notices.append(
+                    PreemptNotice(host=f.host_id, lead_s=f.lead_s))
+        extra: dict = {}
+        # Elastic shrink (ISSUE 7): a restart cannot bring back a host
+        # the fleet has lost for good (chaos lose_host, or the control
+        # plane reports it gone) — re-converge the contract at N-k and
+        # relaunch the smaller gang instead of crash-looping relaunches
+        # of a machine that no longer exists.
+        failed_hosts = {f.host_id for f in real} | set(decision.hosts)
+        lost = {h for h in failed_hosts
+                if h in self.host_ids and self._host_lost(h)}
+        if lost and self.allow_shrink:
+            if len(self.host_ids) - len(lost) < 1:
+                rc = self._failure_rc(failures)
+                self.ft_give_ups_c.add()
+                self._stop_hosts(list(self._procs))
+                self.rc_g.set(rc)
+                self._event("give_up", incident=incident, rc=rc,
+                            reason=f"all {len(self.host_ids)} host(s) "
+                                   "lost — nothing left to shrink to")
+                if self.tracer is not None:
+                    self.tracer.record("ft_give_up", start=t_detect,
+                                       end=self.clock(), trace_id=incident,
+                                       rc=rc)
+                return rc
+            self._stop_hosts(list(self._procs))
+            extra["shrink"] = self._do_shrink(incident, lost)
+            self._launch_gang(first=False)
+            self.ft_gang_restarts_c.add()
+            self.ft_restarts_c.add()
+            self.restarts_c.add()
+        elif decision.action is Action.SOLO_RESTART:
             self._stop_hosts(decision.hosts)
             for h in decision.hosts:
                 self._launch_solo(h)
+            evicted = sum(1 for f in failures
+                          if f.kind is FailureKind.STRAGGLER
+                          and f.host_id in decision.hosts)
+            if evicted:
+                self.ft_evictions_c.add(evicted)
             self.ft_solo_restarts_c.add(len(decision.hosts))
             self.ft_restarts_c.add(len(decision.hosts))
             self.restarts_c.add(len(decision.hosts))
@@ -523,7 +721,8 @@ class GangCoordinator(ChaosTarget):
         mttr = self.clock() - t_detect
         self.ft_mttr_s.observe(mttr)
         self._event("recovered", incident=incident,
-                    action=decision.action.value, mttr_s=round(mttr, 4))
+                    action=decision.action.value, mttr_s=round(mttr, 4),
+                    **extra)
         # Goodput attribution (ISSUE 5): one ledger row per incident so
         # `tpucfn obs goodput` can name who stole the fleet's seconds.
         # detection_s is the estimated failure→detect latency: a HANG is
@@ -535,12 +734,217 @@ class GangCoordinator(ChaosTarget):
             detection_s = self.monitor.config.dead_s
         self._event("goodput_incident", incident=incident,
                     action=decision.action.value,
+                    planned=False,
                     downtime_s=round(mttr, 4),
                     detection_s=round(detection_s, 4),
-                    fleet_step=self._last_fleet_step)
+                    fleet_step=self._last_fleet_step,
+                    **extra)
         if self.tracer is not None:
             self.tracer.record("ft_recover", start=t_detect, dur_s=mttr,
                                trace_id=incident,
                                action=decision.action.value,
                                hosts=list(decision.hosts))
         return None
+
+    # -- graceful degradation (ISSUE 7) -----------------------------------
+
+    def _refresh_ckpt_blacklist(self) -> None:
+        """Expire the corruption blacklist once the run has finalized a
+        step NEWER than everything on it: the re-run has re-saved past
+        the quarantined artifact, and keeping the stale blacklist would
+        make every later ordinary restart skip a perfectly good latest
+        checkpoint and silently rewind a full interval of real work.
+        The retry budget re-arms with it — its job is to stop loops on
+        the SAME artifacts, and those are gone."""
+        if not self._ckpt_blacklist or self.ckpt_dir is None:
+            return
+        newest = _latest_finalized_step(self.ckpt_dir,
+                                        exclude=self._ckpt_blacklist)
+        if newest is not None and newest > max(self._ckpt_blacklist):
+            self._event("ckpt_blacklist_expired",
+                        blacklist=sorted(self._ckpt_blacklist),
+                        newest_step=newest)
+            self._ckpt_blacklist.clear()
+            self._ckpt_retries = 0
+            self.launcher.extra_env.pop(CKPT_BLACKLIST_ENV, None)
+
+    def _host_lost(self, host_id: int) -> bool:
+        """Is this host gone for good?  Chaos ``lose_host`` marks it
+        directly; otherwise the control plane is asked through
+        ``reacquire_check(address)`` — best-effort, because a flaky
+        control-plane answer must degrade to a same-size restart, not
+        block recovery."""
+        if host_id in self._lost_hosts:
+            return True
+        if self.reacquire_check is None:
+            return False
+        hosts = self.launcher.contract.hosts()[
+            : self.launcher.contract.workers_count]
+        if not 0 <= host_id < len(hosts):
+            return False
+        try:
+            return not self.reacquire_check(hosts[host_id])
+        except Exception:  # noqa: BLE001 — see docstring
+            return False
+
+    def _drain_restart(self, incident: int, decision: Decision,
+                       failures: list[Failure], t_detect: float) -> None:
+        """Preemption drain: converge the gang on one step boundary via
+        the drain file, let every rank force-save and exit clean, then
+        relaunch as a PLANNED restart — zero lost work, zero budget.
+        The drain target is fleet max step + margin so laggards can
+        still reach it inside the notice's lead time."""
+        leads = [f.lead_s for f in failures
+                 if f.kind is FailureKind.PREEMPT and f.lead_s]
+        grace = min([*leads, self.drain_grace_s]) if leads \
+            else self.drain_grace_s
+        target = None
+        if self._last_fleet_step is not None:
+            target = self._last_fleet_step + self.drain_step_margin
+        drain_file = None
+        if self.ft_dir is not None:
+            drain_file = request_drain(self.ft_dir, step=target)
+        self._event("drain", incident=incident, hosts=list(decision.hosts),
+                    step=target, grace_s=round(grace, 3),
+                    file=None if drain_file is None else str(drain_file))
+        escalated = 0
+        if drain_file is not None:
+            deadline = self.clock() + grace
+            while (any(p.poll() is None for p in self._procs.values())
+                   and self.clock() < deadline):
+                self.sleep(self.poll_interval)
+        leftovers = [p for p in self._procs.values() if p.poll() is None]
+        if leftovers:
+            # No drain channel (ft_dir unset), or the lead time ran out:
+            # stop the stragglers the hard way.  Still a planned
+            # restart — the preemption was coming either way — just a
+            # less graceful one, and the event says so.
+            escalated = self.launcher.stop_all(
+                leftovers, grace_s=self.term_grace_s,
+                poll_interval=self.poll_interval)
+        dirty = sorted(h for h, p in self._procs.items()
+                       if p.poll() not in (0, None))
+        self._procs.clear()
+        if self.ft_dir is not None:
+            # A relaunched gang polling a stale drain file would
+            # immediately drain itself again.
+            clear_drain(self.ft_dir)
+        extra: dict = {}
+        # A preempted host the control plane will not give back turns
+        # the planned relaunch into a planned shrink.
+        lost = {h for h in self.host_ids if self._host_lost(h)}
+        if (lost and self.allow_shrink
+                and len(self.host_ids) - len(lost) >= 1):
+            extra["shrink"] = self._do_shrink(incident, lost)
+        self._launch_gang(first=False)
+        self.ft_preempt_drains_c.add()
+        self.ft_planned_restarts_c.add()
+        mttr = self.clock() - t_detect
+        self.ft_planned_mttr_s.observe(mttr)
+        self._event("recovered", incident=incident,
+                    action=decision.action.value, planned=True,
+                    mttr_s=round(mttr, 4), escalated=escalated,
+                    dirty_exits=dirty, **extra)
+        self._event("goodput_incident", incident=incident,
+                    action=decision.action.value, planned=True,
+                    downtime_s=round(mttr, 4),
+                    detection_s=round(self.poll_interval, 4),
+                    fleet_step=self._last_fleet_step, **extra)
+        if self.tracer is not None:
+            self.tracer.record("ft_recover", start=t_detect, dur_s=mttr,
+                               trace_id=incident,
+                               action=decision.action.value,
+                               hosts=list(decision.hosts))
+        return None
+
+    def _do_shrink(self, incident: int, lost: set[int]) -> dict:
+        """Re-converge the contract at N-k (stopped gang assumed):
+        survivors renumber to 0..N-k-1, the monitor re-scopes (the old
+        highest ids' heartbeat files must stop being judged), and the
+        launcher's next launch uses the new generation's hostfile.  The
+        caller relaunches."""
+        old_n = len(self.host_ids)
+        new_contract = shrink_contract(self.launcher.contract, sorted(lost))
+        self.launcher.contract = new_contract
+        new_n = new_contract.workers_count
+        if self.monitor is not None:
+            for h in range(new_n, old_n):
+                self.monitor.retire_host(h)
+            self.monitor.set_expected_hosts(new_n)
+        self.host_ids = list(range(new_n))
+        # Renumbered ids make the old lost-markers meaningless; a host
+        # lost in the NEW numbering will be re-marked when it fails.
+        self._lost_hosts.clear()
+        self.ft_shrinks_c.add()
+        info = {"from_hosts": old_n, "to_hosts": new_n,
+                "lost": sorted(lost),
+                "generation": new_contract.generation}
+        self._event("shrink", incident=incident, **info)
+        return info
+
+    def _ckpt_retry(self, incident: int, bad_step: int,
+                    t_detect: float) -> None:
+        """Blacklist + quarantine the checkpoint that failed to restore
+        and relaunch to resume from the previous finalized step.  The
+        quarantine rename is what frees the step number for a fresh
+        save after the re-run; the env blacklist is the belt-and-braces
+        for ranks whose manager opened before the rename (or if the
+        rename failed)."""
+        self._ckpt_retries += 1
+        self._ckpt_blacklist.add(bad_step)
+        self.ft_ckpt_retries_c.add()
+        quarantine = None
+        src = self.ckpt_dir / str(bad_step)
+        if src.is_dir():
+            dst = self.ckpt_dir / "corrupt" / str(bad_step)
+            try:
+                dst.parent.mkdir(parents=True, exist_ok=True)
+                src.rename(dst)
+                quarantine = str(dst)
+            except OSError:
+                pass  # blacklist env still steers the resume past it
+        self.launcher.extra_env[CKPT_BLACKLIST_ENV] = \
+            format_ckpt_blacklist(self._ckpt_blacklist)
+        retry_from = _latest_finalized_step(self.ckpt_dir,
+                                            exclude=self._ckpt_blacklist)
+        ckpt_info = {"bad_step": bad_step, "retry_from": retry_from}
+        self._event("ckpt_retry", incident=incident,
+                    blacklist=sorted(self._ckpt_blacklist),
+                    quarantine=quarantine, **ckpt_info)
+        self._stop_hosts(list(self._procs))
+        self._launch_gang(first=False)
+        self.ft_gang_restarts_c.add()
+        self.ft_restarts_c.add()
+        self.restarts_c.add()
+        mttr = self.clock() - t_detect
+        self.ft_mttr_s.observe(mttr)
+        self._event("recovered", incident=incident, action="ckpt_retry",
+                    mttr_s=round(mttr, 4), ckpt=ckpt_info)
+        self._event("goodput_incident", incident=incident,
+                    action="ckpt_retry", planned=False,
+                    downtime_s=round(mttr, 4),
+                    detection_s=round(self.poll_interval, 4),
+                    fleet_step=self._last_fleet_step, ckpt=ckpt_info)
+        if self.tracer is not None:
+            self.tracer.record("ft_recover", start=t_detect, dur_s=mttr,
+                               trace_id=incident, action="ckpt_retry",
+                               hosts=[])
+        return None
+
+
+def _latest_finalized_step(ckpt_dir: str | Path,
+                           exclude: set[int] | frozenset[int] = frozenset()
+                           ) -> int | None:
+    """Latest finalized checkpoint step by scanning the directory —
+    finalized step dirs are bare numbers; in-flight orbax saves carry a
+    tmp suffix and quarantined corrupt steps live under ``corrupt/``,
+    so neither matches.  (Orbax's own ``latest_step()`` serves a list
+    cached at manager init, which the supervisor never opened.)"""
+    try:
+        entries = list(Path(ckpt_dir).iterdir())
+    except OSError:
+        return None
+    steps = [int(p.name) for p in entries
+             if p.is_dir() and p.name.isdigit()
+             and int(p.name) not in exclude]
+    return max(steps, default=None)

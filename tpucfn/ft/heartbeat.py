@@ -291,6 +291,17 @@ class HeartbeatMonitor:
         heartbeat going stale is retirement, not death."""
         self._retired.add(host_id)
 
+    def set_expected_hosts(self, expected: int | list[int] | None) -> None:
+        """Re-scope the judged fleet (elastic shrink, ISSUE 7): after the
+        gang re-converges at N-1 the old highest id's heartbeat file
+        still exists on disk, and without re-scoping its aging last beat
+        would read as a phantom hang of a host the contract no longer
+        has."""
+        if isinstance(expected, int):
+            expected = list(range(expected))
+        self.expected_hosts = (None if expected is None
+                               else sorted(expected))
+
     def activate_host(self, host_id: int) -> None:
         """Re-judge ``host_id`` (a retired slot was relaunched)."""
         self._retired.discard(host_id)

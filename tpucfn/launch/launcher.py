@@ -85,6 +85,11 @@ class Launcher:
     # the same heartbeat file the dead one owned.
     ft_dir: str | None = None
     ft_heartbeat_s: float | None = None
+    # Supervisor-injected vars applied to every subsequent (re)launch —
+    # how the coordinator's graceful-degradation state (e.g. the ckpt
+    # step blacklist on a corruption retry, ISSUE 7) reaches the ranks
+    # without the contract changing.  Applied last, so it can override.
+    extra_env: dict[str, str] = dataclasses.field(default_factory=dict)
 
     def host_env(self, host_id: int) -> dict[str, str]:
         env = self.contract.to_env()
@@ -95,6 +100,7 @@ class Launcher:
             env["TPUCFN_FT_DIR"] = self.ft_dir
             if self.ft_heartbeat_s is not None:
                 env["TPUCFN_FT_HEARTBEAT_S"] = repr(float(self.ft_heartbeat_s))
+        env.update(self.extra_env)
         return env
 
     def launch(

@@ -443,14 +443,25 @@ def _incidents_from_events(events: Iterable[dict]) -> list[dict]:
                         "downtime_s": e.get("downtime_s"),
                         "detection_s": e.get("detection_s"),
                         "fleet_step": e.get("fleet_step"),
-                        "lost_steps": e.get("lost_steps")})
+                        "lost_steps": e.get("lost_steps"),
+                        # graceful-degradation fields (ISSUE 7): a
+                        # planned drain must not read as a downtime
+                        # regression; shrink/ckpt carry the N→N-1 and
+                        # retried-step detail the renderers show.
+                        "planned": bool(e.get("planned", False)),
+                        "shrink": e.get("shrink"),
+                        "ckpt": e.get("ckpt")})
         elif inc in recovered:
             out.append({"incident": inc,
                         "action": recovered[inc].get("action"),
                         "ts": recovered[inc].get("ts"),
                         "downtime_s": recovered[inc].get("mttr_s"),
                         "detection_s": None, "fleet_step": None,
-                        "lost_steps": None})
+                        "lost_steps": None,
+                        "planned": bool(recovered[inc].get("planned",
+                                                           False)),
+                        "shrink": recovered[inc].get("shrink"),
+                        "ckpt": recovered[inc].get("ckpt")})
         else:
             e = give_ups.get(inc) or decides.get(inc) or detects[inc]
             action = ("give_up" if inc in give_ups
@@ -458,7 +469,8 @@ def _incidents_from_events(events: Iterable[dict]) -> list[dict]:
             out.append({"incident": inc, "action": action,
                         "ts": e.get("ts"), "downtime_s": None,
                         "detection_s": None, "fleet_step": None,
-                        "lost_steps": None})
+                        "lost_steps": None, "planned": False,
+                        "shrink": None, "ckpt": None})
     return out
 
 
@@ -545,6 +557,12 @@ def merge_goodput(by_host: dict[int, list[dict]],
         "incidents": incidents,
         "incident_downtime_s": sum(i["downtime_s"] or 0.0
                                    for i in incidents),
+        # Drained preemptions are restarts the fleet CHOSE to make
+        # (ISSUE 7) — regression tracking should watch the unplanned
+        # number, with the planned share reported alongside.
+        "unplanned_downtime_s": sum(i["downtime_s"] or 0.0
+                                    for i in incidents
+                                    if not i.get("planned")),
     }
 
 
@@ -581,6 +599,10 @@ def append_goodput_ledger(path: str | Path, report: dict, *,
         "productive_steps": report.get("productive_steps"),
         "lost_steps": report.get("lost_steps"),
         "incidents": len(report.get("incidents") or ()),
+        "planned_incidents": sum(
+            1 for i in (report.get("incidents") or ())
+            if i.get("planned")),
+        "unplanned_downtime_s": report.get("unplanned_downtime_s"),
         "buckets": dict(buckets),
         "shares": {b: (v / wall if wall > 0 else None)
                    for b, v in buckets.items()},
@@ -659,9 +681,14 @@ def render_goodput(report: dict) -> str:
             "steps", "lost_steps", "windows", "goodput"]))
     if report["incidents"]:
         lines.append("")
-        lines.append("== incidents ==")
+        planned = sum(1 for i in report["incidents"] if i.get("planned"))
+        lines.append(
+            "== incidents =="
+            + (f"  ({planned} planned; unplanned downtime "
+               f"{report.get('unplanned_downtime_s', 0.0):.2f}s)"
+               if planned else ""))
         lines.append(render_table(report["incidents"], [
-            "incident", "action", "downtime_s", "detection_s",
+            "incident", "action", "planned", "downtime_s", "detection_s",
             "fleet_step", "lost_steps"]))
     if report["skipped_lines"] or report["hosts_empty"]:
         lines.append(f"\n(skipped {report['skipped_lines']} torn lines, "

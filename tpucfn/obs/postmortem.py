@@ -296,8 +296,10 @@ def write_bundle(report: dict, out_dir: str | Path) -> Path:
 def render_postmortem(report: dict) -> str:
     """The human summary (``report.md`` and the CLI's default output)."""
     inc = report["incident"]
-    lines = [f"# postmortem — incident {inc['incident']} "
-             f"({inc.get('action') or 'unresolved'})",
+    label = inc.get("action") or "unresolved"
+    if inc.get("planned"):
+        label += ", planned"
+    lines = [f"# postmortem — incident {inc['incident']} ({label})",
              "",
              f"run dir: {report['run_dir']}",
              f"detected at: {report['detect_ts']}",
@@ -305,6 +307,22 @@ def render_postmortem(report: dict) -> str:
              f"detection_s: {inc.get('detection_s')}  "
              f"fleet_step: {inc.get('fleet_step')}  "
              f"lost_steps: {inc.get('lost_steps')}"]
+    if inc.get("planned"):
+        lines.append("planned restart: preemption notice drained into a "
+                     "clean stop — this downtime was chosen, not suffered")
+    shrink = inc.get("shrink")
+    if shrink:
+        lines.append(
+            f"elastic shrink: {shrink.get('from_hosts')} -> "
+            f"{shrink.get('to_hosts')} hosts "
+            f"(lost {shrink.get('lost')}, contract generation "
+            f"{shrink.get('generation')})")
+    ckpt = inc.get("ckpt")
+    if ckpt:
+        lines.append(
+            f"checkpoint retry: step {ckpt.get('bad_step')} failed to "
+            f"restore and was blacklisted; resumed from "
+            f"{ckpt.get('retry_from')}")
     detect = next((e for e in report["events"]
                    if e.get("kind") == "detect"), None)
     if detect and detect.get("failures"):

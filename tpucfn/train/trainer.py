@@ -40,6 +40,32 @@ LossFn = Callable[[Any, Any, Any, jax.Array], tuple[jax.Array, tuple[dict, Any]]
 InitFn = Callable[[jax.Array], tuple[Any, Any]]
 
 
+class RestoreFailure(RuntimeError):
+    """A checkpoint EXISTS but restoring it failed (corruption,
+    truncation, a half-written save that slipped past finalization).
+
+    Distinct from "no checkpoint" (which quietly falls back to a fresh
+    init) because the two demand opposite recoveries: a missing
+    checkpoint means start over, a corrupt one means *retry from the
+    previous step* — the trainer's caller should exit with
+    ``tpucfn.ft.RESTORE_FAILED_RC`` so the gang coordinator can
+    blacklist the bad step instead of crash-looping into give_up
+    (ISSUE 7).
+
+    Deliberately broad: any failure restoring an existing checkpoint
+    maps here, including non-corruption causes (a sharding/config
+    mismatch, a transient allocator failure).  The coordinator's
+    response is bounded (``max_ckpt_retries``) and reversible — a
+    "quarantined" step is a plain rename into ``<ckpt>/corrupt/`` the
+    operator can move back — and with no earlier step to resume from
+    it declines to retry and fails loudly rather than re-init fresh."""
+
+    def __init__(self, step: int, cause: BaseException):
+        super().__init__(
+            f"restoring checkpoint step {step} failed: {cause!r}")
+        self.step = step
+
+
 @dataclasses.dataclass(frozen=True)
 class TrainerConfig:
     donate_state: bool = True
@@ -153,11 +179,19 @@ class Trainer:
         fresh when there is none (or ``fresh`` forces it).  Returns
         ``(state, resumed_step)`` with ``resumed_step=None`` for a fresh
         init — the one call a gang-restarted job needs to continue from
-        the last saved step instead of retraining from 0."""
+        the last saved step instead of retraining from 0.
+
+        A checkpoint that exists but will not restore raises
+        :class:`RestoreFailure` (never silently re-inits: losing the
+        whole run to a corrupt latest step is the coordinator's call,
+        not this method's)."""
         if ckpt is not None and not fresh:
             latest = ckpt.latest_step()
             if latest is not None:
-                return ckpt.restore(self.abstract_state()), latest
+                try:
+                    return ckpt.restore(self.abstract_state()), latest
+                except Exception as e:  # noqa: BLE001 — see docstring
+                    raise RestoreFailure(latest, e) from e
         return self.init(rng), None
 
     def abstract_state(self) -> Any:
