@@ -28,6 +28,15 @@ with no serving number to compare against (detail.baseline_note says
 so).  Meaningful throughput needs the real chip; on CPU this is a
 correctness and scheduling-overhead bench.
 
+* **Availability** (``--availability``, ISSUE 9): the serve-side
+  analogue of ``ft_bench``'s MTTR split — a deterministic open-loop run
+  (seeded exponential arrival trace) against TWO replicas behind the
+  :class:`~tpucfn.serve.router.ReplicaRouter`, with replica 0 killed at
+  the trace midpoint.  Emits its own BENCH row
+  (``metric: serve_availability``) whose ``detail`` carries
+  ``availability`` (fraction of ACCEPTED requests completing within
+  deadline), the retry success rate, and the hedge win rate.
+
 Usage: python benches/serve_bench.py [--preset tiny --requests 32 ...]
 """
 
@@ -77,6 +86,136 @@ def _run_workload(engine, args, prompts, *, prefix_cache, max_prefill_batch,
     }
 
 
+def run_availability(args) -> int:
+    """Open-loop availability drill: 2 replicas, seeded arrival trace,
+    replica 0 killed after half the trace has been submitted.  Every
+    count in the row is over ACCEPTED requests — admission rejections
+    are the router doing its job, not lost availability."""
+    import jax
+    import numpy as np
+
+    from tpucfn.serve import AdmissionError, ReplicaRouter, Server
+    from tpucfn.serve.engine import ServeEngine, demo_llama_engine
+
+    print(f"# backend={jax.default_backend()} availability drill "
+          f"requests={args.avail_requests}", file=sys.stderr)
+    cfg, engine = demo_llama_engine(args.preset, seed=args.seed,
+                                    max_batch=args.max_batch,
+                                    cache_len=args.cache_len,
+                                    prefill_width=args.max_prefill_batch)
+    engines = [engine,
+               ServeEngine.from_llama(cfg, engine.params,
+                                      max_batch=args.max_batch,
+                                      cache_len=args.cache_len,
+                                      prefill_width=args.max_prefill_batch)]
+
+    def factory(i: int) -> Server:
+        return Server(engines[i], num_blocks=args.num_blocks,
+                      block_size=args.block_size, prefix_cache=True,
+                      max_prefill_batch=args.max_prefill_batch)
+
+    rs = np.random.RandomState(args.seed)
+    prompts = [rs.randint(0, cfg.vocab_size,
+                          rs.randint(args.prompt_len_lo,
+                                     args.prompt_len_hi + 1)).tolist()
+               for _ in range(args.avail_requests)]
+    # Seeded open-loop arrival trace: exponential inter-arrivals, fixed
+    # by --seed, so two runs submit the same prompts at the same
+    # offsets — the arrival process is part of the drill's identity.
+    gaps = rs.exponential(args.avail_interarrival_ms / 1000.0,
+                          size=args.avail_requests)
+    arrivals = np.cumsum(gaps)
+
+    # Compile warmup outside the timed/measured window: both replicas'
+    # buckets (each engine owns its own jit caches).
+    from tpucfn.serve.scheduler import prefill_bucket
+    for eng in engines:
+        warm = Server(eng, num_blocks=args.num_blocks,
+                      block_size=args.block_size, prefix_cache=False,
+                      max_prefill_batch=args.max_prefill_batch)
+        for b in sorted({prefill_bucket(len(q), args.cache_len)
+                         for q in prompts}):
+            warm.submit([1] * min(b, args.cache_len - 2), max_new_tokens=2)
+        warm.run_until_idle()
+
+    router = ReplicaRouter(factory, 2, retry_budget=args.retry_budget,
+                           hedge_ms=args.hedge_ms,
+                           breaker_cooldown_s=1.0)
+    router.start()
+    kill_at = args.avail_requests // 2
+    reqs, rejected = [], 0
+    t0 = time.perf_counter()
+    killed_at_s = None
+    for k, q in enumerate(prompts):
+        if k == kill_at:
+            killed_at_s = time.perf_counter() - t0
+            router.kill_replica(0)
+        lag = arrivals[k] - (time.perf_counter() - t0)
+        if lag > 0:
+            time.sleep(lag)
+        try:
+            reqs.append(router.submit(q, max_new_tokens=args.max_new,
+                                      deadline_s=args.avail_deadline_s))
+        except AdmissionError:
+            # ONLY admission rejections are tolerable here; a router
+            # bug raising anything else must crash the bench, not be
+            # tallied into a plausible-looking row
+            rejected += 1
+    for r in reqs:
+        r.done.wait(args.avail_deadline_s + 30.0)
+    wall = time.perf_counter() - t0
+    router.stop()
+
+    accepted = len(reqs)
+    ok = sum(1 for r in reqs if r.status == "ok")
+    dropped = sum(1 for r in reqs if r.status == "pending")
+    retried = [r for r in reqs if r.retries > 0]
+    retried_ok = sum(1 for r in retried if r.status == "ok")
+    snap = router.snapshot()
+    availability = ok / accepted if accepted else 0.0
+    row = {
+        "metric": "serve_availability",
+        "value": round(availability, 4),
+        "unit": "fraction of accepted requests completing within deadline",
+        "vs_baseline": 0.0,
+        "detail": {
+            "baseline_note": "reference harness was training-only; no "
+                             "published serving availability exists",
+            "backend": jax.default_backend(),
+            "preset": args.preset,
+            "replicas": 2,
+            "requests": args.avail_requests,
+            "accepted": accepted,
+            "rejected_at_submit": rejected,
+            "availability": round(availability, 4),
+            "dropped": dropped,
+            "completed_ok": ok,
+            "retried": len(retried),
+            "retry_success_rate": (round(retried_ok / len(retried), 4)
+                                   if retried else None),
+            "hedges": snap["hedges"],
+            "hedge_win_rate": (round(snap["hedges_won"] / snap["hedges"], 4)
+                               if snap["hedges"] else None),
+            "failovers": snap["failovers"],
+            "kill_at_request": kill_at,
+            "killed_at_s": (round(killed_at_s, 3)
+                            if killed_at_s is not None else None),
+            "deadline_s": args.avail_deadline_s,
+            "interarrival_ms": args.avail_interarrival_ms,
+            "retry_budget": args.retry_budget,
+            "hedge_ms": args.hedge_ms,
+            "wall_s": round(wall, 3),
+            "seed": args.seed,
+            "router": snap,
+        },
+    }
+    print(json.dumps(row))
+    # A dropped request (accepted, never reached a terminal status) is
+    # the one unacceptable outcome — the row reports availability, the
+    # exit code guards delivery.
+    return 0 if dropped == 0 else 1
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--preset", choices=["tiny", "llama3-1b", "llama3-8b"],
@@ -94,7 +233,23 @@ def main() -> int:
                         "workload")
     p.add_argument("--max-prefill-batch", type=int, default=4)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--availability", action="store_true",
+                   help="run the 2-replica open-loop availability drill "
+                        "(replica killed mid-trace) instead of the "
+                        "throughput workloads")
+    p.add_argument("--avail-requests", type=int, default=24)
+    p.add_argument("--avail-deadline-s", type=float, default=15.0)
+    p.add_argument("--avail-interarrival-ms", type=float, default=30.0,
+                   help="mean of the seeded exponential inter-arrival "
+                        "trace")
+    p.add_argument("--retry-budget", type=int, default=2)
+    p.add_argument("--hedge-ms", type=float, default=250.0,
+                   help="hedge delay floor for the availability drill "
+                        "(0 disables hedging)")
     args = p.parse_args()
+
+    if args.availability:
+        return run_availability(args)
 
     import jax
     import numpy as np

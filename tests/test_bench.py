@@ -284,3 +284,39 @@ def test_serve_bench_row_carries_prefix_and_batch_stats():
     assert sp["on"]["prefill_calls"] <= math.ceil(
         sp["requests"] / sp["max_prefill_batch"])
     assert sp["off"]["prefill_calls"] == sp["requests"]
+
+
+def test_serve_bench_availability_row_schema():
+    """ISSUE 9 CI satellite: `serve_bench --availability` emits the
+    serve-side analogue of ft_bench's MTTR split — a BENCH row whose
+    detail carries availability (accepted requests completing within
+    deadline across a mid-trace replica kill), the retry success rate,
+    and the hedge win rate.  Small run on CPU."""
+    import pytest
+
+    pytest.importorskip("jax")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, str(REPO / "benches" / "serve_bench.py"),
+         "--availability", "--avail-requests", "12", "--max-new", "6",
+         "--cache-len", "256", "--avail-deadline-s", "60"],
+        env=env, capture_output=True, text=True, timeout=1200)
+    assert r.returncode == 0, f"stderr:\n{r.stderr[-2000:]}"
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "serve_availability"
+    d = rec["detail"]
+    for key in ("availability", "accepted", "rejected_at_submit",
+                "dropped", "completed_ok", "retried",
+                "retry_success_rate", "hedges", "hedge_win_rate",
+                "failovers", "kill_at_request", "killed_at_s",
+                "deadline_s", "interarrival_ms", "retry_budget",
+                "hedge_ms", "seed", "router"):
+        assert key in d, (key, sorted(d))
+    assert rec["value"] == d["availability"]
+    assert d["dropped"] == 0, "accepted requests must reach a terminal state"
+    assert d["failovers"] == 1  # the scripted mid-trace kill
+    # generous deadline on CPU: the kill must be absorbed, not paid for
+    assert d["availability"] >= 0.99
+    assert d["router"]["failed"] == 0

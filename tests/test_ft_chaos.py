@@ -194,3 +194,60 @@ def test_corrupt_ckpt_targets_a_specific_step(tmp_path):
         ChaosEvent(action="corrupt_ckpt", at_s=1.0, step=20),)), t)
     eng.tick(1.0)
     assert t.calls == [("corrupt", 20)]
+
+
+def test_serve_ops_roundtrip_and_dispatch():
+    """ISSUE 9: the serve-tier ops (kill/freeze/slow replica) ride the
+    same spec/engine machinery — `host` addresses the replica index on
+    serve targets, `delay_s` carries slow_replica's injected latency."""
+
+    class ServeRecorder(ChaosTarget):
+        def __init__(self, n=2):
+            self.n = n
+            self.calls = []
+
+        def num_hosts(self):
+            return self.n
+
+        def kill_replica(self, replica):
+            self.calls.append(("kill_replica", replica))
+
+        def freeze_replica(self, replica, duration_s):
+            self.calls.append(("freeze_replica", replica, duration_s))
+
+        def slow_replica(self, replica, delay_s, duration_s):
+            self.calls.append(("slow_replica", replica, delay_s,
+                               duration_s))
+
+    spec = ChaosSpec(events=(
+        ChaosEvent(action="kill_replica", at_s=1.0, host=0),
+        ChaosEvent(action="freeze_replica", at_s=2.0, host=1,
+                   duration_s=5.0),
+        ChaosEvent(action="slow_replica", at_s=3.0, host=0,
+                   delay_s=0.05, duration_s=4.0),
+    ), seed=7)
+    again = ChaosSpec.from_json(json.dumps(spec.to_json()))
+    assert again == spec  # roundtrip incl. delay_s
+    t = ServeRecorder()
+    eng = ChaosEngine(spec, t)
+    eng.tick(3.5)
+    assert t.calls == [("kill_replica", 0),
+                       ("freeze_replica", 1, 5.0),
+                       ("slow_replica", 0, 0.05, 4.0)]
+    assert eng.done()
+    # an unpinned victim still draws from the seeded rng
+    t1, t2 = ServeRecorder(), ServeRecorder()
+    unpinned = ChaosSpec(events=(
+        ChaosEvent(action="kill_replica", at_s=0.5),), seed=13)
+    ChaosEngine(unpinned, t1).tick(1.0)
+    ChaosEngine(ChaosSpec.from_json(unpinned.to_json()), t2).tick(1.0)
+    assert t1.calls == t2.calls
+
+
+def test_serve_ops_default_to_not_implemented():
+    base = ChaosTarget()
+    for call in (lambda: base.kill_replica(0),
+                 lambda: base.freeze_replica(0, 1.0),
+                 lambda: base.slow_replica(0, 0.1, 1.0)):
+        with pytest.raises(NotImplementedError):
+            call()

@@ -1,0 +1,176 @@
+"""Serve-tier chaos drill (ISSUE 9 acceptance): two REAL tiny-engine
+replicas behind the ReplicaRouter, a scripted ``kill_replica`` fired
+mid-trace through the deterministic chaos harness.  The survivor
+absorbs the dead replica's work; every accepted request either
+completes within its deadline or is transparently retried to a
+BIT-IDENTICAL completion (greedy decode is idempotent); zero accepted
+requests are dropped; the incident lands in ft ``events.jsonl`` with a
+flight capture from the surviving replica; measured availability is
+>= 0.99 excluding nothing — the in-process detection window is one
+step boundary."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from tpucfn.ft.chaos import ChaosEngine, ChaosEvent, ChaosSpec
+from tpucfn.obs import MetricRegistry
+from tpucfn.obs.flight import FlightRecorder, read_flight_file
+from tpucfn.serve import ReplicaRouter, Server
+from tpucfn.serve.engine import ServeEngine, demo_llama_engine
+
+DEADLINE_S = 120.0  # generous: CPU decode is slow, availability is
+                    # about delivery here, not latency
+
+
+@pytest.mark.slow
+def test_router_survives_scripted_replica_kill_bit_identical(tmp_path):
+    ft_dir = tmp_path / "ft"
+    cfg, e0 = demo_llama_engine("tiny", seed=0, max_batch=4,
+                                cache_len=128, prefill_width=2)
+    e1 = ServeEngine.from_llama(cfg, e0.params, max_batch=4,
+                                cache_len=128, prefill_width=2)
+    engines = [e0, e1]
+
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, cfg.vocab_size,
+                          rs.randint(4, 24)).tolist() for _ in range(12)]
+    max_new = 12
+
+    # Compile warmup OUTSIDE the drill: a cold prefill bucket's XLA
+    # compile is a multi-second step, and the drill's timing assumes
+    # ms-scale steps once the trace is running.
+    for eng in engines:
+        warm = Server(eng, num_blocks=128, block_size=16)
+        for b in (16, 32):
+            warm.submit([1] * (b - 2), max_new_tokens=2)
+        warm.run_until_idle()
+
+    # ---- reference: uninterrupted run over the same params (greedy ->
+    # engine- and replica-independent tokens) ------------------------------
+    ref_server = Server(e0, num_blocks=128, block_size=16)
+    ref_reqs = [ref_server.submit(p, max_new_tokens=max_new)
+                for p in prompts]
+    ref_server.run_until_idle()
+    ref_tokens = [r.result(0) for r in ref_reqs]
+
+    # ---- the drill -------------------------------------------------------
+    def factory(i: int) -> Server:
+        fl = FlightRecorder(host_id=i, role="replica")
+        return Server(engines[i], num_blocks=128, block_size=16,
+                      flight=fl)
+
+    router = ReplicaRouter(factory, 2, registry=MetricRegistry(),
+                           ft_dir=ft_dir, retry_budget=2,
+                           heartbeat_interval_s=0.05, tick_s=0.01)
+    spec = ChaosSpec(events=(
+        ChaosEvent(action="kill_replica", at_s=0.01, host=0),), seed=0)
+    chaos = ChaosEngine(spec, router)
+
+    router.start()
+    try:
+        reqs = [router.submit(p, max_new_tokens=max_new,
+                              deadline_s=DEADLINE_S) for p in prompts]
+        # mid-trace: wait until replica 0 actually holds in-flight work,
+        # then let the scripted chaos event fire (deterministic: at_s is
+        # already due at the first tick we grant it)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if (router.replicas[0].inflight > 0
+                    and router.replicas[0].server.outstanding() > 0):
+                break
+            time.sleep(0.002)
+        assert router.replicas[0].inflight > 0, \
+            "drill setup: replica 0 never took work"
+        fired = chaos.tick(elapsed_s=1.0)
+        assert [f.event.action for f in fired] == ["kill_replica"]
+        for r in reqs:
+            assert r.done.wait(DEADLINE_S + 30.0), "dropped request"
+    finally:
+        router.stop()
+
+    # ---- zero dropped accepted requests; all within deadline -------------
+    statuses = [r.status for r in reqs]
+    assert all(s == "ok" for s in statuses), statuses
+    accepted = len(reqs)
+    ok = sum(1 for r in reqs if r.status == "ok")
+    availability = ok / accepted
+    assert availability >= 0.99, availability
+
+    # ---- transparent retry, bit-identical to the uninterrupted run -------
+    retried = [r for r in reqs if r.retries > 0]
+    assert retried, "the kill must have failed over in-flight work"
+    for r, ref in zip(reqs, ref_tokens):
+        assert r.result(0) == ref, f"request {r.rid} diverged after retry"
+    snap = router.snapshot()
+    assert snap["failovers"] == 1
+    assert snap["retries"] >= len(retried)
+    assert snap["failed"] == 0 and snap["expired"] == 0
+
+    # ---- the incident is an ft incident: events + survivor flight --------
+    events = [json.loads(ln) for ln in
+              (ft_dir / "events.jsonl").read_text().splitlines()]
+    kinds = [e["kind"] for e in events]
+    assert "detect" in kinds and "flight_capture" in kinds \
+        and "recovered" in kinds
+    det = next(e for e in events if e["kind"] == "detect")
+    assert det["failures"][0] == {"host": 0, "kind": "replica_killed",
+                                  "rc": None, "step": None,
+                                  "detail": "chaos kill_replica"}
+    cap = next(e for e in events if e["kind"] == "flight_capture")
+    assert cap["hosts"] == [1]  # the SURVIVING replica's ring
+    dump = ft_dir / "flight" / "incident001-host001.jsonl"
+    assert dump.is_file()
+    header, samples, skipped = read_flight_file(dump)
+    assert header is not None and header["host"] == 1
+    assert samples, "survivor's ring must carry its serve samples"
+    rec = next(e for e in events if e["kind"] == "recovered")
+    assert rec["action"] == "replica_relaunch" and rec["host"] == 0
+
+    # ---- the relaunched replica re-admits after warmup -------------------
+    assert router.replicas[0].state(router.clock()) in ("closed",
+                                                        "half_open")
+
+
+@pytest.mark.slow
+def test_router_drain_mid_trace_zero_drops(tmp_path):
+    """Drain (instead of kill) mid-trace: queued work is handed back
+    and completes elsewhere, in-flight work finishes inside the grace,
+    nothing is dropped, outputs stay bit-identical."""
+    cfg, e0 = demo_llama_engine("tiny", seed=0, max_batch=4,
+                                cache_len=128, prefill_width=2)
+    e1 = ServeEngine.from_llama(cfg, e0.params, max_batch=4,
+                                cache_len=128, prefill_width=2)
+    engines = [e0, e1]
+
+    rs = np.random.RandomState(1)
+    prompts = [rs.randint(0, cfg.vocab_size,
+                          rs.randint(4, 24)).tolist() for _ in range(8)]
+    max_new = 8
+
+    ref_server = Server(e0, num_blocks=128, block_size=16)
+    ref_reqs = [ref_server.submit(p, max_new_tokens=max_new)
+                for p in prompts]
+    ref_server.run_until_idle()
+    ref_tokens = [r.result(0) for r in ref_reqs]
+
+    def factory(i: int) -> Server:
+        return Server(engines[i], num_blocks=128, block_size=16)
+
+    router = ReplicaRouter(factory, 2, registry=MetricRegistry(),
+                           ft_dir=tmp_path / "ft", drain_grace_s=60.0)
+    router.start()
+    try:
+        reqs = [router.submit(p, max_new_tokens=max_new,
+                              deadline_s=DEADLINE_S) for p in prompts]
+        assert router.drain(0) is True
+        for r in reqs:
+            assert r.done.wait(DEADLINE_S), "dropped during drain"
+    finally:
+        router.stop()
+    assert all(r.status == "ok" for r in reqs)
+    for r, ref in zip(reqs, ref_tokens):
+        assert r.result(0) == ref
+    assert router.snapshot()["drains"] == 1

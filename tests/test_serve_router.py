@@ -1,0 +1,811 @@
+"""ReplicaRouter (ISSUE 9 tentpole): circuit breaker, health-driven
+failover, deadline-budgeted retry, hedging with loser cancellation,
+graceful drain redistribution, per-replica SLO shed.
+
+Most tests drive the router UNTHREADED for determinism: replica serve
+loops are pumped by hand (``run_until_idle``) and completion callbacks
+fire inline, so every interleaving is scripted."""
+
+import json
+import time
+
+import pytest
+
+from tpucfn.obs import MetricRegistry
+from tpucfn.serve import (
+    AdmissionError,
+    ReplicaFailed,
+    ReplicaRouter,
+    Server,
+)
+from tpucfn.serve.router import REPLICA_STATE_CODES, CircuitBreaker
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakeEngine:
+    """Deterministic greedy-ish tokens: prefill = f(prefix), decode =
+    f(prev token) — identical on every replica, so a retried request's
+    output is bit-identical to the uninterrupted run (the greedy-decode
+    idempotence the router's transparency rests on)."""
+
+    def __init__(self, max_batch=4, cache_len=64, fail=False, delay=0.0):
+        self.max_batch = max_batch
+        self.cache_len = cache_len
+        self.fail = fail
+        self.delay = delay
+
+    def prefill(self, slot, prefix, bucket, temperature=0.0):
+        if self.fail:
+            raise RuntimeError("engine boom")
+        if self.delay:
+            time.sleep(self.delay)
+        return sum(prefix) % 97
+
+    def decode(self, tokens_by_slot):
+        if self.fail:
+            raise RuntimeError("engine boom")
+        if self.delay:
+            time.sleep(self.delay)
+        return {s: (t * 7 + 1) % 97 for s, t in tokens_by_slot.items()}
+
+
+def make_router(n=2, engines=None, clock=None, **kw):
+    engines = engines or [FakeEngine() for _ in range(n)]
+
+    def factory(i):
+        return Server(engines[i], num_blocks=64, block_size=8)
+
+    kw.setdefault("registry", MetricRegistry())
+    if clock is not None:
+        kw["clock"] = clock
+    return ReplicaRouter(factory, n, **kw)
+
+
+def pump(router, i):
+    """Run replica i's serve loop to idle (swallowing the injected-kill
+    re-raise, which unthreaded tests trigger on purpose)."""
+    try:
+        router.replicas[i].server.run_until_idle()
+    except ReplicaFailed:
+        pass
+
+
+def pump_all(router):
+    for i in range(len(router.replicas)):
+        if router.replicas[i].server.failed is None:
+            pump(router, i)
+
+
+# ---- circuit breaker (pure, fake now) -------------------------------------
+
+def test_breaker_trips_after_threshold_consecutive_failures():
+    b = CircuitBreaker(threshold=3, cooldown_s=5.0)
+    assert b.can_route(0.0)
+    b.record_failure(0.0)
+    b.record_failure(0.1)
+    assert b.can_route(0.2)  # two failures: still closed
+    b.record_failure(0.2)
+    assert b.state(0.3) == "open"
+    assert not b.can_route(0.3)
+    # a success between failures resets the consecutive count
+    b2 = CircuitBreaker(threshold=3, cooldown_s=5.0)
+    b2.record_failure(0.0)
+    b2.record_failure(0.1)
+    b2.record_success()
+    b2.record_failure(0.2)
+    b2.record_failure(0.3)
+    assert b2.state(0.4) == "closed"
+
+
+def test_breaker_half_open_probe_then_close_or_reopen():
+    b = CircuitBreaker(threshold=1, cooldown_s=5.0)
+    b.record_failure(0.0)
+    assert not b.can_route(4.9)
+    assert b.state(5.0) == "half_open"
+    assert b.can_route(5.0)
+    b.on_dispatch(5.0)
+    assert not b.can_route(5.1)  # one probe at a time
+    b.record_success()
+    assert b.state(5.2) == "closed"
+    # and the reopen path: probe failure goes straight back to open
+    b.record_failure(6.0)
+    assert b.state(11.0) == "half_open"
+    b.on_dispatch(11.0)
+    b.record_failure(11.1)
+    assert b.state(11.2) == "open"
+    assert not b.can_route(11.2)
+
+
+def test_breaker_probation_requires_one_success():
+    b = CircuitBreaker(threshold=3, cooldown_s=5.0)
+    b.probation()
+    assert b.state(0.0) == "half_open"
+    assert b.can_route(0.0)
+    b.on_dispatch(0.0)
+    b.record_success()
+    assert b.state(0.1) == "closed"
+
+
+# ---- failover + retry ------------------------------------------------------
+
+def test_failover_retried_outputs_bit_identical():
+    """Kill a replica with queued work: the survivors' outputs for the
+    retried requests must equal the uninterrupted reference run."""
+    prompts = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [10]]
+    # reference: one healthy replica serves everything
+    ref_router = make_router(n=1)
+    ref = [ref_router.submit(p, max_new_tokens=4) for p in prompts]
+    pump(ref_router, 0)
+    ref_tokens = [r.result(0) for r in ref]
+
+    router = make_router(n=2)
+    reqs = [router.submit(p, max_new_tokens=4) for p in prompts]
+    # least-loaded routing spread them 2/2
+    assert {a.replica for r in reqs for a in r.attempts} == {0, 1}
+    router.kill_replica(0)  # unthreaded: fails + retries inline
+    pump_all(router)
+    assert [r.result(0) for r in reqs] == ref_tokens
+    assert all(r.status == "ok" for r in reqs)
+    retried = [r for r in reqs if r.retries > 0]
+    assert len(retried) == 2  # replica 0's share failed over
+    assert router.retries_c.value == 2
+    assert router.failovers_c.value == 1
+    # transparently retried: zero dropped, zero user-visible failures
+    assert router.failed_c.value == 0
+
+
+def test_retry_budget_never_exceeds_original_deadline():
+    """The deadline budget handed to each attempt is the REMAINING
+    time — attempt budgets strictly shrink and never exceed the
+    original deadline (fake clock pins the arithmetic)."""
+    clk = FakeClock(100.0)
+    engines = [FakeEngine(fail=True) for _ in range(3)]
+    router = make_router(n=3, engines=engines, clock=clk, retry_budget=2)
+    req = router.submit([1, 2, 3], max_new_tokens=4, deadline_s=10.0)
+    assert [a.budget_s for a in req.attempts] == [10.0]
+    first = req.attempts[0].replica
+    clk.advance(3.0)
+    pump(router, first)  # engine raises -> ReplicaFailed -> retry
+    assert len(req.attempts) == 2
+    assert req.attempts[1].budget_s == pytest.approx(7.0)
+    second = req.attempts[1].replica
+    assert second != first
+    clk.advance(4.0)
+    pump(router, second)
+    assert len(req.attempts) == 3
+    assert req.attempts[2].budget_s == pytest.approx(3.0)
+    budgets = [a.budget_s for a in req.attempts]
+    assert budgets == sorted(budgets, reverse=True)
+    assert all(b <= 10.0 for b in budgets)
+    # third failure: retry budget (2) spent -> terminal replica_failed
+    pump(router, req.attempts[2].replica)
+    assert req.status == "replica_failed"
+    assert isinstance(req.error, ReplicaFailed)
+    assert router.retries_c.value == 2
+    assert router.failed_c.value == 1
+
+
+def test_retry_stops_when_deadline_already_spent():
+    clk = FakeClock()
+    engines = [FakeEngine(fail=True), FakeEngine()]
+    router = make_router(n=2, engines=engines, clock=clk, retry_budget=5)
+    req = router.submit([1, 2], max_new_tokens=2, deadline_s=5.0)
+    first = req.attempts[0].replica
+    clk.advance(6.0)  # budget gone before the failure lands
+    pump(router, first)
+    assert req.status == "expired"
+    assert len(req.attempts) == 1  # no doomed resubmission
+    assert router.expired_c.value == 1
+
+
+def test_no_routable_replica_rejects_503_at_submit():
+    router = make_router(n=2)
+    router.kill_replica(0)
+    router.kill_replica(1)
+    # auto-relaunch put both back in rotation; kill with relaunch off
+    router.auto_relaunch = False
+    router.kill_replica(0)
+    router.kill_replica(1)
+    with pytest.raises(AdmissionError) as e:
+        router.submit([1, 2], max_new_tokens=2)
+    assert e.value.status == 503
+
+
+def test_invalid_request_rejected_400_everywhere():
+    router = make_router(n=2)
+    with pytest.raises(AdmissionError) as e:
+        router.submit([], max_new_tokens=2)
+    assert e.value.status == 400
+
+
+# ---- hedging ---------------------------------------------------------------
+
+def test_hedge_fires_after_delay_cancels_loser_delivers_once():
+    clk = FakeClock()
+    router = make_router(n=2, clock=clk, hedge_ms=100.0)
+    req = router.submit([3, 1, 4], max_new_tokens=3, deadline_s=60.0)
+    assert req.hedge_at == pytest.approx(0.1)
+    assert router._fire_due_hedges(0.05) == 0  # not due yet
+    clk.advance(0.2)
+    assert router._fire_due_hedges() == 1
+    assert router.hedges_c.value == 1
+    assert len(req.attempts) == 2
+    assert {a.replica for a in req.attempts} == {0, 1}
+    hedge = next(a for a in req.attempts if a.hedge)
+    primary = next(a for a in req.attempts if not a.hedge)
+    # the hedge's replica finishes first -> it wins, loser is cancelled
+    pump(router, hedge.replica)
+    assert req.status == "ok" and req.done.is_set()
+    assert router.hedges_won_c.value == 1
+    pump(router, primary.replica)  # processes the loser's cancel
+    assert primary.sreq.status == "cancelled"
+    # exactly-once: the loser completing cannot re-deliver or mutate
+    assert req.tokens == hedge.sreq.tokens
+    assert router.completed_c.value == 1
+
+
+def test_hedge_loser_completion_after_winner_is_ignored():
+    """Reverse race: the PRIMARY wins while the hedge is still queued;
+    the hedge's later completion (even ok) must not double-deliver."""
+    clk = FakeClock()
+    router = make_router(n=2, clock=clk, hedge_ms=50.0)
+    req = router.submit([9, 9], max_new_tokens=2, deadline_s=60.0)
+    clk.advance(0.1)
+    router._fire_due_hedges()
+    primary = next(a for a in req.attempts if not a.hedge)
+    hedge = next(a for a in req.attempts if a.hedge)
+    pump(router, primary.replica)
+    assert req.status == "ok"
+    winner_tokens = list(req.tokens)
+    pump(router, hedge.replica)
+    assert req.tokens == winner_tokens
+    assert router.hedges_won_c.value == 0
+    assert router.completed_c.value == 1
+    assert hedge.sreq.status in ("cancelled", "ok")
+
+
+def test_hedge_delay_uses_p99_with_floor():
+    clk = FakeClock()
+    router = make_router(n=2, clock=clk, hedge_ms=100.0,
+                        hedge_min_samples=5)
+    assert router._hedge_delay_s() == pytest.approx(0.1)  # cold: floor
+    for v in (0.2, 0.3, 0.4, 0.5, 0.6):
+        router._latency.observe(v)
+    assert router._hedge_delay_s() == pytest.approx(0.6)  # p99 > floor
+    router2 = make_router(n=2, clock=clk, hedge_ms=1000.0,
+                         hedge_min_samples=2)
+    router2._latency.observe(0.01)
+    router2._latency.observe(0.02)
+    assert router2._hedge_delay_s() == pytest.approx(1.0)  # floor wins
+
+
+def test_no_hedge_with_single_replica():
+    router = make_router(n=1, hedge_ms=10.0)
+    req = router.submit([1], max_new_tokens=1, deadline_s=60.0)
+    assert req.hedge_at is None
+
+
+# ---- drain -----------------------------------------------------------------
+
+def test_drain_redistributes_queue_to_healthy_replicas():
+    router = make_router(n=2, drain_grace_s=30.0)
+    prompts = [[i, i + 1, i + 2] for i in range(6)]
+    reqs = [router.submit(p, max_new_tokens=3) for p in prompts]
+    on_zero = [r for r in reqs if r.attempts[0].replica == 0]
+    assert on_zero  # routing spread some work onto replica 0
+    assert router.drain(0) is True
+    # every request replica 0 held was handed back and resubmitted
+    for r in on_zero:
+        assert r.attempts[0].sreq.status == "retried"
+        assert r.attempts[-1].replica == 1
+    pump(router, 1)
+    assert all(r.status == "ok" for r in reqs)
+    assert router.replicas[0].state(router.clock()) == "stopped"
+    # a drained replica takes no new traffic...
+    req = router.submit([42], max_new_tokens=1)
+    assert req.attempts[0].replica == 1
+    # ...until relaunched
+    router.relaunch(0, probation=False)
+    assert router.replicas[0].state(router.clock()) == "closed"
+    assert router.drains_c.value == 1
+
+
+def test_drain_lets_inflight_finish_on_the_draining_replica():
+    router = make_router(n=2)
+    req = router.submit([5, 5, 5], max_new_tokens=4)
+    idx = req.attempts[0].replica
+    srv = router.replicas[idx].server
+    srv.step()  # prefill: the sequence is now RUNNING, not queued
+    assert router.drain(idx) is True
+    assert req.status == "ok"  # finished on the draining replica
+    assert req.retries == 0
+
+
+# ---- health-driven incident flow ------------------------------------------
+
+def test_health_check_turns_dead_serve_loop_into_incident(tmp_path):
+    ft = tmp_path / "ft"
+    engines = [FakeEngine() for _ in range(2)]
+
+    from tpucfn.obs.flight import FlightRecorder
+
+    def factory(i):
+        fl = FlightRecorder(host_id=i, role="replica")
+        fl.record("serve", queue=0, running=0, occupancy=0.0)
+        return Server(engines[i], num_blocks=64, block_size=8, flight=fl)
+
+    router = ReplicaRouter(factory, 2, registry=MetricRegistry(), ft_dir=ft)
+    req = router.submit([1, 2, 3], max_new_tokens=2)
+    idx = req.attempts[0].replica
+    # the replica's engine dies organically (not via chaos)
+    router.replicas[idx].server.fail(ReplicaFailed("organic death"))
+    router._check_health()
+    # incident: detect + flight capture from the survivor + relaunch
+    events = [json.loads(ln) for ln in
+              (ft / "events.jsonl").read_text().splitlines()]
+    kinds = [e["kind"] for e in events]
+    assert "detect" in kinds and "recovered" in kinds
+    assert "flight_capture" in kinds
+    cap = next(e for e in events if e["kind"] == "flight_capture")
+    assert cap["hosts"] == [1 - idx]
+    assert (ft / "flight" /
+            f"incident001-host{1 - idx:03d}.jsonl").is_file()
+    assert router.failovers_c.value == 1
+    # the in-flight request failed over and completes on the survivor
+    pump_all(router)
+    assert req.status == "ok" and req.retries == 1
+    # relaunched replica is in probation until its first success
+    assert router.replicas[idx].state(router.clock()) == "half_open"
+
+
+def test_frozen_replica_flagged_dead_by_heartbeat_classifier(tmp_path):
+    """End-to-end freeze: the serve loop stops beating, the ft
+    classifier reads DEAD, the router fails over and relaunches.
+    Real threads + real (small) intervals."""
+    ft = tmp_path / "ft"
+    engines = [FakeEngine() for _ in range(2)]
+
+    def factory(i):
+        return Server(engines[i], num_blocks=64, block_size=8)
+
+    router = ReplicaRouter(factory, 2, registry=MetricRegistry(),
+                           ft_dir=ft, heartbeat_interval_s=0.05,
+                           tick_s=0.01)
+    # shrink the startup grace so the test stays fast
+    router.monitor.config = type(router.monitor.config)(
+        interval_s=0.05, startup_grace_s=0.5)
+    router.start()
+    try:
+        ok = router.submit([1, 2], max_new_tokens=2, deadline_s=10.0)
+        assert ok.done.wait(5.0) and ok.status == "ok"
+        router.freeze_replica(0, 60.0)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not router.failovers_c.value:
+            time.sleep(0.02)
+        assert router.failovers_c.value >= 1, \
+            "frozen replica never became an incident"
+        events = [json.loads(ln) for ln in
+                  (ft / "events.jsonl").read_text().splitlines()]
+        det = next(e for e in events if e["kind"] == "detect")
+        assert det["failures"][0]["kind"] == "replica_hang"
+        # and the tier keeps serving
+        ok2 = router.submit([3, 4], max_new_tokens=2, deadline_s=10.0)
+        assert ok2.done.wait(5.0) and ok2.status == "ok"
+    finally:
+        router.stop()
+
+
+# ---- per-replica SLO shed --------------------------------------------------
+
+def burn(server, n=10):
+    for _ in range(n):
+        server.slo.record(9.9, 9.9)  # violates any sane target
+
+
+def test_shed_moves_per_replica_then_429_when_all_burn():
+    engines = [FakeEngine() for _ in range(2)]
+
+    def factory(i):
+        return Server(engines[i], num_blocks=64, block_size=8,
+                      ttft_slo_s=1e-6, tpot_slo_s=1e-6)
+
+    router = ReplicaRouter(factory, 2, registry=MetricRegistry(),
+                           slo_shed=True)
+    burn(router.replicas[0].server)
+    assert router.replicas[0].server.slo.should_shed(8)
+    # fresh traffic routes AWAY from the burning replica
+    for _ in range(3):
+        req = router.submit([1, 2], max_new_tokens=1)
+        assert req.attempts[0].replica == 1
+    # all replicas burning -> the router itself sheds with 429
+    burn(router.replicas[1].server)
+    with pytest.raises(AdmissionError) as e:
+        router.submit([1, 2], max_new_tokens=1)
+    assert e.value.status == 429
+    assert router.sheds_c.value == 1
+    # retries may still use a burning replica (finish accepted work)
+    pump_all(router)
+
+
+def test_shed_off_by_default():
+    engines = [FakeEngine() for _ in range(2)]
+
+    def factory(i):
+        return Server(engines[i], num_blocks=64, block_size=8,
+                      ttft_slo_s=1e-6, tpot_slo_s=1e-6)
+
+    router = ReplicaRouter(factory, 2, registry=MetricRegistry())
+    burn(router.replicas[0].server)
+    burn(router.replicas[1].server)
+    router.submit([1, 2], max_new_tokens=1)  # no shed
+    assert router.sheds_c.value == 0
+
+
+# ---- observability ---------------------------------------------------------
+
+def test_replica_state_gauges_exported():
+    reg = MetricRegistry()
+    router = make_router(n=2, registry=reg)
+    m = reg.varz()["metrics"]
+    assert m["router_replica_state_0"] == REPLICA_STATE_CODES["closed"]
+    router.auto_relaunch = False
+    router.kill_replica(1)
+    m = reg.varz()["metrics"]
+    assert m["router_replica_state_1"] == REPLICA_STATE_CODES["dead"]
+    for name in ("router_retries_total", "router_hedges_total",
+                 "router_hedges_won_total", "router_failovers_total",
+                 "router_sheds_total"):
+        assert name in m, name
+
+
+def test_snapshot_shape():
+    router = make_router(n=2)
+    req = router.submit([1, 2], max_new_tokens=1)
+    pump_all(router)
+    assert req.status == "ok"
+    snap = router.snapshot()
+    for key in ("replicas", "requests", "completed", "retries", "hedges",
+                "hedges_won", "failovers", "sheds", "drains", "expired",
+                "failed", "latency_s"):
+        assert key in snap, key
+    assert snap["replicas"][0]["state"] == "closed"
+    assert snap["requests"] == 1.0 and snap["completed"] == 1.0
+
+
+# ---- review-pass pins (ISSUE 9 review findings) ---------------------------
+
+def test_hedge_loser_cancel_targets_its_own_incarnation():
+    """After a relaunch the slot's current server restarts req ids at
+    0, so cancelling a loser by id on the CURRENT server would hit an
+    unrelated request — the cancel must go to the attempt's own
+    incarnation (review pin)."""
+    clk = FakeClock()
+    router = make_router(n=2, clock=clk, hedge_ms=50.0)
+    req = router.submit([1, 2, 3], max_new_tokens=2, deadline_s=60.0)
+    clk.advance(0.1)
+    router._fire_due_hedges()
+    hedge = next(a for a in req.attempts if a.hedge)
+    primary = next(a for a in req.attempts if not a.hedge)
+    old_server = primary.server
+    # the primary's replica is relaunched while both attempts are live
+    router.relaunch(primary.replica, probation=False)
+    victim = router.replicas[primary.replica].server.submit(
+        [9], max_new_tokens=1)  # fresh incarnation: req_id 0 again
+    assert victim.req_id == primary.sreq.req_id  # the collision is real
+    pump(router, hedge.replica)  # hedge wins -> loser cancelled
+    assert req.status == "ok"
+    # the cancel went to the OLD server, not the fresh one's victim
+    assert primary.sreq.req_id in old_server._cancel_req
+    assert victim.req_id not in \
+        router.replicas[primary.replica].server._cancel_req
+
+
+def test_admission_rejected_probe_releases_the_breaker_slot():
+    """A half-open probe whose dispatch is refused at admission never
+    ran: the probe slot must be released or the replica stays out of
+    rotation forever (review pin)."""
+    b = CircuitBreaker(threshold=1, cooldown_s=1.0)
+    b.record_failure(0.0)
+    assert b.state(2.0) == "half_open"
+    b.on_dispatch(2.0)
+    assert not b.can_route(2.1)
+    b.abort_probe()
+    assert b.can_route(2.2)  # the next probe can still happen
+    # router-level: probation replica whose submit 429s (queue full)
+    engines = [FakeEngine() for _ in range(2)]
+
+    def factory(i):
+        # replica 0 can hold almost nothing: its probe dispatch 429s
+        return Server(engines[i], num_blocks=64, block_size=8,
+                      max_queued_tokens=4 if i == 0 else 1 << 16)
+
+    router = ReplicaRouter(factory, 2, registry=MetricRegistry())
+    router.replicas[0].breaker.probation()
+    req = router.submit([1, 2, 3, 4], max_new_tokens=4)  # needs 8 tokens
+    assert req.attempts[0].replica == 1  # fell through to the healthy one
+    pump_all(router)
+    assert req.status == "ok"
+    # the breaker is not wedged: replica 0 still offers its probe
+    assert router.replicas[0].breaker.can_route(router.clock())
+
+
+def test_drain_requeue_does_not_consume_retry_budget():
+    """--retry-budget 0 must still hand a drained replica's queue to
+    the survivors: a requeue is a handoff, not a failure (review pin)."""
+    router = make_router(n=2, retry_budget=0)
+    reqs = [router.submit([i, i + 1], max_new_tokens=2) for i in range(4)]
+    on_zero = [r for r in reqs if r.attempts[0].replica == 0]
+    assert on_zero
+    assert router.drain(0) is True
+    pump(router, 1)
+    assert all(r.status == "ok" for r in reqs)
+    # ...while a real replica failure at budget 0 stays terminal
+    router2 = make_router(n=2, retry_budget=0)
+    req = router2.submit([1, 2], max_new_tokens=2)
+    router2.auto_relaunch = False
+    router2.kill_replica(req.attempts[0].replica)
+    assert req.status == "replica_failed"
+    assert len(req.attempts) == 1
+
+
+def test_drain_all_closes_admission_and_never_relaunches():
+    """The SIGTERM path: every replica drains, auto-relaunch is off —
+    the health sweep must not resurrect replicas and keep decoding
+    past the preemption (review pin)."""
+    router = make_router(n=2)
+    reqs = [router.submit([i, i + 1], max_new_tokens=2) for i in range(4)]
+    router.drain_all(wait=True)
+    assert all(r.status == "ok" for r in reqs)  # accepted work finished
+    assert router.auto_relaunch is False
+    with pytest.raises(AdmissionError) as e:
+        router.submit([9], max_new_tokens=1)
+    assert e.value.status == 503
+    router._check_health()  # a sweep after drain must not relaunch
+    assert router.failovers_c.value == 0
+    now = router.clock()
+    assert all(rep.state(now) == "stopped" for rep in router.replicas)
+
+
+def test_submit_rechecks_failure_inside_the_enqueue_lock():
+    """fail() landing between submit's fast-path gate and the enqueue
+    must not strand a request in a queue nobody will ever drain
+    (review pin: the re-check lives in the enqueue lock acquisition)."""
+    server = Server(FakeEngine(), num_blocks=64, block_size=8)
+    server.fail(ReplicaFailed("dead"))
+    with pytest.raises(AdmissionError) as e:
+        server.submit([1, 2], max_new_tokens=1)
+    assert e.value.status == 503
+    with server._lock:
+        assert not server._incoming  # nothing was enqueued post-failure
+
+
+def test_state_display_never_mutates_the_breaker():
+    """Gauges/snapshots run on scrape threads OUTSIDE the router lock:
+    the display path must be read-only, or a scrape racing the routing
+    path could clear a live half-open probe slot (review pin)."""
+    b = CircuitBreaker(threshold=1, cooldown_s=1.0)
+    b.record_failure(0.0)
+    # cooldown elapsed: peek REPORTS half_open but does not transition
+    assert b.peek(2.0) == "half_open"
+    assert b._state == "open" and not b._probe_inflight
+    # the locked routing path transitions and takes the probe slot...
+    assert b.can_route(2.0)
+    b.on_dispatch(2.0)
+    assert b._probe_inflight
+    # ...and a concurrent scrape must not clear it
+    assert b.peek(2.1) == "half_open"
+    assert b._probe_inflight
+    router = make_router(n=1)
+    reg = router.registry
+    reg.varz()  # a scrape evaluates the computed state gauges
+    assert router.replicas[0].breaker._state == "closed"
+
+
+def test_relaunch_refused_when_old_thread_wont_die():
+    """A wedged serve thread outliving the join bound must NOT get a
+    second loop started on its engine — the slot stays dead at N-1
+    instead of corrupting the shared cache (review pin)."""
+    router = make_router(n=2)
+    victim = router.replicas[0]
+    victim.server.wait_stopped = lambda timeout=None: False  # wedged
+    router.kill_replica(0)
+    assert victim.dead
+    assert router.failovers_c.value == 0  # no recovered event either
+    # the tier keeps serving on the survivor
+    req = router.submit([1, 2], max_new_tokens=2)
+    assert req.attempts[0].replica == 1
+    pump(router, 1)
+    assert req.status == "ok"
+
+
+def test_all_replicas_backpressured_surfaces_429_not_503():
+    """Every replica rejecting 429 (queue full) is backpressure — the
+    router must propagate 429 (back off), not the 503 that means
+    'unavailable, go elsewhere' (review pin)."""
+    engines = [FakeEngine() for _ in range(2)]
+
+    def factory(i):
+        return Server(engines[i], num_blocks=64, block_size=8,
+                      max_queued_tokens=4)
+
+    router = ReplicaRouter(factory, 2, registry=MetricRegistry())
+    with pytest.raises(AdmissionError) as e:
+        router.submit([1, 2, 3, 4], max_new_tokens=8)  # needs 12 > 4
+    assert e.value.status == 429
+    assert "queue full" in str(e.value)
+
+
+def test_mid_flight_rejection_lands_in_a_terminal_counter():
+    """requests == completed + expired + failed + rejected must hold:
+    a deferred 400 delivery is terminal and counted (review pin)."""
+    from tpucfn.serve.router import RouterRequest
+
+    router = make_router(n=2)
+    rreq = RouterRequest(0, [1], 1, 0.0, None, 0.0)
+    with router._lock:
+        rreq.rid = router._next_id
+        router._next_id += 1
+        router._live[rreq.rid] = rreq
+    router._deliver(rreq, error=AdmissionError("late 400", status=400),
+                    status="rejected")
+    assert router.rejected_c.value == 1
+    assert router.snapshot()["rejected"] == 1.0
+
+
+def test_probe_released_when_attempt_expires_or_cancels():
+    """A half-open probe whose attempt ends expired/cancelled carries
+    no health signal — the probe slot must be released or the breaker
+    is unroutable forever (review pin)."""
+    # expired probe (replica deadlines run on real time)
+    router = make_router(n=2)
+    rep0 = router.replicas[0]
+    rep0.breaker.probation()
+    req = router.submit([1, 2], max_new_tokens=2, deadline_s=0.01)
+    assert req.attempts[0].replica == 0  # the probe
+    assert not rep0.breaker.can_route(router.clock())  # slot taken
+    time.sleep(0.03)  # deadline passes before the probe runs
+    pump(router, 0)   # serve loop expires it -> callback
+    assert req.status == "expired"
+    assert rep0.breaker.can_route(router.clock()), \
+        "expired probe must release the slot"
+    # cancelled probe
+    router2 = make_router(n=2)
+    rep0 = router2.replicas[0]
+    rep0.breaker.probation()
+    req2 = router2.submit([1, 2], max_new_tokens=2, deadline_s=60.0)
+    assert not rep0.breaker.can_route(router2.clock())
+    rep0.server.cancel(req2.attempts[0].sreq.req_id)
+    pump(router2, 0)
+    assert req2.attempts[0].sreq.status == "cancelled"
+    assert rep0.breaker.can_route(router2.clock()), \
+        "cancelled probe must release the slot"
+
+
+def test_router_expiry_sweep_backstops_a_wedged_replica():
+    """The replica's own loop is the expiry enforcer — unless it is
+    wedged inside a step; then the router's sweep must terminate the
+    request so result() cannot hang forever (review pin)."""
+    clk = FakeClock()
+    router = make_router(n=1, clock=clk)
+    req = router.submit([1, 2], max_new_tokens=2, deadline_s=5.0)
+    # the replica never pumps (wedged); sweep before deadline: nothing
+    assert router._expire_overdue(4.0) == 0
+    # after deadline but inside the slack: the replica gets first crack
+    assert router._expire_overdue(5.5) == 0
+    clk.t = 7.0
+    assert router._expire_overdue() == 1
+    assert req.status == "expired" and req.done.is_set()
+    assert router.expired_c.value == 1
+
+
+def test_orphaned_hedge_submitted_after_delivery_is_cancelled():
+    """If the primary wins WHILE the hedge's Server.submit is still in
+    flight, the loser sweep misses it (sreq still None) — the dispatch
+    path must cancel it right after submit returns (review pin)."""
+    clk = FakeClock()
+    router = make_router(n=2, clock=clk, hedge_ms=50.0)
+    req = router.submit([2, 2], max_new_tokens=2, deadline_s=60.0)
+    primary = req.attempts[0]
+    target = router.replicas[1 - primary.replica].server
+    real_submit = target.submit
+
+    def submit_racing_delivery(*a, **kw):
+        sreq = real_submit(*a, **kw)
+        # the primary completes before _dispatch records att.sreq
+        pump(router, primary.replica)
+        assert req.status == "ok"
+        return sreq
+
+    target.submit = submit_racing_delivery
+    clk.advance(0.1)
+    router._fire_due_hedges()
+    hedge = next(a for a in req.attempts if a.hedge)
+    assert hedge.sreq.req_id in target._cancel_req, \
+        "orphaned hedge must be cancelled after the fact"
+    target.submit = real_submit
+    pump_all(router)
+    assert router.completed_c.value == 1  # delivered exactly once
+
+
+def test_replica_tracer_namespaces_ids_and_tags_replica():
+    from tpucfn.serve.router import ReplicaTracer
+
+    class Rec:
+        enabled = True
+
+        def __init__(self):
+            self.calls = []
+
+        def event(self, kind, **kw):
+            self.calls.append(("event", kind, kw))
+
+        def record(self, name, **kw):
+            self.calls.append(("record", name, kw))
+
+    rec = Rec()
+    t = ReplicaTracer(rec, 1)
+    assert t.enabled
+    t.event("request_done", trace_id=5, outcome="ok")
+    t.record("prefill", start=0.0, end=1.0, trace_id=5)
+    for _, _, kw in rec.calls:
+        assert kw["trace_id"] == 1_000_000_000 + 5
+        assert kw["replica"] == 1
+    t.event("preemption", count=2)  # no trace_id: passes through
+    assert rec.calls[-1][2]["count"] == 2
+
+
+def test_wedged_replica_orphans_are_completed_router_side():
+    """A loop wedged INSIDE an engine call never consumes fail()'s
+    injection, so its callbacks never fire — the router must complete
+    those attempts itself (retry elsewhere) or callers hang forever
+    (review pin)."""
+    router = make_router(n=2)
+    req = router.submit([1, 2, 3], max_new_tokens=3, deadline_s=60.0)
+    idx = req.attempts[0].replica
+    wedged = router.replicas[idx].server
+    wedged.wait_stopped = lambda timeout=None: False  # won't die
+    wedged.fail = lambda exc=None: None               # never consumed
+    router.kill_replica(idx)
+    # the orphan sweep retried it onto the survivor
+    assert len(req.attempts) == 2
+    assert req.attempts[1].replica == 1 - idx
+    pump(router, 1 - idx)
+    assert req.status == "ok" and req.retries == 1
+    # the wedged incarnation reviving later must not double-handle
+    router._fail_orphan_attempts(idx, wedged, "replica_killed")
+    assert req.status == "ok" and len(req.attempts) == 2
+
+
+def test_hedge_counter_not_bumped_when_dispatch_only_expired():
+    """_fire_due_hedges on a request whose deadline already passed
+    delivers expired without submitting a duplicate — that is not a
+    hedge and must not enter the win-rate denominator (review pin)."""
+    clk = FakeClock()
+    router = make_router(n=2, clock=clk, hedge_ms=50.0)
+    req = router.submit([1, 2], max_new_tokens=2, deadline_s=1.0)
+    clk.advance(2.0)  # hedge due AND deadline spent
+    assert router._fire_due_hedges() == 0
+    assert router.hedges_c.value == 0
+    assert req.status == "expired"
+
+
+def test_router_latency_summary_is_on_the_registry():
+    reg = MetricRegistry()
+    router = make_router(n=2, registry=reg)
+    req = router.submit([1, 2], max_new_tokens=2)
+    pump_all(router)
+    assert req.status == "ok"
+    m = reg.varz()["metrics"]
+    assert "router_request_latency_seconds" in m
+    text = reg.to_prometheus()
+    assert "router_request_latency_seconds_count 1" in text

@@ -321,13 +321,19 @@ def cmd_serve(args) -> int:
     prompts (``--prompts`` JSONL with {"tokens": [...]} rows, or
     ``--synthetic N`` random prompts) and print the serving metrics
     snapshot as one JSON line.  Net-new vs the reference (training-only
-    harness); the serving counterpart of ``launch``."""
+    harness); the serving counterpart of ``launch``.
+
+    ``--replicas N`` (ISSUE 9) runs N engine replicas behind a
+    :class:`~tpucfn.serve.router.ReplicaRouter` — health-driven
+    failover, deadline-budgeted retry (``--retry-budget``), optional
+    hedging (``--hedge-ms``), graceful drain on SIGTERM."""
     import json as _json
+    import signal as _signal
 
     import numpy as np
 
     from tpucfn.serve import AdmissionError, Server
-    from tpucfn.serve.engine import demo_llama_engine
+    from tpucfn.serve.engine import ServeEngine, demo_llama_engine
 
     cfg, engine = demo_llama_engine(args.preset, seed=args.seed,
                                     max_batch=args.max_batch,
@@ -375,7 +381,8 @@ def cmd_serve(args) -> int:
     if args.trace_dir:
         artifacts_root = Path(args.trace_dir).resolve().parent
         flight.install_dump_handlers(artifacts_root / "flight")
-    tracer = obs_srv = None
+    tracer = obs_srv = hb = server = router = None
+    reqs = []
     try:
         # Inside the try from the first resource on: a failed port bind
         # must not leak the tracer it was preceded by (and the tracer
@@ -395,26 +402,127 @@ def cmd_serve(args) -> int:
                                    flight=flight, profiler=profiler)
         if obs_srv is not None:
             print(f"obs endpoint: {obs_srv.url()}", file=sys.stderr)
-        server = Server(engine, num_blocks=args.num_blocks,
-                        block_size=args.block_size,
-                        max_queued_tokens=args.max_queued_tokens,
-                        registry=registry, tracer=tracer,
-                        prefix_cache=args.prefix_cache,
-                        max_prefill_batch=args.max_prefill_batch,
-                        ttft_slo_s=args.slo_ttft, tpot_slo_s=args.slo_tpot,
-                        slo_objective=args.slo_objective,
-                        slo_shed=args.slo_shed,
-                        flight=flight)
-        reqs = []
+        # Gang supervision (ISSUE 9): under the `tpucfn launch --ft`
+        # fan-out a serve host writes heartbeats like any trainer rank —
+        # a dead serve host becomes an ft incident with flight capture
+        # and relaunch through the existing GangCoordinator.
+        hb = None
+        ft_dir = os.environ.get("TPUCFN_FT_DIR", "").strip()
+        if ft_dir:
+            from tpucfn.ft.heartbeat import HeartbeatWriter
+
+            hb = HeartbeatWriter(
+                ft_dir, host_id, role="server",
+                interval_s=float(
+                    os.environ.get("TPUCFN_FT_HEARTBEAT_S", "1.0") or 1.0))
+            hb.start()
+
+        if args.replicas > 1:
+            from tpucfn.serve import ReplicaRouter
+            from tpucfn.serve.router import ReplicaTracer
+
+            engines = [engine] + [
+                ServeEngine.from_llama(cfg, engine.params,
+                                       max_batch=args.max_batch,
+                                       cache_len=args.cache_len,
+                                       prefill_width=args.max_prefill_batch)
+                for _ in range(args.replicas - 1)]
+
+            class _FlightTee:
+                """Replica samples land in the replica's OWN ring (what
+                the router captures from survivors at incident time)
+                AND, tagged with the replica index, in the host-level
+                ring `flight` — the one /flightrecorder serves and the
+                gang coordinator captures when this HOST survives an
+                incident.  Without the tee the host ring is empty in
+                router mode and survivor forensics regress (PR 6)."""
+
+                def __init__(self, replica: int):
+                    self.replica = replica
+                    self.ring = FlightRecorder(host_id=replica,
+                                               role="replica")
+
+                def record(self, kind, **fields):
+                    flight.record(kind, replica=self.replica, **fields)
+                    return self.ring.record(kind, **fields)
+
+                def snapshot(self):
+                    return self.ring.snapshot()
+
+            def _replica(i: int) -> Server:
+                # private registry + per-replica ring; the shared
+                # registry carries the router_* series instead (two
+                # replicas' serve_* counters on one registry would fuse)
+                return Server(engines[i], num_blocks=args.num_blocks,
+                              block_size=args.block_size,
+                              max_queued_tokens=args.max_queued_tokens,
+                              prefix_cache=args.prefix_cache,
+                              max_prefill_batch=args.max_prefill_batch,
+                              ttft_slo_s=args.slo_ttft,
+                              tpot_slo_s=args.slo_tpot,
+                              slo_objective=args.slo_objective,
+                              tracer=ReplicaTracer(tracer, i),
+                              flight=_FlightTee(i))
+
+            serve_ft = (Path(ft_dir) / "serve" if ft_dir
+                        else (artifacts_root / "serve-ft"
+                              if args.trace_dir else None))
+            router = ReplicaRouter(
+                _replica, args.replicas, registry=registry,
+                ft_dir=serve_ft, retry_budget=args.retry_budget,
+                hedge_ms=args.hedge_ms, slo_shed=args.slo_shed,
+                drain_grace_s=args.drain_grace)
+        else:
+            server = Server(engine, num_blocks=args.num_blocks,
+                            block_size=args.block_size,
+                            max_queued_tokens=args.max_queued_tokens,
+                            registry=registry, tracer=tracer,
+                            prefix_cache=args.prefix_cache,
+                            max_prefill_batch=args.max_prefill_batch,
+                            ttft_slo_s=args.slo_ttft,
+                            tpot_slo_s=args.slo_tpot,
+                            slo_objective=args.slo_objective,
+                            slo_shed=args.slo_shed,
+                            flight=flight)
+
+        def _on_term(signum, frame):
+            # Graceful drain (ISSUE 9 satellite): a preempted serve host
+            # finishes the decodes it accepted (bounded by the grace)
+            # instead of dropping them; admission closes immediately.
+            # wait=False: only arm the deadline — the serving loops
+            # enforce it, a signal handler must not block.  Router mode
+            # goes through drain_all so the health sweep cannot
+            # auto-relaunch drained replicas and keep decoding past the
+            # preemption.
+            if router is not None:
+                router.drain_all(args.drain_grace, wait=False)
+            else:
+                server.drain(args.drain_grace, wait=False)
+            print(f"SIGTERM: draining (grace {args.drain_grace:g}s)",
+                  file=sys.stderr)
+
+        try:
+            _signal.signal(_signal.SIGTERM, _on_term)
+        except ValueError:
+            pass  # not the main thread (embedded use): no drain hook
+
+        front = router if router is not None else server
+        if router is not None:
+            router.start()
         for p in prompts:
             try:
-                reqs.append(server.submit(
+                reqs.append(front.submit(
                     p, max_new_tokens=args.max_new,
                     temperature=args.temperature,
                     deadline_s=args.deadline_s))
             except AdmissionError as e:
                 print(f"rejected ({e.status}): {e}", file=sys.stderr)
-        server.run_until_idle()
+        if router is not None:
+            for r in reqs:
+                r.done.wait()
+            router.stop()
+        else:
+            server.run_until_idle()
     finally:
         # Same contract as cmd_launch/run_train_loop: a failing run must
         # still release the bound obs port and the open trace file.
@@ -422,12 +530,17 @@ def cmd_serve(args) -> int:
             tracer.close()
         if obs_srv is not None:
             obs_srv.close()
+        if hb is not None:
+            hb.stop()
     ok = sum(1 for r in reqs if r.error is None)
     print(f"served {ok}/{len(prompts)} requests "
           f"({len(prompts) - len(reqs)} rejected at submit)",
           file=sys.stderr)
-    print(_json.dumps({**server.metrics.snapshot(),
-                       "slo": server.slo.snapshot()}))
+    if router is not None:
+        print(_json.dumps({"router": router.snapshot()}))
+    else:
+        print(_json.dumps({**server.metrics.snapshot(),
+                           "slo": server.slo.snapshot()}))
     # Partial failure is failure: scripts wrapping this must see expired/
     # rejected requests in the exit code, not just in the JSON.
     return 0 if ok == len(prompts) else 1
@@ -1147,6 +1260,25 @@ def build_parser() -> argparse.ArgumentParser:
                     help="SLO-aware early shedding: 429 new requests while "
                          "the rolling-window burn rate is sustained above "
                          "1 (sheds counted in serve_slo_shed_total)")
+    sv.add_argument("--replicas", type=int, default=1, metavar="N",
+                    help="engine replicas behind a resilient router "
+                         "(health-driven failover, deadline-budgeted "
+                         "retry, hedging, graceful drain); 1 = classic "
+                         "single server")
+    sv.add_argument("--retry-budget", type=int, default=2, metavar="K",
+                    help="max resubmissions per request after replica "
+                         "failure (bounded by the deadline budget "
+                         "either way)")
+    sv.add_argument("--hedge-ms", type=float, default=0.0, metavar="MS",
+                    help="enable hedging: duplicate a straggling request "
+                         "to a second replica after the p99-derived "
+                         "delay, floored at MS (0 disables; first "
+                         "completion wins, the loser is cancelled)")
+    sv.add_argument("--drain-grace", type=float, default=30.0,
+                    metavar="SECONDS",
+                    help="SIGTERM drain window: admission closes and "
+                         "accepted work gets this long to finish before "
+                         "being failed/requeued")
     sv.add_argument("--seed", type=int, default=0)
     sv.add_argument("--obs-port", type=int, default=None, metavar="PORT",
                     help="serve /metrics, /healthz, /varz on PORT while the "

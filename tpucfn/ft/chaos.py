@@ -29,7 +29,10 @@ from pathlib import Path
 from typing import Any
 
 ACTIONS = ("kill", "hang", "delay_heartbeats", "corrupt_ckpt",
-           "preempt_notice", "lose_host")
+           "preempt_notice", "lose_host",
+           # serve-tier ops (ISSUE 9): fired against a ReplicaRouter —
+           # `host` addresses the replica index on serve targets
+           "kill_replica", "freeze_replica", "slow_replica")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -44,14 +47,23 @@ class ChaosEvent:
     the notice's lead seconds); ``lose_host`` kills the host AND marks
     it un-reacquirable, so the coordinator's next relaunch must shrink
     to N-1 instead of bringing it back; ``corrupt_ckpt`` with ``step``
-    set corrupts that specific step instead of the latest."""
+    set corrupts that specific step instead of the latest.
+
+    Serve-tier ops (ISSUE 9, fired against a
+    :class:`~tpucfn.serve.router.ReplicaRouter`): ``kill_replica``
+    fails the replica's serve loop (its in-flight requests complete
+    with ReplicaFailed and the router fails over); ``freeze_replica``
+    stalls the serve loop — and its heartbeats — for ``duration_s``
+    (0 = until unfrozen); ``slow_replica`` adds ``delay_s`` of latency
+    to every step for ``duration_s``."""
 
     action: str
     at_s: float | None = None
     at_step: int | None = None
     host: int | None = None
-    duration_s: float = 0.0  # hang / delay_heartbeats / preempt lead
+    duration_s: float = 0.0  # hang / delay_heartbeats / preempt lead / freeze
     step: int | None = None  # corrupt_ckpt: target step (None = latest)
+    delay_s: float = 0.0     # slow_replica: per-step injected latency
 
     def __post_init__(self):
         if self.action not in ACTIONS:
@@ -62,7 +74,8 @@ class ChaosEvent:
 
     def to_json(self) -> dict:
         return {k: v for k, v in dataclasses.asdict(self).items()
-                if v is not None and not (k == "duration_s" and v == 0.0)}
+                if v is not None
+                and not (k in ("duration_s", "delay_s") and v == 0.0)}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -118,6 +131,26 @@ class ChaosTarget:
 
     def corrupt_latest_checkpoint(self, rng: random.Random,
                                   step: int | None = None) -> None:
+        raise NotImplementedError
+
+    # -- serve-tier ops (ISSUE 9: tpucfn.serve.router.ReplicaRouter) -------
+
+    def kill_replica(self, replica: int) -> None:
+        """Fail the replica's serve loop: in-flight requests complete
+        with ReplicaFailed and the router's failover path takes over."""
+        raise NotImplementedError
+
+    def freeze_replica(self, replica: int, duration_s: float) -> None:
+        """Stall the replica's serve loop (and its loop-driven
+        heartbeats) for ``duration_s`` seconds (0 = indefinitely) —
+        the serve-side HANG class."""
+        raise NotImplementedError
+
+    def slow_replica(self, replica: int, delay_s: float,
+                     duration_s: float) -> None:
+        """Add ``delay_s`` of latency to every serve step for
+        ``duration_s`` seconds (0 = indefinitely) — the straggler
+        class, the hedge path's reason to exist."""
         raise NotImplementedError
 
 
@@ -202,6 +235,12 @@ class ChaosEngine:
                 self.target.preempt_notice(host, ev.duration_s)
             elif ev.action == "lose_host":
                 self.target.lose_host(host)
+            elif ev.action == "kill_replica":
+                self.target.kill_replica(host)
+            elif ev.action == "freeze_replica":
+                self.target.freeze_replica(host, ev.duration_s)
+            elif ev.action == "slow_replica":
+                self.target.slow_replica(host, ev.delay_s, ev.duration_s)
             elif ev.action == "corrupt_ckpt":
                 self.target.corrupt_latest_checkpoint(self.rng, step=ev.step)
             self.fired.append(rec)

@@ -11,6 +11,9 @@ The serving counterpart of the training stack (ROADMAP north star:
   slot-resident, donated cache (vmapped per-slot cache indices).
 * :mod:`tpucfn.serve.frontend` — thread-safe queue, 429/400 admission
   control, deadlines, and the obs.metrics serving dashboard.
+* :mod:`tpucfn.serve.router` — the resilient tier (ISSUE 9): N replica
+  Servers behind health-driven failover, deadline-budgeted retry,
+  hedging, and graceful drain.
 
 CLI: ``tpucfn serve`` (see ``tpucfn/cli/main.py``); bench:
 ``benches/serve_bench.py``.
@@ -19,11 +22,19 @@ CLI: ``tpucfn serve`` (see ``tpucfn/cli/main.py``); bench:
 from tpucfn.serve.engine import ServeEngine  # noqa: F401
 from tpucfn.serve.frontend import (  # noqa: F401
     AdmissionError,
+    Cancelled,
     DeadlineExceeded,
+    ReplicaFailed,
+    Requeued,
     Server,
     ServeRequest,
     ServingMetrics,
     SLOTracker,
+)
+from tpucfn.serve.router import (  # noqa: F401
+    CircuitBreaker,
+    ReplicaRouter,
+    RouterRequest,
 )
 from tpucfn.serve.kvcache import (  # noqa: F401
     AdmitResult,

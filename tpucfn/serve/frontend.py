@@ -53,7 +53,8 @@ from tpucfn.serve.scheduler import (
 class AdmissionError(RuntimeError):
     """Request refused at submit time.  ``status`` follows HTTP
     semantics: 429 = retry later (backpressure), 400 = never valid on
-    this engine."""
+    this engine, 503 = this replica is unavailable (draining or failed)
+    — retry ELSEWHERE, which is exactly what the replica router does."""
 
     def __init__(self, msg: str, *, status: int = 429):
         super().__init__(msg)
@@ -64,10 +65,37 @@ class DeadlineExceeded(RuntimeError):
     """The request's deadline passed before it finished."""
 
 
+class ReplicaFailed(RuntimeError):
+    """5xx-equivalent: the replica (engine or serve loop) died under the
+    request.  Structurally distinct from :class:`DeadlineExceeded` on
+    purpose (ISSUE 9): a router retries a replica failure on a healthy
+    replica with the remaining deadline budget, while an expired
+    deadline is terminal — nobody is waiting anymore."""
+
+
+class Requeued(ReplicaFailed):
+    """The replica handed this request back without finishing it
+    (drain / queue eviction); the router resubmits it elsewhere.  The
+    replica-level handle's terminal ``status`` is ``"retried"``."""
+
+
+class Cancelled(RuntimeError):
+    """The request was cancelled (a hedge that lost the race)."""
+
+
 class ServeRequest:
     """Caller-facing handle: block on :meth:`result` (or poll
     :attr:`done`).  Timing fields are filled by the serve loop —
-    ``t_first_token - t_submit`` is the TTFT the metrics record."""
+    ``t_first_token - t_submit`` is the TTFT the metrics record.
+
+    ``status`` is the terminal outcome, settled exactly when ``done``
+    sets (ISSUE 9 satellite): ``"ok"`` / ``"expired"`` (deadline) /
+    ``"replica_failed"`` (engine or replica death) / ``"retried"`` (the
+    replica handed it back for resubmission elsewhere) / ``"rejected"``
+    (admission) / ``"cancelled"`` (hedge loser) — so routers and tests
+    branch on structure instead of string-matching error messages.
+    ``on_done`` is an optional single-shot callback invoked after the
+    terminal state is visible (the router's completion hook)."""
 
     def __init__(self, req_id: int, prompt: list[int], max_new_tokens: int,
                  temperature: float, deadline: float | None):
@@ -78,6 +106,8 @@ class ServeRequest:
         self.deadline = deadline
         self.tokens: list[int] | None = None
         self.error: BaseException | None = None
+        self.status = "pending"
+        self.on_done = None
         self.t_submit = time.monotonic()
         self.t_first_token: float | None = None
         self.t_done: float | None = None
@@ -128,6 +158,10 @@ class ServingMetrics:
             "serve_rejected_requests_total", "requests refused (429/400)")
         self.expired = r.counter(
             "serve_expired_requests_total", "requests past their deadline")
+        self.replica_failed = r.counter(
+            "serve_replica_failed_requests_total",
+            "requests completed with a replica/engine failure "
+            "(5xx-equivalent; counted separately from deadline expiry)")
         self.preemptions = r.counter(
             "serve_preemptions_total", "KV-pressure evictions")
         self.prefill_calls = r.counter(
@@ -164,6 +198,7 @@ class ServingMetrics:
             "completed": self.completed.value,
             "rejected": self.rejected.value,
             "expired": self.expired.value,
+            "replica_failed": self.replica_failed.value,
             "preemptions": self.preemptions.value,
             "prompt_tokens": self.prompt_tokens.value,
             "generated_tokens": self.generated_tokens.value,
@@ -365,7 +400,8 @@ class Server:
                  slo_objective: float = 0.99, slo_window_s: float = 60.0,
                  slo_shed: bool = False, shed_min_window: int = 8,
                  shed_probe_every: int = 10,
-                 flight=None):
+                 flight=None, heartbeat=None,
+                 clock=time.monotonic):
         """``slo_shed`` arms SLO-aware early shedding: submit() rejects
         with 429 while the rolling-window burn rate is sustained above 1
         (``SLOTracker.should_shed``), shedding load BEFORE the SLO is
@@ -377,7 +413,14 @@ class Server:
         complete healthily decay the burn and end the shed episode as
         soon as the engine actually recovers.  ``flight`` is a
         :class:`~tpucfn.obs.flight.FlightRecorder` receiving queue
-        depth / batch occupancy / scheduler-decision samples (ISSUE 6)."""
+        depth / batch occupancy / scheduler-decision samples (ISSUE 6).
+        ``heartbeat`` is a :class:`~tpucfn.ft.heartbeat.HeartbeatWriter`
+        beaten FROM the serve loop itself (ISSUE 9): a frozen or wedged
+        loop stops beating, which is what lets the ft classifier (and
+        the replica router's health check) tell a stuck replica from an
+        idle one — a daemon-thread writer would keep beating through a
+        freeze.  ``clock`` (monotonic) is injectable for drain/freeze
+        timing tests."""
         self.engine = engine
         # Both ISSUE-3 fast paths are duck-typed off the engine so fakes
         # (and any decode-protocol engine without the batched entry
@@ -419,11 +462,46 @@ class Server:
         self._next_id = 0
         self._thread: threading.Thread | None = None
         self._stopping = False
+        # Resilience state (ISSUE 9): drain/failure/chaos, all consumed
+        # at step boundaries ON the serve thread so no second thread
+        # ever mutates the scheduler.
+        self.heartbeat = heartbeat
+        self.clock = clock
+        self._last_beat = float("-inf")
+        self._draining = False
+        self._drain_deadline: float | None = None
+        self._failed: BaseException | None = None
+        self._injected_failure: BaseException | None = None
+        self._frozen_until = 0.0
+        self._slow_until = 0.0
+        self._slow_delay = 0.0
+        self._cancel_req: set[int] = set()
+        self._evict_waiting = False
+
+    @property
+    def failed(self) -> BaseException | None:
+        """The exception that killed this replica's serve loop, or None
+        while it is healthy — the router's liveness probe."""
+        return self._failed
 
     # -- submit path (any thread) ------------------------------------------
     def submit(self, prompt: list[int], *, max_new_tokens: int,
                temperature: float = 0.0,
-               deadline_s: float | None = None) -> ServeRequest:
+               deadline_s: float | None = None,
+               on_done=None) -> ServeRequest:
+        """``on_done(req)`` — optional single-shot completion callback,
+        attached BEFORE the request is queued so a fast serve thread can
+        never complete the request in the submit/attach gap (the race
+        the router's retry path would otherwise lose)."""
+        with self._lock:
+            if self._failed is not None:
+                self.metrics.rejected.add()
+                raise AdmissionError(
+                    f"replica failed: {self._failed}", status=503)
+            if self._draining:
+                self.metrics.rejected.add()
+                raise AdmissionError(
+                    "replica draining: admission closed", status=503)
         budget = len(prompt) + max_new_tokens
         if not prompt or max_new_tokens < 1:
             self.metrics.rejected.add()
@@ -460,6 +538,20 @@ class Server:
             with self._lock:
                 self._shed_seen = 0
         with self._lock:
+            # Re-checked HERE, in the same lock acquisition that
+            # enqueues: the gate at the top is a fast path, but fail()/
+            # drain() can land between it and this block, and a request
+            # appended after _fail_all drained the queue would never be
+            # processed — its on_done would never fire and the caller
+            # would wait forever.
+            if self._failed is not None:
+                self.metrics.rejected.add()
+                raise AdmissionError(
+                    f"replica failed: {self._failed}", status=503)
+            if self._draining:
+                self.metrics.rejected.add()
+                raise AdmissionError(
+                    "replica draining: admission closed", status=503)
             if self._outstanding_tokens + budget > self.max_queued_tokens:
                 self.metrics.rejected.add()
                 raise AdmissionError(
@@ -471,6 +563,7 @@ class Server:
                 self._next_id, list(prompt), max_new_tokens, temperature,
                 None if deadline_s is None
                 else time.monotonic() + deadline_s)
+            req.on_done = on_done
             self._next_id += 1
             self._incoming.append(req)
             self._work.notify()
@@ -496,6 +589,7 @@ class Server:
         ttft = (None if req.t_first_token is None
                 else req.t_first_token - req.t_submit)
         if error is None:
+            req.status = "ok"
             self.metrics.completed.add()
             self.metrics.request_latency_s.observe(req.t_done - req.t_submit)
             self.metrics.request_latency_hist.observe(req.t_done - req.t_submit)
@@ -507,26 +601,44 @@ class Server:
                     else 0.0)
             self.slo.record(ttft, tpot)
         elif isinstance(error, DeadlineExceeded):
+            req.status = "expired"
             self.metrics.expired.add()
             # an expired request violates both objectives by definition —
             # the caller got no usable answer (None scores as violation;
             # results aren't streamed, so a mid-flight first token never
             # reached anyone).
             self.slo.record(None, None)
+        elif isinstance(error, Requeued):
+            # Handed back for resubmission elsewhere (drain): not a
+            # failure of this replica and not scored — the retry's
+            # eventual completion is what the fleet experienced.
+            req.status = "retried"
+        elif isinstance(error, ReplicaFailed):
+            # Counted separately from expiry on purpose (ISSUE 9): a
+            # dead replica is an availability event the router retries;
+            # an expired deadline is a latency event nobody can retry.
+            req.status = "replica_failed"
+            self.metrics.replica_failed.add()
+        elif isinstance(error, Cancelled):
+            req.status = "cancelled"
         else:
+            req.status = "rejected"
             self.metrics.rejected.add()
         if self.tracer.enabled:
-            outcome = ("ok" if error is None else
-                       "expired" if isinstance(error, DeadlineExceeded)
-                       else "rejected")
             self.tracer.event(
-                "request_done", trace_id=req.req_id, outcome=outcome,
+                "request_done", trace_id=req.req_id, outcome=req.status,
                 latency_s=req.t_done - req.t_submit,
                 ttft_s=(None if req.t_first_token is None
                         else req.t_first_token - req.t_submit),
                 generated=len(tokens) if tokens is not None
                 else partial_generated)
         req.done.set()
+        cb, req.on_done = req.on_done, None
+        if cb is not None:
+            try:
+                cb(req)
+            except Exception:  # noqa: BLE001 — a router-callback bug
+                pass  # must not take the serve loop down with it
 
     # -- the step function (one scheduler decision + one engine call) ------
     def _ingest(self) -> None:
@@ -550,7 +662,32 @@ class Server:
 
     def step(self) -> bool:
         """One iteration: ingest, expire deadlines, run one prefill or
-        one decode round, record results.  Returns False when idle."""
+        one decode round, record results.  Returns False when idle.
+
+        Raises :class:`ReplicaFailed` when a failure was injected
+        (:meth:`fail`) — the driving loops route that through
+        :meth:`_fail_all` so every in-flight request completes with a
+        structured error instead of hanging forever."""
+        self._maybe_beat()
+        self._pause_if_frozen()
+        with self._lock:
+            inj, self._injected_failure = self._injected_failure, None
+            slow = (self._slow_delay
+                    if self.clock() < self._slow_until else 0.0)
+        if inj is not None:
+            raise inj
+        if slow > 0.0:
+            time.sleep(slow)
+        if (self._drain_deadline is not None
+                and self.clock() > self._drain_deadline):
+            # Bounded drain: the grace window closed with work still in
+            # flight — fail the leftovers loudly (the router requeues
+            # them; a bare `tpucfn serve` reports them) instead of
+            # decoding past the preemption that motivated the drain.
+            self._fail_all(ReplicaFailed("drain grace expired with work "
+                                         "in flight"))
+            return False
+        self._process_cancels()
         self._ingest()
         preempt0 = self.kv.evictions
         for seq in self.scheduler.expire():
@@ -671,10 +808,251 @@ class Server:
             self.flight.record("serve", queue=queue, running=running,
                                occupancy=round(occupancy, 4))
 
+    # -- resilience plumbing (ISSUE 9) -------------------------------------
+    def _maybe_beat(self) -> None:
+        """One heartbeat per writer interval, FROM the serve loop (see
+        ``heartbeat`` in ``__init__``) — a frozen loop stops beating."""
+        hb = self.heartbeat
+        if hb is None:
+            return
+        now = self.clock()
+        if now - self._last_beat >= hb.interval_s:
+            self._last_beat = now
+            hb.beat()
+
+    def _pause_if_frozen(self) -> None:
+        """Chaos ``freeze_replica``: block the serve loop (no steps, no
+        beats) until the freeze lapses — or a kill/stop arrives, which
+        must still win against a frozen replica."""
+        while True:
+            with self._lock:
+                if self._injected_failure is not None or self._stopping:
+                    return
+                remaining = self._frozen_until - self.clock()
+            if remaining <= 0:
+                return
+            time.sleep(min(0.005, remaining))
+
+    def _process_cancels(self) -> None:
+        """Apply cancel/evict requests at the step boundary — the serve
+        thread is the only scheduler mutator, so cross-thread ``cancel``
+        /``evict_queued`` calls just leave a note here."""
+        with self._lock:
+            ids, self._cancel_req = self._cancel_req, set()
+            evict, self._evict_waiting = self._evict_waiting, False
+        for rid in sorted(ids):
+            self._cancel_one(rid)
+        if evict:
+            self._evict_waiting_now()
+
+    def _cancel_one(self, rid: int) -> None:
+        with self._lock:
+            queued = next((r for r in self._incoming if r.req_id == rid),
+                          None)
+            if queued is not None:
+                self._incoming.remove(queued)
+        if queued is not None:
+            self._complete(queued, error=Cancelled("cancelled before start"))
+            return
+        if rid in self._by_seq:
+            seq = self.scheduler.cancel(rid)
+            if seq is not None:
+                req = self._by_seq.pop(rid)
+                self._complete(req, error=Cancelled(
+                    f"cancelled after {len(seq.generated)}"
+                    f"/{seq.max_new_tokens} tokens"),
+                    partial_generated=len(seq.generated))
+
+    def _evict_waiting_now(self) -> None:
+        """Hand every not-yet-started sequence back to the caller with
+        ``Requeued`` (terminal status ``retried``): a draining replica's
+        queue belongs on a healthy replica, not behind this one's last
+        decodes.  Running sequences are untouched — they get the drain
+        grace window."""
+        with self._lock:
+            batch = list(self._incoming)
+            self._incoming.clear()
+        for seq in list(self.scheduler.waiting):
+            self.scheduler.cancel(seq.seq_id)
+            req = self._by_seq.pop(seq.seq_id, None)
+            if req is not None:
+                batch.append(req)
+        for req in batch:
+            self._complete(req, error=Requeued(
+                "replica draining: requeued to another replica"))
+
+    def cancel(self, req_id: int) -> None:
+        """Request cancellation (hedge-loser path): takes effect at the
+        next step boundary on the serve thread; the handle completes
+        with :class:`Cancelled` (status ``"cancelled"``).  Unknown or
+        already-finished ids are a no-op."""
+        with self._lock:
+            self._cancel_req.add(req_id)
+            self._work.notify()
+
+    def evict_queued(self) -> None:
+        """Hand all queued-not-started work back (each completes with
+        :class:`Requeued`, status ``"retried"``) at the next step
+        boundary — the router's drain calls this before waiting out the
+        in-flight grace."""
+        with self._lock:
+            self._evict_waiting = True
+            self._work.notify()
+
+    def fail(self, exc: BaseException | None = None) -> None:
+        """Kill this replica (chaos ``kill_replica``, or the router
+        acting on a DEAD health verdict): every in-flight and queued
+        request completes with :class:`ReplicaFailed`, admission closes
+        (503), and the serve thread exits.  Idempotent."""
+        exc = exc if exc is not None else ReplicaFailed("replica killed")
+        if not isinstance(exc, ReplicaFailed):
+            exc = ReplicaFailed(repr(exc))
+        with self._lock:
+            if self._failed is not None:
+                return
+            if self._thread is not None:
+                # the serve thread consumes the injection at its next
+                # step boundary (and the freeze-pause loop checks it, so
+                # a kill still beats a frozen replica)
+                self._injected_failure = exc
+                self._work.notify()
+                return
+        self._fail_all(exc)
+
+    def _fail_all(self, exc: ReplicaFailed) -> None:
+        """Terminal: mark the replica failed and complete everything in
+        flight with the failure.  Scheduler state is abandoned, not
+        repaired — a failed replica never runs another step."""
+        with self._lock:
+            if self._failed is not None:
+                return
+            self._failed = exc
+            batch = list(self._incoming)
+            self._incoming.clear()
+        reqs = batch + [self._by_seq.pop(k) for k in list(self._by_seq)]
+        self.scheduler.waiting.clear()
+        self.scheduler.running.clear()
+        for req in reqs:
+            self._complete(req, error=exc)
+
+    def freeze(self, duration_s: float | None = None) -> None:
+        """Chaos ``freeze_replica``: the serve loop (and its heartbeat)
+        stalls for ``duration_s`` (None = until :meth:`unfreeze`)."""
+        with self._lock:
+            self._frozen_until = (float("inf") if duration_s is None
+                                  else self.clock() + duration_s)
+
+    def unfreeze(self) -> None:
+        with self._lock:
+            self._frozen_until = 0.0
+
+    def slow(self, delay_s: float, duration_s: float | None = None) -> None:
+        """Chaos ``slow_replica``: every step pays an extra ``delay_s``
+        for ``duration_s`` (None = until ``slow(0)``)."""
+        with self._lock:
+            self._slow_delay = float(delay_s)
+            self._slow_until = (float("inf") if duration_s is None
+                                else self.clock() + duration_s)
+
+    def outstanding(self) -> int:
+        """Requests submitted but not yet terminal."""
+        with self._lock:
+            return len(self._incoming) + len(self._by_seq)
+
+    def wait_stopped(self, timeout: float | None = None) -> bool:
+        """Wait for the serve thread to exit (it ends on its own after
+        :meth:`fail` or :meth:`stop`); True when no thread is running.
+        The router joins a killed incarnation here before relaunching —
+        two serve loops driving one engine race its donated cache
+        buffers."""
+        t = self._thread
+        if t is None:
+            return True
+        t.join(timeout)
+        return not t.is_alive()
+
+    def drain(self, grace_s: float = 30.0, *, wait: bool = True) -> bool:
+        """Graceful shutdown (ISSUE 9 satellite): close admission (503)
+        and run the work already accepted to completion, bounded by
+        ``grace_s`` — a preempted serve host finishes its decodes
+        instead of abandoning them the way ``stop()`` did.  Work still
+        unfinished when the grace closes completes with
+        :class:`ReplicaFailed` (the router requeues it).
+
+        ``wait=False`` only arms the drain (admission off + deadline)
+        and returns — the signal-handler form: the already-running loop
+        enforces the bound.  Returns True when everything finished
+        inside the grace."""
+        if not wait:
+            # Signal-handler form: the handler may have interrupted a
+            # frame ON THIS THREAD that already holds self._lock (the
+            # serve loop's step(), or submit()), and self._lock is not
+            # reentrant — acquiring it here would deadlock the process
+            # at the exact moment it is trying to die gracefully.
+            # Plain attribute stores are GIL-atomic and the running
+            # loop reads them at its next step boundary.
+            self._draining = True
+            if self._drain_deadline is None:
+                self._drain_deadline = self.clock() + grace_s
+            return len(self._incoming) + len(self._by_seq) == 0
+        with self._lock:
+            self._draining = True
+            if self._drain_deadline is None:
+                self._drain_deadline = self.clock() + grace_s
+            deadline = self._drain_deadline
+            self._work.notify()
+        clean = True
+        if self._thread is None:
+            while True:
+                if self.clock() > deadline:
+                    if self.outstanding():
+                        self._fail_all(ReplicaFailed(
+                            "drain grace expired with work in flight"))
+                        clean = False
+                    break
+                try:
+                    if not self.step():
+                        break
+                except ReplicaFailed as e:
+                    self._fail_all(e)
+                    clean = False
+                    break
+                except Exception as e:  # noqa: BLE001 — engine died mid-drain
+                    self._fail_all(ReplicaFailed(f"serve loop failed: {e!r}"))
+                    clean = False
+                    break
+        else:
+            while self.outstanding() and self.clock() <= deadline:
+                time.sleep(0.005)
+            thread = self._thread
+            self.stop(timeout=max(grace_s, 1.0))
+            if thread is not None and thread.is_alive():
+                # wedged (e.g. frozen) — leave the leftovers to fail()
+                # /the router; completing them here would race the loop
+                return False
+            if self.outstanding():
+                self._fail_all(ReplicaFailed(
+                    "drain grace expired with work in flight"))
+                clean = False
+        # _failed catches every force-fail path, including the serve
+        # thread running step()'s own drain-deadline branch just before
+        # exiting (the threaded join then sees outstanding()==0 and a
+        # dead thread — which is NOT a clean drain).
+        return clean and self.outstanding() == 0 and self._failed is None
+
     # -- driving modes -----------------------------------------------------
     def run_until_idle(self) -> None:
-        while self.step():
-            pass
+        while True:
+            try:
+                if not self.step():
+                    return
+            except ReplicaFailed as e:
+                self._fail_all(e)
+                return
+            except Exception as e:  # noqa: BLE001 — engine/scheduler died
+                wrapped = ReplicaFailed(f"serve loop failed: {e!r}")
+                self._fail_all(wrapped)
+                raise wrapped from e
 
     def start(self) -> None:
         if self._thread is not None:
@@ -694,7 +1072,19 @@ class Server:
 
     def _run(self) -> None:
         while True:
-            if not self.step():
+            try:
+                progressed = self.step()
+            except ReplicaFailed as e:
+                self._fail_all(e)
+                return
+            except Exception as e:  # noqa: BLE001 — engine/scheduler died
+                # The old behavior silently killed this thread and left
+                # every in-flight request hanging forever; a replica
+                # failure must complete them with a structured error the
+                # router can retry (ISSUE 9).
+                self._fail_all(ReplicaFailed(f"serve loop failed: {e!r}"))
+                return
+            if not progressed:
                 with self._lock:
                     if self._stopping:
                         return
@@ -702,5 +1092,9 @@ class Server:
                         # Truly idle: no queued or running sequences means
                         # no pending deadlines either (_by_seq drains with
                         # the scheduler), so sleep until submit()/stop()
-                        # notifies — zero idle wakeups.
-                        self._work.wait()
+                        # notifies — with a heartbeat attached, wake once
+                        # per interval so liveness keeps flowing while
+                        # idle (idle is not dead).
+                        self._work.wait(
+                            None if self.heartbeat is None
+                            else self.heartbeat.interval_s)

@@ -91,6 +91,7 @@ class SequenceState(enum.Enum):
     RUNNING = "running"
     FINISHED = "finished"
     EXPIRED = "expired"   # deadline passed before completion
+    CANCELLED = "cancelled"  # withdrawn (hedge loser / drain requeue)
 
 
 @dataclasses.dataclass
@@ -263,6 +264,25 @@ class ContinuousBatchingScheduler:
                 s.state = SequenceState.EXPIRED
                 dead.append(s)
         return dead
+
+    # -- cancellation (ISSUE 9) --------------------------------------------
+    def cancel(self, seq_id: int) -> Sequence | None:
+        """Withdraw one sequence wherever it is: waiting (dropped from
+        the queue) or running (slot and blocks released, exactly like a
+        deadline expiry).  Returns the sequence, or None when it is not
+        here (already finished/expired) — cancellation of finished work
+        is a no-op, which is what first-completion-wins hedging needs."""
+        for i, s in enumerate(self.waiting):
+            if s.seq_id == seq_id:
+                del self.waiting[i]
+                s.state = SequenceState.CANCELLED
+                return s
+        for slot, s in list(self.running.items()):
+            if s.seq_id == seq_id:
+                self._vacate(slot)
+                s.state = SequenceState.CANCELLED
+                return s
+        return None
 
     # -- the core decision -------------------------------------------------
     def next_work(self) -> PrefillWork | DecodeWork | None:
