@@ -74,3 +74,32 @@ def test_enable_compile_cache_sets_config(tmp_path):
 
     d = enable_compile_cache(str(tmp_path / "cache"))
     assert jax.config.jax_compilation_cache_dir == d
+
+
+def test_metric_logger_log_works_without_jax(tmp_path):
+    """MetricLogger serves the jax-free planes (ISSUE 10): with stdout
+    mirroring off, log() must write the JSONL record without ever
+    importing jax (pinned with a meta-path hook making jax
+    unimportable)."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    script = (
+        "import sys\n"
+        "class B:\n"
+        "    def find_spec(self, name, path=None, target=None):\n"
+        "        if name == 'jax' or name.startswith('jax.'):\n"
+        "            raise ImportError('blocked: ' + name)\n"
+        "        return None\n"
+        "sys.meta_path.insert(0, B())\n"
+        "from tpucfn.obs.metrics import MetricLogger\n"
+        "ml = MetricLogger(None, stdout_every=0)\n"
+        "ml.log(1, {'loss': 0.5})\n"
+        "ml.close()\n"
+        "print('OK')\n"
+    )
+    r = subprocess.run([sys.executable, "-c", script], cwd=repo,
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0 and "OK" in r.stdout, (r.stdout, r.stderr)

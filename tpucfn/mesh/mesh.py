@@ -20,11 +20,15 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Sequence
+from typing import TYPE_CHECKING, Sequence
 
-import jax
-import numpy as np
-from jax.sharding import Mesh
+# jax (and numpy, which jax drags in anyway) are imported lazily inside
+# the mesh-building functions: MeshSpec itself is pure arithmetic, and
+# the CLI's jax-free paths (`tpucfn check`, provisioning) import this
+# module for the spec only.
+if TYPE_CHECKING:  # pragma: no cover - annotations only
+    import jax
+    from jax.sharding import Mesh
 
 AXIS_PIPELINE = "pipeline"
 AXIS_DATA = "data"
@@ -122,6 +126,10 @@ def build_mesh(
     adjacent device ids — on a real slice, adjacent ids are ICI neighbors,
     which is exactly where the tensor/context axes belong.
     """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
     if devices is None:
         devices = jax.devices()
     if spec is None:
@@ -134,6 +142,8 @@ def build_mesh(
 def local_mesh_devices(mesh: Mesh) -> list[jax.Device]:
     """Devices of ``mesh`` attached to this process (host-local shard of the
     fleet — the analogue of one row of the reference's hostfile)."""
+    import jax
+
     return [d for d in mesh.devices.flat if d.process_index == jax.process_index()]
 
 
@@ -159,6 +169,10 @@ def build_multislice_mesh(
     multislice TPU); otherwise (CPU tests, single slice) contiguous
     device-id blocks stand in for slices — same layout math either way.
     """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
     if dcn_axis not in DCN_FRIENDLY_AXES:
         raise ValueError(
             f"dcn_axis {dcn_axis!r} is latency/bandwidth-bound; only "

@@ -16,7 +16,10 @@ import time
 from pathlib import Path
 from typing import Any, Mapping
 
-import jax
+# jax is imported lazily at the call sites that need a live backend
+# (device stats, process index): this module also serves the jax-free
+# planes (the obs registry under `tpucfn check`, the ft coordinator),
+# and a top-level import would drag the whole runtime into them.
 
 
 def nearest_rank(xs_sorted: list, p: float):
@@ -100,6 +103,8 @@ def device_memory_stats(device=None) -> dict | None:
     the process's first device."""
     try:
         if device is None:
+            import jax
+
             device = jax.devices()[0]
         stats = device.memory_stats()
     except Exception:  # noqa: BLE001 — telemetry is best-effort
@@ -133,6 +138,8 @@ def register_device_gauges(registry, device=None, *,
     names: list[str] = []
     if device is None:
         try:
+            import jax
+
             device = jax.devices()[0]
         except Exception:  # noqa: BLE001 — no backend, no telemetry
             device = None
@@ -259,6 +266,8 @@ class StepTimer:
         return items_per_step / mst if mst else None
 
     def per_chip_throughput(self, items_per_step: int) -> float | None:
+        import jax
+
         tp = self.throughput(items_per_step)
         return tp / jax.device_count() if tp else None
 
@@ -280,6 +289,8 @@ class MetricLogger:
         self._f = None
         self._tb = None
         if log_dir is not None:
+            import jax
+
             d = Path(log_dir)
             d.mkdir(parents=True, exist_ok=True)
             self.path = d / f"{name}-host{jax.process_index():03d}.jsonl"
@@ -319,7 +330,13 @@ class MetricLogger:
                         if k not in ("step", "time") and isinstance(v, float):
                             tf.summary.scalar(f"{self.name}/{k}", v,
                                               step=int(step))
-        if jax.process_index() == 0 and self.stdout_every and step % self.stdout_every == 0:
+        # jax only when stdout mirroring is actually due — log() must
+        # stay importable (and cheap) on the jax-free planes
+        if self.stdout_every and step % self.stdout_every == 0:
+            import jax
+        else:
+            return
+        if jax.process_index() == 0:
             body = " ".join(
                 f"{k}={v:.5g}" if isinstance(v, float) else f"{k}={v}"
                 for k, v in record.items()

@@ -16,7 +16,10 @@ import threading
 import time
 from pathlib import Path
 
-import jax
+# jax is imported lazily at the trace/config call sites: this module's
+# CompileCacheProbe and ProfileCapture plumbing also run on the jax-free
+# planes (obs server routes, `tpucfn check`), where a top-level import
+# would drag the whole runtime in.
 
 
 def start_profiler_server(port: int = 9012):
@@ -34,6 +37,8 @@ def start_profiler_server(port: int = 9012):
                 f"profiler server already running on port {prev}; cannot "
                 f"start another on {port} (one per process)")
         return start_profiler_server._server
+    import jax
+
     start_profiler_server._server = jax.profiler.start_server(port)
     start_profiler_server._port = port
     return start_profiler_server._server
@@ -46,6 +51,8 @@ def enable_compile_cache(cache_dir: str | None = None) -> str:
     ``tpucfn launch`` on a pod — then skips recompilation, which is what
     keeps time_to_first_step from being compile-dominated (SURVEY.md §7.4
     item 6, BASELINE.md metric 2).  Safe to call multiple times."""
+    import jax
+
     from tpucfn.utils.env import xla_cache_dir
 
     cache_dir = cache_dir or xla_cache_dir()
@@ -90,6 +97,8 @@ class ProfileCapture:
         if self._capture_fn is not None:
             self._capture_fn(d, seconds)
             return
+        import jax
+
         jax.profiler.start_trace(str(d))
         try:
             self.sleep(seconds)
@@ -187,6 +196,8 @@ def profile_steps(log_dir: str | Path, *, enabled: bool = True):
     if not enabled:
         yield
         return
+    import jax
+
     d = Path(log_dir)
     d.mkdir(parents=True, exist_ok=True)
     jax.profiler.start_trace(str(d))
