@@ -373,9 +373,12 @@ class GangCoordinator(ChaosTarget):
     # -- event / snapshot plumbing ---------------------------------------
 
     def _event(self, kind: str, **fields) -> None:
+        from tpucfn.ft.events import validate_event_kind
+
         if self.ft_dir is None:
             return
-        rec = {"ts": time.time(), "kind": kind, **fields}
+        rec = {"ts": time.time(), "kind": validate_event_kind(kind),
+               **fields}
         with open(self.ft_dir / "events.jsonl", "a") as f:
             f.write(json.dumps(rec) + "\n")
         self._write_snapshot()

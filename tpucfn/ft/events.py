@@ -1,0 +1,55 @@
+"""Canonical vocabulary of the ft plane's ``events.jsonl`` (ISSUE 10).
+
+Every incident event the GangCoordinator or the serve-tier
+ReplicaRouter appends carries a ``kind`` from this tuple — and both
+emitters validate against it, so a typo'd kind fails loudly at the
+emit site instead of producing a row no consumer (``tpucfn ft
+status``, goodput incident attribution, postmortem assembly) will ever
+match.  This is the same drift-proofing heartbeat file naming got with
+``HB_GLOB`` in PR 5, applied to the event vocabulary; the
+``vocab-drift`` rule of ``tpucfn check`` reads this tuple via ``ast``
+(no imports) and flags stray literals anywhere in the package.
+
+jax-free on purpose: the coordinator, the router, and the analyzer all
+import it.
+"""
+
+from __future__ import annotations
+
+EVENT_KINDS = (
+    # lifecycle (GangCoordinator)
+    "launch",          # gang (re)launched: hosts, generation
+    "solo_launch",     # one host relaunched into the running gang
+    "host_exit",       # a rank finished cleanly (rc 0)
+    "done",            # the run ended; final rc
+    # incident flow (GangCoordinator + ReplicaRouter)
+    "detect",          # failures observed: [{host, kind, rc, step, detail}]
+    "decide",          # policy verdict for an incident
+    "flight_capture",  # survivors' flight rings captured at detect time
+    "recovered",       # incident closed: action, mttr_s
+    "give_up",         # restart budget exhausted / unrecoverable
+    "goodput_incident",  # goodput attribution row (downtime, lost work)
+    # graceful degradation (ISSUE 7)
+    "drain",           # drain initiated (preemption notice / router drain)
+    "drained",         # router: one replica's drain finished (clean flag)
+    "drain_all",       # router: process-level SIGTERM drain
+    "shrink",          # contract re-converged at N-k survivors
+    "ckpt_retry",      # corrupt checkpoint quarantined, retrying earlier
+    "ckpt_blacklist_expired",  # a newer finalized step retired the blacklist
+    # serve-tier specifics (ISSUE 9)
+    "relaunch_skipped",  # old serve thread outlived the join; slot stays dead
+    # chaos bookkeeping (ISSUE 4/7 harness)
+    "chaos_preempt_notice",
+    "chaos_ckpt_corrupted",
+    "host_lost",
+)
+
+
+def validate_event_kind(kind: str) -> str:
+    """Raise at the emit site on a kind outside the canonical set — a
+    row nothing will ever match is a silent bug, not an event."""
+    if kind not in EVENT_KINDS:
+        raise ValueError(
+            f"event kind {kind!r} is not in ft.events.EVENT_KINDS — add it "
+            "to the canonical tuple (and its consumers) or fix the typo")
+    return kind

@@ -53,6 +53,12 @@ from tpucfn.obs.goodput import host_id_from_path, read_jsonl_counting
 
 FLIGHT_GLOB = "flight-host*.jsonl"
 
+# Canonical kinds of the flight FILE format (ISSUE 10): "flight" is a
+# live snapshot body, "flight_dump" the on-disk header line.  Ring
+# SAMPLE kinds stay an open vocabulary (each instrumentation point
+# names its own); only the file-level kinds are matched by readers.
+FLIGHT_FILE_KINDS = ("flight", "flight_dump")
+
 
 def flight_path(d: str | Path, host_id: int) -> Path:
     return Path(d) / f"flight-host{host_id:03d}.jsonl"

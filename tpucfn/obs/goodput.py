@@ -77,6 +77,15 @@ REPORT_BUCKETS = ("productive_step", "compile", "compile_cached",
 
 LEDGER_GLOB = "goodput-host*.jsonl"
 
+# Canonical record kinds of the per-host ledger files (ISSUE 10):
+# "window" opens a process incarnation, "phase" attributes one bucketed
+# duration, "close" ends an incarnation cleanly.  The cross-run
+# regression ledger (`--ledger`) uses its own row kind.  The
+# `vocab-drift` rule of `tpucfn check` reads these tuples via ast, so a
+# typo'd literal in a reader or writer is a finding, not silent drift.
+LEDGER_KINDS = ("window", "phase", "close")
+LEDGER_ROW_KINDS = ("goodput_run",)
+
 
 def ledger_path(d: str | Path, host_id: int) -> Path:
     return Path(d) / f"goodput-host{host_id:03d}.jsonl"

@@ -41,6 +41,13 @@ import time
 from pathlib import Path
 from typing import Any
 
+# Canonical kind of a timed trace record (ISSUE 10): every span line
+# carries kind "span"; Tracer.event() lines carry their event NAME as
+# the kind (an open vocabulary — request_submitted, preemption, ...),
+# so consumers select spans by this tuple and treat everything else as
+# point events.
+SPAN_KINDS = ("span",)
+
 _current_span: contextvars.ContextVar[int | None] = contextvars.ContextVar(
     "tpucfn_current_span", default=None)
 

@@ -50,6 +50,16 @@ from tpucfn.serve.scheduler import (
 )
 
 
+# Canonical terminal vocabulary of ServeRequest.status (ISSUE 10): the
+# router, the benches, and tests branch on these strings, so they live
+# in ONE tuple the `vocab-drift` rule of `tpucfn check` enforces — a
+# literal outside this set anywhere in the package is a finding.
+# "pending" is the non-terminal initial state; everything else is
+# settled exactly when `done` fires (see ServeRequest).
+REQUEST_STATUSES = ("pending", "ok", "expired", "replica_failed",
+                    "retried", "rejected", "cancelled")
+
+
 class AdmissionError(RuntimeError):
     """Request refused at submit time.  ``status`` follows HTTP
     semantics: 429 = retry later (backpressure), 400 = never valid on

@@ -981,9 +981,12 @@ class ReplicaRouter(ChaosTarget):
     # -- forensics ---------------------------------------------------------
 
     def _event(self, kind: str, **fields) -> None:
+        from tpucfn.ft.events import validate_event_kind
+
         if self.ft_dir is None:
             return
-        rec = {"ts": time.time(), "kind": kind, "plane": "serve", **fields}
+        rec = {"ts": time.time(), "kind": validate_event_kind(kind),
+               "plane": "serve", **fields}
         with self._lock:
             with open(self.ft_dir / "events.jsonl", "a") as f:
                 f.write(json.dumps(rec) + "\n")
