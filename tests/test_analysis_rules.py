@@ -1,0 +1,960 @@
+"""Per-rule fixture suite for ``tpucfn.analysis`` (ISSUE 10).
+
+Every rule gets two synthetic modules: a minimal reproduction of the
+historical incident it encodes (MUST fire) and the shipped fixed shape
+(MUST stay silent) — including the PR 8 SIGTERM-handler-lock and
+join-under-lock repros.  Plus fingerprint stability (line motion does
+not orphan baselines), baseline round-trips, and the inline pragma.
+"""
+
+import json
+
+import pytest
+
+from tpucfn.analysis import (
+    Finding,
+    apply_baseline,
+    load_baseline,
+    run_check,
+    write_baseline,
+)
+
+
+def make_pkg(tmp_path, files: dict) -> tuple:
+    """Write ``files`` (rel path -> source) into a synthetic package and
+    return (package_root, repo_root)."""
+    root = tmp_path / "repo"
+    pkg = root / "pkg"
+    pkg.mkdir(parents=True, exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    for rel, src in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        if p.name != "__init__.py" and not (p.parent / "__init__.py").exists():
+            (p.parent / "__init__.py").write_text("")
+        p.write_text(src)
+    return pkg, root
+
+
+def check(tmp_path, files, rules=None, **kw):
+    pkg, root = make_pkg(tmp_path, files)
+    return run_check(pkg, repo_root=root, rules=rules, **kw)
+
+
+# -- signal-safety ----------------------------------------------------------
+
+# The PR 8 incident, reduced: the SIGTERM handler calls drain(wait=False)
+# and drain takes the non-reentrant server lock BEFORE the wait gate —
+# if the signal interrupted a frame holding the lock, the process
+# deadlocks at the moment it tries to die.
+SIGTERM_LOCK_BUG = '''
+import signal
+import threading
+import time
+
+
+class Server:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def drain(self, grace_s, wait=True):
+        with self._lock:
+            self._draining = True
+            self._deadline = time.monotonic() + grace_s
+        if not wait:
+            return False
+
+
+def cmd_serve():
+    server = Server()
+
+    def _on_term(signum, frame):
+        server.drain(30.0, wait=False)
+
+    signal.signal(signal.SIGTERM, _on_term)
+'''
+
+# The shipped fix: the wait=False arm is LOCK-FREE plain stores and
+# returns before the lock-taking wait=True body.
+SIGTERM_LOCK_FIXED = SIGTERM_LOCK_BUG.replace(
+    '''    def drain(self, grace_s, wait=True):
+        with self._lock:
+            self._draining = True
+            self._deadline = time.monotonic() + grace_s
+        if not wait:
+            return False
+''',
+    '''    def drain(self, grace_s, wait=True):
+        if not wait:
+            self._draining = True
+            self._deadline = time.monotonic() + grace_s
+            return False
+        with self._lock:
+            self._draining = True
+''')
+
+
+def test_signal_handler_lock_fires(tmp_path):
+    fs = check(tmp_path, {"srv.py": SIGTERM_LOCK_BUG},
+               rules=["signal-safety"])
+    assert len(fs) == 1
+    f = fs[0]
+    assert f.rule == "signal-safety"
+    assert "Server.drain" in f.key and "_on_term" in f.key
+    assert "non-reentrant" in f.message
+
+
+def test_signal_handler_lockfree_arm_path_is_silent(tmp_path):
+    fs = check(tmp_path, {"srv.py": SIGTERM_LOCK_FIXED},
+               rules=["signal-safety"])
+    assert fs == []
+
+
+def test_signal_handler_rlock_is_silent(tmp_path):
+    # the PR 6 fix: the flight ring's lock became an RLock exactly so
+    # the dump handler could interrupt a record() holding it
+    fs = check(tmp_path, {"srv.py": SIGTERM_LOCK_BUG.replace(
+        "threading.Lock()", "threading.RLock()")}, rules=["signal-safety"])
+    assert fs == []
+
+
+def test_signal_handler_nested_installer_resolves(tmp_path):
+    # install_dump_handlers shape: handler defined inside a loop inside
+    # a method, calling back into the same object
+    src = '''
+import signal
+import threading
+
+
+class Ring:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def snapshot(self):
+        with self._lock:
+            return 1
+
+    def install(self, signals=(signal.SIGTERM,)):
+        for sig in signals:
+            def _handler(signum, frame):
+                self.snapshot()
+            signal.signal(sig, _handler)
+'''
+    fs = check(tmp_path, {"ring.py": src}, rules=["signal-safety"])
+    assert len(fs) == 1 and "Ring.snapshot" in fs[0].key
+    fs = check(tmp_path, {"ring.py": src.replace(
+        "threading.Lock()", "threading.RLock()")}, rules=["signal-safety"])
+    assert fs == []
+
+
+# -- blocking-under-lock ----------------------------------------------------
+
+# The PR 8 incident, reduced: relaunch joined the old serve thread while
+# holding the router lock the thread's completion callbacks needed.
+JOIN_UNDER_LOCK_BUG = '''
+import threading
+
+
+class Router:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def relaunch(self, timeout=10.0):
+        with self._lock:
+            self._thread.join(timeout)
+            self._thread = None
+'''
+
+JOIN_OUTSIDE_LOCK_FIXED = '''
+import threading
+
+
+class Router:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def relaunch(self, timeout=10.0):
+        with self._lock:
+            thread, self._thread = self._thread, None
+        thread.join(timeout)
+'''
+
+
+def test_join_under_lock_fires(tmp_path):
+    fs = check(tmp_path, {"r.py": JOIN_UNDER_LOCK_BUG},
+               rules=["blocking-under-lock"])
+    assert len(fs) == 1
+    assert "join" in fs[0].message and "Router._lock" in fs[0].message
+
+
+def test_join_outside_lock_is_silent(tmp_path):
+    fs = check(tmp_path, {"r.py": JOIN_OUTSIDE_LOCK_FIXED},
+               rules=["blocking-under-lock"])
+    assert fs == []
+
+
+def test_str_join_and_short_sleep_under_lock_are_silent(tmp_path):
+    src = '''
+import threading
+import time
+
+
+class R:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def fmt(self, parts):
+        with self._lock:
+            time.sleep(0.005)
+            return ", ".join(parts) + "-".join(p for p in parts)
+'''
+    assert check(tmp_path, {"r.py": src},
+                 rules=["blocking-under-lock"]) == []
+
+
+def test_long_sleep_and_subprocess_under_lock_fire(tmp_path):
+    src = '''
+import subprocess
+import threading
+import time
+
+
+class R:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def slowpath(self):
+        with self._lock:
+            time.sleep(1.0)
+            subprocess.run(["true"])
+'''
+    fs = check(tmp_path, {"r.py": src}, rules=["blocking-under-lock"])
+    assert len(fs) == 2
+    assert any("sleep" in f.message for f in fs)
+    assert any("subprocess.run" in f.message for f in fs)
+
+
+def test_blocking_through_one_call_level_fires(tmp_path):
+    src = '''
+import threading
+
+
+class R:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def outer(self, timeout=5.0):
+        with self._lock:
+            self._wait_dead(timeout)
+
+    def _wait_dead(self, timeout):
+        self._thread.join(timeout)
+'''
+    fs = check(tmp_path, {"r.py": src}, rules=["blocking-under-lock"])
+    assert len(fs) == 1 and "join" in fs[0].message
+
+
+def test_inline_pragma_suppresses(tmp_path):
+    src = JOIN_UNDER_LOCK_BUG.replace(
+        "self._thread.join(timeout)",
+        "self._thread.join(timeout)  "
+        "# tpucfn: allow[blocking-under-lock] bounded handoff by design")
+    assert check(tmp_path, {"r.py": src},
+                 rules=["blocking-under-lock"]) == []
+
+
+def test_join_wrapper_under_lock_fires_despite_unresolvable_receiver(tmp_path):
+    # the REAL PR 8 shape: the join is hidden behind Server.wait_stopped
+    # and the receiver (`old.server`) cannot be resolved statically —
+    # the wrapper name itself must carry the verdict
+    src = '''
+import threading
+
+
+class Router:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def relaunch(self, idx):
+        old = self.replicas[idx]
+        with self._lock:
+            ok = old.server.wait_stopped(timeout=10.0)
+        return ok
+'''
+    fs = check(tmp_path, {"r.py": src}, rules=["blocking-under-lock"])
+    assert len(fs) == 1 and "wait_stopped" in fs[0].message
+
+
+# -- lock-order -------------------------------------------------------------
+
+LOCK_CYCLE_BUG = '''
+import threading
+
+
+class S:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def ba(self):
+        with self._b:
+            with self._a:
+                pass
+'''
+
+
+def test_lock_order_cycle_fires(tmp_path):
+    fs = check(tmp_path, {"s.py": LOCK_CYCLE_BUG}, rules=["lock-order"])
+    keys = {f.key for f in fs}
+    assert "cycle:S._a->S._b" in keys and "cycle:S._b->S._a" in keys
+
+
+def test_consistent_lock_order_is_silent(tmp_path):
+    src = LOCK_CYCLE_BUG.replace(
+        '''    def ba(self):
+        with self._b:
+            with self._a:
+                pass
+''', '''    def ba(self):
+        with self._a:
+            with self._b:
+                pass
+''')
+    assert check(tmp_path, {"s.py": src}, rules=["lock-order"]) == []
+
+
+def test_reacquire_held_nonreentrant_lock_fires(tmp_path):
+    # the PR 6 shape before the RLock fix: the dump path re-enters the
+    # ring lock the interrupted frame already holds
+    src = '''
+import threading
+
+
+class Ring:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def record(self):
+        with self._lock:
+            self.snapshot()
+
+    def snapshot(self):
+        with self._lock:
+            return 1
+'''
+    fs = check(tmp_path, {"ring.py": src}, rules=["lock-order"])
+    assert len(fs) == 1 and "re-acquires" in fs[0].message
+    # RLock makes the same shape legal
+    assert check(tmp_path, {"ring.py": src.replace(
+        "threading.Lock()", "threading.RLock()")},
+        rules=["lock-order"]) == []
+
+
+def test_cross_method_lock_edge_builds_cycle(tmp_path):
+    src = '''
+import threading
+
+
+class S:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            self._grab_b()
+
+    def _grab_b(self):
+        with self._b:
+            pass
+
+    def ba(self):
+        with self._b:
+            with self._a:
+                pass
+'''
+    fs = check(tmp_path, {"s.py": src}, rules=["lock-order"])
+    assert {f.key for f in fs} == {"cycle:S._a->S._b", "cycle:S._b->S._a"}
+
+
+# -- metric-hygiene ---------------------------------------------------------
+
+# The PR 8 incident, reduced: a fleet-named Summary constructed directly
+# and never registered — /metrics silently loses the series.
+LOST_SUMMARY_BUG = '''
+from pkg.obsbits import Summary
+
+
+class Router:
+    def __init__(self):
+        self._latency = Summary("router_request_latency_seconds")
+'''
+
+OBSBITS = '''
+class Summary:
+    def __init__(self, name, keep=4096):
+        self.name = name
+
+
+class Registry:
+    def counter(self, name, help=""):
+        return name
+
+    def gauge(self, name, help=""):
+        return name
+
+    def summary(self, name, help=""):
+        return name
+'''
+
+
+def test_unregistered_fleet_summary_fires(tmp_path):
+    fs = check(tmp_path, {"router.py": LOST_SUMMARY_BUG,
+                          "obsbits.py": OBSBITS},
+               rules=["metric-hygiene"])
+    assert len(fs) == 1
+    assert fs[0].key == "unregistered:router_request_latency_seconds"
+    assert "never registered" in fs[0].message
+
+
+def test_registered_summary_is_silent(tmp_path):
+    # the shipped fix: r.summary("router_request_latency_seconds", ...)
+    fixed = OBSBITS + '''
+
+r = Registry()
+lat = r.summary("router_request_latency_seconds", "routed latency")
+'''
+    assert check(tmp_path, {"router.py": LOST_SUMMARY_BUG,
+                            "obsbits.py": fixed},
+                 rules=["metric-hygiene"]) == []
+
+
+def test_private_nonfleet_summary_is_silent(tmp_path):
+    # the deliberate shape: an exact-percentile Summary kept OFF the
+    # registry uses a non-fleet name (frontend's request_latency_s)
+    src = LOST_SUMMARY_BUG.replace("router_request_latency_seconds",
+                                   "request_latency_s")
+    assert check(tmp_path, {"router.py": src, "obsbits.py": OBSBITS},
+                 rules=["metric-hygiene"]) == []
+
+
+def test_type_and_help_conflicts_and_prefix_fire(tmp_path):
+    src = OBSBITS + '''
+
+r = Registry()
+a = r.counter("serve_widgets_total", "how many widgets")
+b = r.gauge("serve_widgets_total", "widget level")
+c = r.counter("widgets_total", "no fleet prefix")
+'''
+    fs = check(tmp_path, {"obsbits.py": src}, rules=["metric-hygiene"])
+    keys = {f.key for f in fs}
+    assert "type:serve_widgets_total:gauge" in keys
+    assert "help:serve_widgets_total" in keys
+    assert "prefix:widgets_total" in keys
+
+
+def test_dangling_test_reference_fires(tmp_path):
+    pkg, root = make_pkg(tmp_path, {"obsbits.py": OBSBITS + '''
+
+r = Registry()
+real = r.counter("serve_real_total", "exists")
+'''})
+    tests = root / "tests"
+    tests.mkdir()
+    (tests / "test_x.py").write_text(
+        'def test_m(snap):\n'
+        '    assert snap["serve_real_total"] == 1\n'
+        '    assert snap["serve_ghost_total"] == 1\n')
+    fs = run_check(pkg, repo_root=root, tests_dir=tests,
+                   rules=["metric-hygiene"])
+    assert [f.key for f in fs] == ["ref:serve_ghost_total"]
+    assert fs[0].path == "tests/test_x.py"
+
+
+# -- jax-hazards ------------------------------------------------------------
+
+# The PR 4 resume-crasher shape, reduced: the cache is donated to the
+# jitted decode and then read again without being rebound from the
+# result — a use-after-free on the donated buffer.
+DONATED_READ_BUG = '''
+import jax
+
+
+class Engine:
+    def __init__(self, impl):
+        self._decode_jit = jax.jit(impl, donate_argnums=(0,))
+
+    def decode(self, tokens):
+        nxt = self._decode_jit(self.cache, tokens)
+        return nxt, self.cache[0]
+'''
+
+DONATED_REBOUND_FIXED = '''
+import jax
+
+
+class Engine:
+    def __init__(self, impl):
+        self._decode_jit = jax.jit(impl, donate_argnums=(0,))
+
+    def decode(self, tokens):
+        nxt, self.cache = self._decode_jit(self.cache, tokens)
+        return nxt
+'''
+
+
+def test_donated_read_after_call_fires(tmp_path):
+    fs = check(tmp_path, {"eng.py": DONATED_READ_BUG},
+               rules=["jax-hazards"])
+    assert len(fs) == 1
+    assert "donated" in fs[0].message and "self.cache" in fs[0].message
+
+
+def test_donated_rebound_from_result_is_silent(tmp_path):
+    assert check(tmp_path, {"eng.py": DONATED_REBOUND_FIXED},
+                 rules=["jax-hazards"]) == []
+
+
+def test_jit_in_loop_fires_and_hoisted_is_silent(tmp_path):
+    bug = '''
+import jax
+
+
+def sweep(configs, f):
+    out = []
+    for c in configs:
+        g = jax.jit(lambda x, c=c: f(x, c))
+        out.append(g(1.0))
+    return out
+'''
+    fs = check(tmp_path, {"sweep.py": bug}, rules=["jax-hazards"])
+    assert len(fs) == 1 and "loop body" in fs[0].message
+    hoisted = '''
+import jax
+
+
+def sweep(configs, f):
+    g = jax.jit(f)
+    out = []
+    for c in configs:
+        out.append(g(1.0, c))
+    return out
+'''
+    assert check(tmp_path, {"sweep.py": hoisted},
+                 rules=["jax-hazards"]) == []
+
+
+# -- vocab-drift ------------------------------------------------------------
+
+VOCAB_PKG = {
+    "events.py": 'EVENT_KINDS = ("detect", "recovered")\n'
+                 'LEDGER_KINDS = ("window", "phase", "close")\n',
+    "serve/front.py": '''
+REQUEST_STATUSES = ("pending", "ok", "expired")
+
+
+class R:
+    def finish(self, req, e):
+        req.status = "ok"
+        if req.status == "expired":
+            pass
+        kind = e.get("kind")
+        if kind == "recovered":
+            pass
+        lock_kind = "lock"
+        if lock_kind == "lock":
+            pass
+        self._event("detect")
+''',
+}
+
+
+def test_canonical_vocab_is_silent(tmp_path):
+    assert check(tmp_path, dict(VOCAB_PKG), rules=["vocab-drift"]) == []
+
+
+def test_vocab_typos_fire(tmp_path):
+    files = dict(VOCAB_PKG)
+    files["serve/front.py"] = files["serve/front.py"] \
+        .replace('req.status = "ok"', 'req.status = "okay"') \
+        .replace('if kind == "recovered":', 'if kind == "recoverd":') \
+        .replace('self._event("detect")', 'self._event("detetc")')
+    fs = check(tmp_path, files, rules=["vocab-drift"])
+    assert {f.key for f in fs} == {"status:okay", "kind:recoverd",
+                                   "event:detetc"}
+
+
+def test_vocab_silent_without_canonical_tuples(tmp_path):
+    files = {"serve/front.py": VOCAB_PKG["serve/front.py"]
+             .replace('REQUEST_STATUSES = ("pending", "ok", "expired")', "")
+             .replace('req.status = "ok"', 'req.status = "anything"')}
+    assert check(tmp_path, files, rules=["vocab-drift"]) == []
+
+
+# -- fingerprints / baseline ------------------------------------------------
+
+def test_fingerprints_stable_under_line_motion(tmp_path):
+    fs1 = check(tmp_path, {"r.py": JOIN_UNDER_LOCK_BUG},
+                rules=["blocking-under-lock"])
+    moved = "# a new comment\n# another\n\n" + JOIN_UNDER_LOCK_BUG
+    fs2 = check(tmp_path, {"r.py": moved}, rules=["blocking-under-lock"])
+    assert [f.fingerprint for f in fs1] == [f.fingerprint for f in fs2]
+    assert fs1[0].line != fs2[0].line  # the line moved; the identity didn't
+
+
+def test_baseline_round_trip(tmp_path):
+    fs = check(tmp_path, {"r.py": JOIN_UNDER_LOCK_BUG},
+               rules=["blocking-under-lock"])
+    bp = tmp_path / "baseline.json"
+    write_baseline(bp, fs)
+    data = json.loads(bp.read_text())
+    assert data["suppressions"][0]["fingerprint"] == fs[0].fingerprint
+    # a TODO justification loads (it is non-empty) and suppresses
+    baseline = load_baseline(bp)
+    active, suppressed, stale = apply_baseline(fs, baseline)
+    assert active == [] and len(suppressed) == 1 and stale == []
+    # once fixed, the entry is stale
+    active, suppressed, stale = apply_baseline([], baseline)
+    assert active == [] and suppressed == [] and len(stale) == 1
+
+
+def test_baseline_requires_justification(tmp_path):
+    bp = tmp_path / "baseline.json"
+    bp.write_text(json.dumps({"version": 1, "suppressions": [
+        {"fingerprint": "abc123", "rule": "x", "justification": ""}]}))
+    with pytest.raises(ValueError, match="justification"):
+        load_baseline(bp)
+
+
+def test_update_preserves_justifications(tmp_path):
+    fs = check(tmp_path, {"r.py": JOIN_UNDER_LOCK_BUG},
+               rules=["blocking-under-lock"])
+    bp = tmp_path / "baseline.json"
+    write_baseline(bp, fs)
+    prev = load_baseline(bp)
+    prev[fs[0].fingerprint]["justification"] = "bounded by design"
+    write_baseline(bp, fs, prev)
+    assert load_baseline(bp)[fs[0].fingerprint]["justification"] \
+        == "bounded by design"
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    fs = check(tmp_path, {"bad.py": "def broken(:\n"})
+    assert len(fs) == 1 and fs[0].rule == "parse-error"
+
+
+def test_unknown_rule_raises(tmp_path):
+    with pytest.raises(ValueError, match="unknown rule"):
+        check(tmp_path, {"x.py": "pass\n"}, rules=["nope"])
+
+
+def test_diff_only_filter(tmp_path):
+    pkg, root = make_pkg(tmp_path, {"a.py": JOIN_UNDER_LOCK_BUG,
+                                    "b.py": JOIN_UNDER_LOCK_BUG})
+    fs = run_check(pkg, repo_root=root, rules=["blocking-under-lock"],
+                   only={"pkg/a.py"})
+    assert [f.path for f in fs] == ["pkg/a.py"]
+
+
+# -- review-pass pins -------------------------------------------------------
+
+def test_donated_rebind_in_nested_suite_is_silent(tmp_path):
+    # review fix: a guarded rebind (`try: x = self._step(x) except: ...`)
+    # was reported as a use-after-free because the outer suite's pass
+    # walked into the nested body but checked rebinding against the
+    # outer statement
+    src = '''
+import jax
+
+
+class Engine:
+    def __init__(self, impl):
+        self._step = jax.jit(impl, donate_argnums=(0,))
+
+    def run(self, x):
+        try:
+            x = self._step(x)
+        except ValueError:
+            pass
+        return x + 1
+'''
+    assert check(tmp_path, {"eng.py": src}, rules=["jax-hazards"]) == []
+
+
+def test_module_scope_signal_install_fires(tmp_path):
+    # review fix: a top-level signal.signal(...) arms a handler just as
+    # surely as one inside a function — and the bare-name form from
+    # `from signal import signal` resolves too
+    src = '''
+from signal import SIGTERM, signal
+import threading
+
+_LOCK = threading.Lock()
+
+
+def _handler(signum, frame):
+    with _LOCK:
+        pass
+
+
+signal(SIGTERM, _handler)
+'''
+    fs = check(tmp_path, {"mod.py": src}, rules=["signal-safety"])
+    assert len(fs) == 1 and "_handler" in fs[0].key
+
+
+def test_changed_files_includes_untracked(tmp_path):
+    import subprocess
+    from tpucfn.analysis import changed_files
+
+    root = tmp_path / "r"
+    (root / "pkg").mkdir(parents=True)
+    subprocess.run(["git", "init", "-q"], cwd=root, check=True)
+    subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                    "commit", "-q", "--allow-empty", "-m", "seed"],
+                   cwd=root, check=True)
+    (root / "pkg" / "new.py").write_text("X = 1\n")
+    assert changed_files(root, "HEAD") == {"pkg/new.py"}
+
+
+def test_donated_rebind_on_branch_is_silent(tmp_path):
+    # review fix: a rebind inside a nested suite (`if retry: x = y + 1`)
+    # must count as a rebind — the read after it is not a use-after-free
+    src = '''
+import jax
+
+
+class Engine:
+    def __init__(self, impl):
+        self._step = jax.jit(impl, donate_argnums=(0,))
+
+    def run(self, x, retry):
+        y = self._step(x)
+        if retry:
+            x = y + 1
+        print(x)
+        return y
+'''
+    assert check(tmp_path, {"eng.py": src}, rules=["jax-hazards"]) == []
+
+
+def test_blocking_rule_prunes_constant_branches_in_callees(tmp_path):
+    # review fix: `with self._lock: self.drain(wait=False)` must analyze
+    # only drain's lock-free arm-only path, not the unreachable
+    # wait=True body that joins a thread
+    src = '''
+import threading
+
+
+class S:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def stopper(self):
+        with self._lock:
+            self.drain(wait=False)
+
+    def drain(self, wait=True):
+        if not wait:
+            self._draining = True
+            return
+        self._thread.join(10.0)
+'''
+    assert check(tmp_path, {"s.py": src},
+                 rules=["blocking-under-lock"]) == []
+    # and with wait=True at the call site the join IS reachable
+    bug = src.replace("self.drain(wait=False)", "self.drain(wait=True)")
+    fs = check(tmp_path, {"s.py": bug}, rules=["blocking-under-lock"])
+    assert len(fs) == 1 and "join" in fs[0].message
+
+
+def test_str_join_with_s_suffixed_arg_is_silent(tmp_path):
+    # review fix: `sep.join(parts_s)` is string work, not a thread join
+    src = '''
+import threading
+
+
+class R:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def fmt(self, sep, parts_s):
+        with self._lock:
+            return sep.join(parts_s)
+'''
+    assert check(tmp_path, {"r.py": src},
+                 rules=["blocking-under-lock"]) == []
+
+
+def test_join_with_caps_duration_constant_fires(tmp_path):
+    src = '''
+import threading
+
+RELAUNCH_JOIN_S = 10.0
+
+
+class R:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def relaunch(self):
+        with self._lock:
+            self._thread.join(RELAUNCH_JOIN_S)
+'''
+    fs = check(tmp_path, {"r.py": src}, rules=["blocking-under-lock"])
+    assert len(fs) == 1 and "join" in fs[0].message
+
+
+def test_event_bus_signal_method_is_not_an_install(tmp_path):
+    # review fix: `bus.signal("change", cb)` is an event-bus API, not
+    # signal.signal — only receivers that resolve to the signal module
+    # arm the rule
+    src = '''
+import threading
+
+
+class Bus:
+    def signal(self, name, cb):
+        pass
+
+
+class C:
+    def __init__(self, bus):
+        self._lock = threading.Lock()
+        bus.signal("change", self.locked)
+
+    def locked(self):
+        with self._lock:
+            pass
+'''
+    assert check(tmp_path, {"c.py": src}, rules=["signal-safety"]) == []
+
+
+def test_changed_files_untracked_in_subdirectory_repo(tmp_path):
+    import subprocess
+    from tpucfn.analysis import changed_files
+
+    top = tmp_path / "top"
+    (top / "sub" / "pkg").mkdir(parents=True)
+    subprocess.run(["git", "init", "-q"], cwd=top, check=True)
+    subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                    "commit", "-q", "--allow-empty", "-m", "seed"],
+                   cwd=top, check=True)
+    (top / "sub" / "pkg" / "new.py").write_text("X = 1\n")
+    # repo_root is a SUBDIRECTORY of the git toplevel: untracked paths
+    # must still anchor correctly (ls-files --full-name)
+    assert changed_files(top / "sub", "HEAD") == {"pkg/new.py"}
+
+
+def test_match_statement_suites_are_scanned(tmp_path):
+    # review fix: hand-rolled suite recursion was blind inside `match`
+    # case bodies — a join under a lock inside a case shipped silently,
+    # and a rebind inside a case was a jax-hazards false positive
+    blocking = '''
+import threading
+
+
+class R:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def act(self, mode, timeout=5.0):
+        with self._lock:
+            match mode:
+                case "stop":
+                    self._thread.join(timeout)
+                case _:
+                    pass
+'''
+    fs = check(tmp_path, {"r.py": blocking}, rules=["blocking-under-lock"])
+    assert len(fs) == 1 and "join" in fs[0].message
+
+    rebind = '''
+import jax
+
+
+class Engine:
+    def __init__(self, impl):
+        self._step = jax.jit(impl, donate_argnums=(0,))
+
+    def run(self, x, mode):
+        y = self._step(x)
+        match mode:
+            case "retry":
+                x = y + 1
+        print(x)
+'''
+    assert check(tmp_path, {"eng.py": rebind}, rules=["jax-hazards"]) == []
+
+
+def test_blocking_context_manager_under_lock_fires(tmp_path):
+    # review fix: `with urlopen(url):` inside a lock region is a
+    # network round-trip under the lock even though the call is a
+    # context expression, not a body statement
+    src = '''
+import threading
+from urllib.request import urlopen
+
+
+class R:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def fetch(self, url):
+        with self._lock:
+            with urlopen(url) as r:
+                return r.read()
+'''
+    fs = check(tmp_path, {"r.py": src}, rules=["blocking-under-lock"])
+    assert len(fs) == 1 and "urlopen" in fs[0].message
+
+
+def test_lock_order_descent_edges_survive_prior_module_visits(tmp_path):
+    # review fix: the callee-descent memo persisted across modules while
+    # the order graph reset per module — whichever module scanned first
+    # claimed the shared helper's edge and later modules' graphs lost it
+    helper = '''
+import threading
+
+
+class Z:
+    def __init__(self):
+        self._ring = threading.Lock()
+
+    def grab(self):
+        with self._ring:
+            pass
+'''
+    user = '''
+import threading
+
+from pkg.z import Z
+
+
+class S:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def forward(self):
+        z = Z()
+        with self._lock:
+            z.grab()
+'''
+    pkg, root = make_pkg(tmp_path, {"a.py": user, "b.py": user,
+                                    "z.py": helper})
+    from tpucfn.analysis.core import Analysis, load_modules
+    from tpucfn.analysis.rules.locks import _Scanner
+
+    mods, _ = load_modules(pkg, root)
+    sc = _Scanner(Analysis(mods, package_root=pkg, repo_root=root))
+    edges_by_mod = {}
+    for mod in mods:
+        sc.scan_module(mod)
+        edges_by_mod[mod.rel] = set(sc.edges)
+    assert ("S._lock", "Z._ring") in edges_by_mod["pkg/a.py"]
+    assert ("S._lock", "Z._ring") in edges_by_mod["pkg/b.py"]

@@ -1,0 +1,79 @@
+"""``tpucfn check`` over the repo's own package, inside tier-1
+(ISSUE 10 CI satellite): every future PR passes through the analyzer —
+a non-baselined finding here fails the suite, exactly like a test.
+
+Also pins the two operational guarantees the ISSUE demands: the full
+run stays under 10 seconds, and the analyzer never imports jax (a cold
+jax import alone would blow the budget on a slow container — and the
+analyzer must run in environments that have no accelerator stack at
+all).
+"""
+
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import tpucfn
+from tpucfn.analysis import apply_baseline, load_baseline, run_check
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = Path(tpucfn.__file__).resolve().parent
+BASELINE = REPO / "runs" / "analysis_baseline.json"
+
+
+def test_package_is_clean_under_the_rule_pack():
+    t0 = time.monotonic()
+    findings = run_check(PACKAGE, repo_root=PACKAGE.parent)
+    elapsed = time.monotonic() - t0
+    baseline = load_baseline(BASELINE) if BASELINE.is_file() else {}
+    active, suppressed, stale = apply_baseline(findings, baseline)
+    assert active == [], (
+        "tpucfn check found non-baselined findings — fix them or add a "
+        "JUSTIFIED baseline entry (runs/analysis_baseline.json):\n"
+        + "\n".join(f"  {f.path}:{f.line} [{f.rule}] {f.message} "
+                    f"(fingerprint {f.fingerprint})" for f in active))
+    assert stale == [], (
+        "stale baseline entries suppress nothing — prune with "
+        "`tpucfn check --update-baseline`:\n"
+        + "\n".join(f"  {e['fingerprint']} [{e.get('rule')}] "
+                    f"{e.get('path')}" for e in stale))
+    assert elapsed < 10.0, f"analyzer took {elapsed:.1f}s (budget 10s)"
+
+
+def test_committed_baseline_entries_are_justified():
+    baseline = load_baseline(BASELINE)  # raises on missing justification
+    for ent in baseline.values():
+        assert "TODO" not in ent["justification"], (
+            f"baseline entry {ent['fingerprint']} still carries a TODO "
+            "justification")
+
+
+def test_check_cli_runs_without_importing_jax():
+    """The whole `tpucfn check` path — CLI import included — must work
+    with jax unimportable (and therefore never pay its import cost)."""
+    script = (
+        "import sys\n"
+        "class B:\n"
+        "    def find_spec(self, name, path=None, target=None):\n"
+        "        if name == 'jax' or name.startswith('jax.'):\n"
+        "            raise ImportError('jax import blocked: ' + name)\n"
+        "        return None\n"
+        "sys.meta_path.insert(0, B())\n"
+        "from tpucfn.cli.main import main\n"
+        "sys.exit(main(['check']))\n"
+    )
+    r = subprocess.run([sys.executable, "-c", script], cwd=REPO,
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+
+
+def test_diff_mode_reports_only_changed_files(tmp_path):
+    """--diff restricts reporting to files changed vs a ref while still
+    parsing the whole package (cross-module context), so the builder
+    loop can run it incrementally."""
+    from tpucfn.analysis import changed_files
+
+    changed = changed_files(REPO, "HEAD")
+    findings = run_check(PACKAGE, repo_root=PACKAGE.parent, only=changed)
+    assert all(f.path in changed for f in findings)

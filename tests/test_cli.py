@@ -108,3 +108,100 @@ def test_state_persists_across_invocations(tmp_path, capsys):
 def test_unknown_cluster_errors(tmp_path):
     with pytest.raises(KeyError):
         _cli(tmp_path, "status", "--name", "nope")
+
+
+# -- tpucfn check (ISSUE 10) ------------------------------------------------
+# rc/JSON contract pinned so tooling (the builder loop, CI wrappers) can
+# consume it: rc 0 clean, rc 1 findings, rc 2 usage error; --json emits
+# exactly one JSON object per finding with file/line/rule/fingerprint/
+# message keys.
+
+CHECK_BUG_SRC = '''
+import threading
+
+
+class Router:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def relaunch(self, timeout=10.0):
+        with self._lock:
+            self._thread.join(timeout)
+'''
+
+
+def _check_pkg(tmp_path, src=CHECK_BUG_SRC):
+    pkg = tmp_path / "repo" / "pkg"
+    pkg.mkdir(parents=True, exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "router.py").write_text(src)
+    return pkg
+
+
+def test_check_json_one_line_per_finding_rc1(tmp_path, capsys):
+    pkg = _check_pkg(tmp_path)
+    rc = _cli(tmp_path, "check", "--json", str(pkg))
+    assert rc == 1
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert set(rec) == {"file", "line", "rule", "fingerprint", "message"}
+    assert rec["rule"] == "blocking-under-lock"
+    assert rec["file"].endswith("pkg/router.py")
+    assert isinstance(rec["line"], int) and rec["line"] > 0
+    assert isinstance(rec["fingerprint"], str) and len(rec["fingerprint"]) == 16
+
+
+def test_check_clean_package_rc0(tmp_path, capsys):
+    pkg = _check_pkg(tmp_path, "X = 1\n")
+    rc = _cli(tmp_path, "check", "--json", str(pkg))
+    assert rc == 0
+    assert capsys.readouterr().out.strip() == ""
+
+
+def test_check_usage_errors_rc2(tmp_path, capsys):
+    pkg = _check_pkg(tmp_path)
+    assert _cli(tmp_path, "check", "--rules", "nosuch", str(pkg)) == 2
+    assert "unknown rule" in capsys.readouterr().err
+    assert _cli(tmp_path, "check", str(pkg / "missing")) == 2
+    capsys.readouterr()
+    assert _cli(tmp_path, "check", "--baseline",
+                str(tmp_path / "nope.json"), str(pkg)) == 2
+
+
+def test_check_baseline_suppresses_to_rc0(tmp_path, capsys):
+    pkg = _check_pkg(tmp_path)
+    bp = tmp_path / "baseline.json"
+    assert _cli(tmp_path, "check", "--baseline", str(bp)) == 2  # missing
+    capsys.readouterr()
+    # --update-baseline writes it; justify; then the run is clean
+    assert _cli(tmp_path, "check", "--update-baseline",
+                "--baseline", str(bp), str(pkg)) == 0
+    capsys.readouterr()
+    body = bp.read_text().replace(
+        "TODO: one line on why this finding is deliberately kept",
+        "bounded join by design")
+    bp.write_text(body)
+    rc = _cli(tmp_path, "check", "--json", "--baseline", str(bp), str(pkg))
+    assert rc == 0
+    assert capsys.readouterr().out.strip() == ""
+
+
+def test_check_rules_filter(tmp_path, capsys):
+    pkg = _check_pkg(tmp_path)
+    rc = _cli(tmp_path, "check", "--json", "--rules", "signal-safety",
+              str(pkg))
+    assert rc == 0  # the join bug is not a signal-safety finding
+    assert capsys.readouterr().out.strip() == ""
+
+
+def test_check_update_baseline_refuses_partial_views(tmp_path, capsys):
+    # review fix: rewriting the baseline from a --rules or --diff
+    # subset would silently drop every other rule's suppressions
+    pkg = _check_pkg(tmp_path)
+    bp = tmp_path / "baseline.json"
+    rc = _cli(tmp_path, "check", "--update-baseline", "--baseline", str(bp),
+              "--rules", "signal-safety", str(pkg))
+    assert rc == 2
+    assert "--rules" in capsys.readouterr().err
+    assert not bp.exists()

@@ -1,0 +1,25 @@
+"""tpucfn.analysis — the repo's concurrency- and fleet-invariant static
+analyzer (``tpucfn check``, ISSUE 10).
+
+Eight PRs of serve/ft/obs infrastructure kept re-shipping the same
+defect classes — locks acquired in signal handlers, joins under locks,
+metrics that never reached /metrics, stringly-typed vocabularies
+drifting.  This package turns that incident history into enforced
+rules: a jax-free, stdlib-``ast`` engine (:mod:`~tpucfn.analysis.core`)
+plus a rule pack (:mod:`~tpucfn.analysis.rules`), surfaced as
+``tpucfn check`` and run over the package itself inside tier-1
+(``tests/test_analysis_self.py``) so every future PR passes through it.
+"""
+
+from tpucfn.analysis.core import (  # noqa: F401
+    Analysis,
+    Finding,
+    apply_baseline,
+    changed_files,
+    fingerprint,
+    load_baseline,
+    load_modules,
+    run_check,
+    write_baseline,
+)
+from tpucfn.analysis.rules import ALL_RULES, Rule, resolve_rules  # noqa: F401

@@ -1,0 +1,652 @@
+"""Analysis engine: module walker, call-graph, findings, baselines.
+
+``tpucfn check`` (ISSUE 10) turns the repo's incident history into
+machine-checked rules: every rule under :mod:`tpucfn.analysis.rules`
+encodes a bug class this codebase has actually shipped (a lock acquired
+inside a SIGTERM handler, a ``Thread.join`` under the router lock, an
+unregistered metric silently missing from ``/metrics``...).  This module
+is the rule-independent substrate:
+
+* **Module loading** — :func:`load_modules` parses every ``*.py`` under
+  a package root with stdlib :mod:`ast`; nothing is imported, so the
+  analyzer runs in well under a second with no jax in the process.
+* **Resolution** — :class:`Analysis` indexes classes and functions so
+  rules can resolve ``self.m()`` / ``obj.m()`` / bare-name calls to
+  their definitions (including cross-module, via a unique-class-name
+  index) and classify lock attributes as reentrant or not
+  (:meth:`Analysis.lock_kind`).
+* **Findings** — :class:`Finding` carries a *stable fingerprint* built
+  from ``(rule, path, key)`` where ``key`` is a rule-chosen token
+  (function qualname + lock attr, metric name...), **never** the line
+  number — so reformatting or unrelated edits do not invalidate a
+  baseline.
+* **Suppression** — two escape hatches, both explicit: an inline
+  ``# tpucfn: allow[rule-id]`` pragma on (or one line above) the
+  flagged line, and a baseline file mapping fingerprints to one-line
+  justifications (:func:`load_baseline` refuses entries without one —
+  silent suppressions are the thing this tool exists to end).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import re
+import subprocess
+from pathlib import Path
+from typing import Callable, Iterable
+
+# -- findings ---------------------------------------------------------------
+
+
+def fingerprint(rule: str, path: str, key: str) -> str:
+    """Stable identity of one finding: rule + repo-relative path +
+    rule-chosen key.  Line numbers are deliberately excluded so code
+    motion above a finding does not orphan its baseline entry."""
+    h = hashlib.sha1(f"{rule}|{path}|{key}".encode()).hexdigest()
+    return h[:16]
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    message: str
+    key: str  # stable token; see fingerprint()
+
+    @property
+    def fingerprint(self) -> str:
+        return fingerprint(self.rule, self.path, self.key)
+
+    def to_json(self) -> dict:
+        return {"file": self.path, "line": self.line, "rule": self.rule,
+                "fingerprint": self.fingerprint, "message": self.message}
+
+
+# -- modules ----------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Module:
+    path: Path  # absolute
+    rel: str    # repo-relative posix path ("tpucfn/serve/router.py")
+    tree: ast.Module
+    lines: list[str]
+
+
+def load_modules(package_root: Path,
+                 repo_root: Path) -> tuple[list[Module], list[Finding]]:
+    """Parse every ``*.py`` under ``package_root``.  Unparseable files
+    become ``parse-error`` findings instead of crashing the run — a
+    syntax error is the one bug every rule would otherwise miss."""
+    modules: list[Module] = []
+    findings: list[Finding] = []
+    for p in sorted(package_root.rglob("*.py")):
+        if "__pycache__" in p.parts:
+            continue
+        rel = p.relative_to(repo_root).as_posix()
+        try:
+            src = p.read_text(encoding="utf-8", errors="replace")
+            tree = ast.parse(src, filename=str(p))
+        except SyntaxError as e:
+            findings.append(Finding(
+                "parse-error", rel, e.lineno or 1,
+                f"file does not parse: {e.msg}", key="syntax"))
+            continue
+        modules.append(Module(p, rel, tree, src.splitlines()))
+    return modules, findings
+
+
+# -- function / class indexes ----------------------------------------------
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function or method definition, with enough context to walk
+    calls out of it."""
+
+    qualname: str                 # "Class.method" / "func" / "func.<nested>"
+    node: ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda
+    module: Module
+    class_name: str | None = None
+
+    @property
+    def params(self) -> list[str]:
+        a = self.node.args
+        return [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+
+
+def sub_suites(stmt: ast.stmt):
+    """Every nested statement suite of one compound statement —
+    if/for/while/with bodies, else/finally, exception handlers, AND
+    ``match`` case bodies.  The ONE place suite recursion is defined:
+    hand-rolled body/orelse loops scattered across rules went blind
+    inside ``match`` statements (review finding)."""
+    for attr in ("body", "orelse", "finalbody"):
+        v = getattr(stmt, attr, None)
+        if v and isinstance(v[0], ast.stmt):
+            yield v
+    for h in getattr(stmt, "handlers", ()) or ():
+        yield h.body
+    for c in getattr(stmt, "cases", ()) or ():
+        yield c.body
+
+
+def _walk_funcs(mod: Module):
+    """Yield (qualname, node, class_name) for every def in the module,
+    including methods and functions nested inside other functions — and
+    inside any compound statement (a handler defined in a ``try:`` or a
+    ``for`` loop is still a function)."""
+
+    def rec(body, prefix: str, class_name: str | None):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{node.name}"
+                yield q, node, class_name
+                yield from rec(node.body, q + ".", class_name)
+            elif isinstance(node, ast.ClassDef):
+                yield from rec(node.body, f"{prefix}{node.name}.",
+                               node.name if not prefix else class_name)
+            else:
+                for b in sub_suites(node):
+                    yield from rec(b, prefix, class_name)
+
+    yield from rec(mod.tree.body, "", None)
+
+
+class Analysis:
+    """Shared context handed to every rule: the parsed modules plus
+    lazily-built cross-module indexes."""
+
+    def __init__(self, modules: list[Module], *, package_root: Path,
+                 repo_root: Path, tests_dir: Path | None = None,
+                 readme: Path | None = None):
+        self.modules = modules
+        self.package_root = package_root
+        self.repo_root = repo_root
+        self.tests_dir = tests_dir
+        self.readme = readme
+        self._funcs: dict[str, dict[str, FuncInfo]] = {}
+        self._classes: dict[str, list[tuple[Module, ast.ClassDef]]] | None = None
+        self._locks: dict[tuple[str, str | None], dict[str, str]] = {}
+
+    # -- indexes -----------------------------------------------------------
+
+    def functions(self, mod: Module) -> dict[str, FuncInfo]:
+        if mod.rel not in self._funcs:
+            self._funcs[mod.rel] = {
+                q: FuncInfo(q, node, mod, cls)
+                for q, node, cls in _walk_funcs(mod)}
+        return self._funcs[mod.rel]
+
+    @property
+    def class_index(self) -> dict[str, list[tuple[Module, ast.ClassDef]]]:
+        """Class name -> definitions across the whole package (used to
+        resolve ``obj = ClassName(...)`` constructor calls; ambiguous
+        names resolve to nothing)."""
+        if self._classes is None:
+            self._classes = {}
+            for mod in self.modules:
+                for node in ast.walk(mod.tree):
+                    if isinstance(node, ast.ClassDef):
+                        self._classes.setdefault(node.name, []).append(
+                            (mod, node))
+        return self._classes
+
+    # -- lock classification ----------------------------------------------
+
+    def lock_kinds(self, mod: Module,
+                   class_name: str | None) -> dict[str, tuple[str, str]]:
+        """``attr -> (kind, canonical_attr)`` for ``self.<attr>``
+        (methods) or bare names (module level).  ``threading.Lock()`` ->
+        non-reentrant ``"lock"``; ``RLock()`` -> ``"rlock"``;
+        ``Condition(self.x)`` is an ALIAS of ``x`` — acquiring the
+        condition acquires x, so both resolve to x's kind and identity
+        (bare ``Condition()`` builds its own RLock)."""
+        cache_key = (mod.rel, class_name)
+        if cache_key in self._locks:
+            return self._locks[cache_key]
+        out: dict[str, tuple[str, str]] = {}
+        aliases: dict[str, str] = {}  # attr wrapped by a Condition
+
+        if class_name is None:
+            assigns = [n for n in mod.tree.body if isinstance(n, ast.Assign)]
+        else:
+            assigns = []
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef) and node.name == class_name:
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Assign):
+                            assigns.append(sub)
+        for a in assigns:
+            kind, wrapped = _lock_ctor_kind(a.value)
+            if kind is None and wrapped is None:
+                continue
+            for t in a.targets:
+                attr = _self_attr_or_name(t)
+                if attr is None:
+                    continue
+                if wrapped is not None:
+                    aliases[attr] = wrapped
+                else:
+                    out[attr] = (kind, attr)
+        for attr, wrapped in aliases.items():
+            kind, canon = out.get(wrapped, ("rlock", wrapped))
+            out[attr] = (kind, canon)
+        self._locks[cache_key] = out
+        return out
+
+    def lock_kind(self, mod: Module, class_name: str | None,
+                  expr: ast.expr) -> tuple[str | None, str | None]:
+        """Classify a ``with <expr>:`` context manager.  Returns
+        ``(kind, name)`` where kind is "lock"/"rlock"/None (not a lock
+        we can see) and name is the normalized lock identity (aliases —
+        a Condition over a lock — collapse onto the wrapped lock)."""
+        attr = _self_attr_or_name(expr)
+        if attr is None:
+            return None, None
+        kinds = self.lock_kinds(mod, class_name)
+        if attr in kinds:
+            kind, canon = kinds[attr]
+            scope = class_name or "<module>"
+            return kind, f"{scope}.{canon}"
+        if class_name is not None:
+            # module-level lock used from a method
+            mkinds = self.lock_kinds(mod, None)
+            if attr in mkinds:
+                kind, canon = mkinds[attr]
+                return kind, f"<module>.{canon}"
+        return None, None
+
+    # -- call resolution ---------------------------------------------------
+
+    def resolve_call(self, mod: Module, caller: FuncInfo,
+                     call: ast.Call) -> FuncInfo | None:
+        """Best-effort static resolution of one call site:
+
+        * ``name(...)``      -> function in the same module (nested defs
+          in the caller win over module-level ones);
+        * ``self.m(...)``    -> method of the caller's class;
+        * ``obj.m(...)``     -> ``Cls.m`` when ``obj`` was assigned
+          ``Cls(...)`` in the caller (or at module level) and ``Cls``
+          names exactly one class in the package.
+
+        Unresolvable calls return None — rules stay conservative.
+        """
+        funcs = self.functions(mod)
+        f = call.func
+        if isinstance(f, ast.Name):
+            nested = f"{caller.qualname}.{f.id}"
+            if nested in funcs:
+                return funcs[nested]
+            return funcs.get(f.id)
+        if not isinstance(f, ast.Attribute):
+            return None
+        if isinstance(f.value, ast.Name) and f.value.id == "self" \
+                and caller.class_name is not None:
+            return self._method(mod, caller.class_name, f.attr)
+        if isinstance(f.value, ast.Name):
+            cls = self._var_class(mod, caller, f.value.id)
+            if cls is not None:
+                cmod, cname = cls
+                return self._method(cmod, cname, f.attr)
+        return None
+
+    def _method(self, mod: Module, class_name: str,
+                name: str) -> FuncInfo | None:
+        q = f"{class_name}.{name}"
+        info = self.functions(mod).get(q)
+        if info is not None:
+            return info
+        # single-level base-class lookup by name, package-wide
+        for m, node in self.class_index.get(class_name, []):
+            for base in node.bases:
+                if isinstance(base, ast.Name):
+                    for bm, bnode in self.class_index.get(base.id, []):
+                        hit = self.functions(bm).get(f"{base.id}.{name}")
+                        if hit is not None:
+                            return hit
+        return None
+
+    def _var_class(self, mod: Module, caller: FuncInfo,
+                   var: str) -> tuple[Module, str] | None:
+        """Which class (if exactly one, package-wide) ``var`` was
+        constructed from — in the caller's body, any enclosing
+        function's body (closure variables: the signal-handler idiom is
+        a nested ``_on_term`` closing over ``server``), or at module
+        level."""
+        funcs = self.functions(mod)
+        spots = list(ast.walk(caller.node))
+        parts = caller.qualname.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            enclosing = funcs.get(".".join(parts[:i]))
+            if enclosing is not None and \
+                    not isinstance(enclosing.node, ast.Lambda):
+                spots.extend(enclosing.node.body)
+        spots.extend(mod.tree.body)
+        classes: set[str] = set()
+        for node in spots:
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(isinstance(t, ast.Name) and t.id == var
+                       for t in node.targets):
+                continue
+            v = node.value
+            if isinstance(v, ast.Call) and isinstance(v.func, ast.Name):
+                classes.add(v.func.id)
+        for cname in classes:
+            defs = self.class_index.get(cname, [])
+            if len(defs) == 1:
+                return defs[0][0], cname
+        return None
+
+    # -- inline suppression ------------------------------------------------
+
+    def allowed(self, mod: Module, line: int, rule: str) -> bool:
+        """True when the flagged line (or the one above it) carries an
+        explicit ``# tpucfn: allow[<rule>]`` pragma."""
+        tag = f"tpucfn: allow[{rule}]"
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(mod.lines) and tag in mod.lines[ln - 1]:
+                return True
+        return False
+
+
+def _self_attr_or_name(node: ast.expr) -> str | None:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _lock_ctor_kind(value: ast.expr) -> tuple[str | None, str | None]:
+    """``(kind, wrapped_attr)`` for a lock-constructing RHS, else
+    ``(None, None)``.  ``Condition(self.x)`` reports ``(None, "x")`` so
+    the caller can alias it to x's kind."""
+    if not isinstance(value, ast.Call):
+        return None, None
+    f = value.func
+    name = f.attr if isinstance(f, ast.Attribute) else \
+        f.id if isinstance(f, ast.Name) else None
+    if name == "Lock":
+        return "lock", None
+    if name == "RLock":
+        return "rlock", None
+    if name == "Condition":
+        if value.args:
+            wrapped = _self_attr_or_name(value.args[0])
+            if wrapped is not None:
+                return None, wrapped
+        return "rlock", None
+    return None, None
+
+
+# -- constant-aware statement iteration ------------------------------------
+
+
+def live_statements(body: list[ast.stmt],
+                    consts: dict[str, object] | None = None):
+    """Yield the statements of ``body`` recursively, pruning ``if``
+    branches decidable from ``consts`` (parameter-name -> constant).
+    This is what lets a call like ``drain(wait=False)`` analyze only the
+    signal-handler-safe early-return path instead of flagging the
+    lock-taking ``wait=True`` body it never reaches (the PR 8 fixed
+    shape).  Nested function definitions are NOT descended into — they
+    only run if called, and call edges are walked separately."""
+    consts = consts or {}
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(stmt, ast.If):
+            verdict = _const_test(stmt.test, consts)
+            if verdict is True:
+                yield from live_statements(stmt.body, consts)
+                if _terminates(stmt.body):
+                    return  # early-return guard: the rest never runs
+                continue
+            if verdict is False:
+                yield from live_statements(stmt.orelse, consts)
+                if stmt.orelse and _terminates(stmt.orelse):
+                    return
+                continue
+            yield stmt
+            yield from live_statements(stmt.body, consts)
+            yield from live_statements(stmt.orelse, consts)
+            continue
+        yield stmt
+        for sub in sub_suites(stmt):
+            yield from live_statements(sub, consts)
+
+
+def _terminates(body: list[ast.stmt]) -> bool:
+    """Does this suite unconditionally leave the enclosing block?"""
+    if not body:
+        return False
+    last = body[-1]
+    if isinstance(last, (ast.Return, ast.Raise, ast.Break, ast.Continue)):
+        return True
+    if isinstance(last, ast.If):
+        return bool(last.orelse) and _terminates(last.body) \
+            and _terminates(last.orelse)
+    return False
+
+
+def _const_test(test: ast.expr, consts: dict[str, object]):
+    """Truth value of an ``if`` test under ``consts``, or None."""
+    if isinstance(test, ast.Name) and test.id in consts:
+        return bool(consts[test.id])
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        inner = _const_test(test.operand, consts)
+        return None if inner is None else not inner
+    return None
+
+
+def call_consts(call: ast.Call, callee: FuncInfo) -> dict[str, object]:
+    """Constant arguments of ``call`` mapped to ``callee`` parameter
+    names (positional and keyword) — the input to branch pruning."""
+    out: dict[str, object] = {}
+    params = callee.params
+    if params and params[0] == "self":
+        params = params[1:]
+    for i, a in enumerate(call.args):
+        if isinstance(a, ast.Constant) and i < len(params):
+            out[params[i]] = a.value
+    for kw in call.keywords:
+        if kw.arg is not None and isinstance(kw.value, ast.Constant):
+            out[kw.arg] = kw.value.value
+    return out
+
+
+def calls_in(stmt: ast.stmt) -> Iterable[ast.Call]:
+    """Every Call expression directly inside one statement (does not
+    recurse into nested statement bodies — pair with live_statements)."""
+    children = []
+    for field in stmt._fields:
+        v = getattr(stmt, field, None)
+        if isinstance(v, ast.expr):
+            children.append(v)
+        elif isinstance(v, list):
+            children.extend(x for x in v if isinstance(x, ast.expr))
+        # withitem list
+        if field == "items" and isinstance(v, list):
+            children.extend(x.context_expr for x in v
+                            if isinstance(x, ast.withitem))
+    for c in children:
+        for node in ast.walk(c):
+            if isinstance(node, ast.Call):
+                yield node
+
+
+# -- baseline ---------------------------------------------------------------
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path: str | Path) -> dict[str, dict]:
+    """``fingerprint -> entry``.  Every entry MUST carry a non-empty
+    one-line justification; a baseline that silently suppresses is the
+    exact failure mode this tool exists to prevent, so it raises."""
+    p = Path(path)
+    try:
+        data = json.loads(p.read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        raise ValueError(f"cannot read baseline {p}: {e}")
+    if not isinstance(data, dict) or "suppressions" not in data:
+        raise ValueError(f"baseline {p}: expected {{'suppressions': [...]}}")
+    out: dict[str, dict] = {}
+    for ent in data["suppressions"]:
+        fp = ent.get("fingerprint")
+        just = (ent.get("justification") or "").strip()
+        if not fp:
+            raise ValueError(f"baseline {p}: entry missing fingerprint: {ent}")
+        if not just:
+            raise ValueError(
+                f"baseline {p}: suppression {fp} ({ent.get('rule')}) has no "
+                "justification — every baselined finding must say why it is "
+                "deliberately kept")
+        out[fp] = ent
+    return out
+
+
+def write_baseline(path: str | Path, findings: list[Finding],
+                   previous: dict[str, dict] | None = None) -> Path:
+    """Write a baseline covering exactly ``findings``; justifications of
+    entries already present in ``previous`` are preserved, new ones get
+    an explicit TODO the author must fill in before review."""
+    previous = previous or {}
+    ents = []
+    for f in sorted(findings, key=lambda f: (f.path, f.rule, f.key)):
+        prev = previous.get(f.fingerprint, {})
+        ents.append({
+            "fingerprint": f.fingerprint,
+            "rule": f.rule,
+            "path": f.path,
+            "key": f.key,
+            "message": f.message,
+            "justification": prev.get("justification")
+            or "TODO: one line on why this finding is deliberately kept",
+        })
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(json.dumps({"version": BASELINE_VERSION,
+                             "suppressions": ents}, indent=2) + "\n")
+    return p
+
+
+def apply_baseline(findings: list[Finding], baseline: dict[str, dict]
+                   ) -> tuple[list[Finding], list[Finding], list[dict]]:
+    """``(active, suppressed, stale_entries)`` — stale entries suppress
+    nothing anymore (the finding was fixed) and should be pruned with
+    ``--update-baseline``."""
+    active, suppressed = [], []
+    seen: set[str] = set()
+    for f in findings:
+        if f.fingerprint in baseline:
+            suppressed.append(f)
+            seen.add(f.fingerprint)
+        else:
+            active.append(f)
+    stale = [ent for fp, ent in baseline.items() if fp not in seen]
+    return active, suppressed, stale
+
+
+# -- git / diff mode --------------------------------------------------------
+
+
+def changed_files(repo_root: Path, ref: str) -> set[str]:
+    """Repo-root-relative paths changed vs ``ref`` — committed, staged,
+    worktree, AND untracked (``git diff`` alone never lists the brand-
+    new files a PR adds, which are exactly where new findings live).
+    Git reports toplevel-relative paths; they are re-anchored onto
+    ``repo_root`` so ``--diff`` works from a subdirectory checkout too.
+    Raises ValueError when git cannot answer (not a repo, bad ref)."""
+
+    def _git(*args: str) -> list[str]:
+        try:
+            out = subprocess.run(["git", "-C", str(repo_root), *args],
+                                 capture_output=True, text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise ValueError(f"git {args[0]} failed: {e}")
+        if out.returncode != 0:
+            raise ValueError(
+                f"git {' '.join(args)} failed: {out.stderr.strip()}")
+        return [line.strip() for line in out.stdout.splitlines()
+                if line.strip()]
+
+    toplevel = Path(_git("rev-parse", "--show-toplevel")[0]).resolve()
+    # --full-name: ls-files otherwise prints cwd-relative paths (unlike
+    # git diff, which is always toplevel-relative) — joining those onto
+    # toplevel silently dropped untracked files in subdirectory checkouts
+    names = _git("diff", "--name-only", ref, "--") \
+        + _git("ls-files", "--others", "--exclude-standard", "--full-name")
+    root = Path(repo_root).resolve()
+    out: set[str] = set()
+    for name in names:
+        p = (toplevel / name).resolve()
+        try:
+            out.add(p.relative_to(root).as_posix())
+        except ValueError:
+            continue  # changed file outside this package's repo_root
+    return out
+
+
+# -- runner -----------------------------------------------------------------
+
+
+def run_check(package_root: str | Path, *,
+              rules: Iterable[str] | None = None,
+              repo_root: str | Path | None = None,
+              tests_dir: str | Path | None = None,
+              readme: str | Path | None = None,
+              only: set[str] | None = None) -> list[Finding]:
+    """Run the rule pack over ``package_root`` and return findings
+    (inline-pragma suppressions already dropped; baseline is the
+    caller's business so ``--update-baseline`` can see everything).
+
+    ``only`` restricts REPORTING to the given repo-relative paths
+    (``--diff`` mode) — the whole package is still parsed so cross-
+    module rules (metric hygiene, vocabularies) keep full context.
+    """
+    from tpucfn.analysis.rules import resolve_rules
+
+    package_root = Path(package_root).resolve()
+    repo_root = (Path(repo_root).resolve() if repo_root is not None
+                 else package_root.parent)
+    if tests_dir is None:
+        cand = repo_root / "tests"
+        tests_dir = cand if cand.is_dir() else None
+    if readme is None:
+        cand = repo_root / "README.md"
+        readme = cand if cand.is_file() else None
+
+    modules, findings = load_modules(package_root, repo_root)
+    analysis = Analysis(modules, package_root=package_root,
+                        repo_root=repo_root,
+                        tests_dir=Path(tests_dir) if tests_dir else None,
+                        readme=Path(readme) if readme else None)
+    mod_by_rel = {m.rel: m for m in modules}
+    for rule in resolve_rules(rules):
+        for f in rule.check(analysis):
+            mod = mod_by_rel.get(f.path)
+            if mod is not None and analysis.allowed(mod, f.line, f.rule):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.key))
+    # identical (rule, path, key) triples get stable ordinals so every
+    # finding keeps a distinct fingerprint
+    counts: dict[str, int] = {}
+    for f in findings:
+        fp = f.fingerprint
+        n = counts.get(fp, 0) + 1
+        counts[fp] = n
+        if n > 1:
+            f.key = f"{f.key}#{n}"
+    if only is not None:
+        findings = [f for f in findings if f.path in only]
+    return findings

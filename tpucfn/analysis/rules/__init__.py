@@ -1,0 +1,81 @@
+"""The rule pack: every rule encodes a bug class this repo has shipped.
+
+Each rule is a :class:`Rule` with a kebab-case id (what ``--rules``,
+inline ``# tpucfn: allow[...]`` pragmas, and baseline entries name), a
+one-line summary, the CHANGES.md incident it encodes (the README
+catalog renders these), and a ``check(analysis) -> Iterable[Finding]``
+callable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Iterable
+
+from tpucfn.analysis.rules import (
+    jax_hazards,
+    locks,
+    metrics_hygiene,
+    signal_safety,
+    vocab,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    summary: str
+    incident: str
+    check: Callable
+
+
+ALL_RULES: dict[str, Rule] = {r.id: r for r in (
+    Rule("signal-safety",
+         "no non-reentrant lock acquisition reachable from a signal "
+         "handler",
+         "PR 6 flight-dump handler self-deadlock; PR 8 "
+         "Server.drain(wait=False) SIGTERM deadlock",
+         signal_safety.check),
+    Rule("blocking-under-lock",
+         "no join/subprocess/network/long-sleep inside a `with lock:` "
+         "region",
+         "PR 8 Thread.join under the router lock deadlocked completion "
+         "callbacks",
+         locks.check_blocking),
+    Rule("lock-order",
+         "no lock-acquisition cycles (including re-acquiring a held "
+         "non-reentrant lock)",
+         "PR 6 non-reentrant flight-ring lock re-entered from the "
+         "signal path",
+         locks.check_order),
+    Rule("metric-hygiene",
+         "every fleet-named metric is registered exactly once, with one "
+         "type and help; tests/README reference only real series",
+         "PR 8 router_request_latency_seconds Summary never registered "
+         "— /metrics lost latency exactly when --replicas turned on",
+         metrics_hygiene.check),
+    Rule("jax-hazards",
+         "no donated-buffer read after the jitted call that donated it; "
+         "no jax.jit in a loop body",
+         "PR 4 resume crasher: donated restore buffers freed through "
+         "the wrong allocator",
+         jax_hazards.check),
+    Rule("vocab-drift",
+         "event kinds / ledger kinds / request statuses stay on their "
+         "canonical tuples",
+         "the HB_GLOB lesson (PR 5): scattered literals drift; one typo "
+         "and a consumer silently never matches",
+         vocab.check),
+)}
+
+
+def resolve_rules(ids: Iterable[str] | None) -> list[Rule]:
+    if ids is None:
+        return list(ALL_RULES.values())
+    out = []
+    for i in ids:
+        if i not in ALL_RULES:
+            raise ValueError(
+                f"unknown rule {i!r} (known: {', '.join(sorted(ALL_RULES))})")
+        out.append(ALL_RULES[i])
+    return out

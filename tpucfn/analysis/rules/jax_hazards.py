@@ -1,0 +1,304 @@
+"""jax-hazards: donated-buffer reuse and per-step recompilation.
+
+Incidents encoded (CHANGES.md):
+
+* PR 4's resume crasher — orbax/tensorstore handed back buffers XLA did
+  not own, and the trainer's ``donate_argnums`` freed them through the
+  wrong allocator ("corrupted double-linked list" aborts).  The general
+  shape the rule catches statically: an argument passed in a donated
+  position of a jitted call is **read again after the call** without
+  being rebound from its result — donation invalidated that buffer, so
+  the read is a use-after-free that jax reports (at best) as
+  "buffer deleted" at some later, unrelated line.
+* ``jax.jit`` invoked inside a loop body builds a fresh jitted callable
+  (and usually a fresh compile-cache miss) per iteration — the classic
+  silent 100x step-time bug.  Deliberate compile sweeps (the flash
+  autotuner) baseline the finding with a justification.
+
+Both checks resolve ``jax.jit(...)``/``jit(...)`` assignments (including
+``self._x = jax.jit(impl, donate_argnums=(0,))`` in ``__init__``) and
+then inspect call sites of those targets; unknown call targets are
+never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpucfn.analysis.core import (
+    Analysis,
+    Finding,
+    FuncInfo,
+    calls_in,
+    sub_suites,
+)
+
+RULE_ID = "jax-hazards"
+
+
+def _is_jit(call: ast.Call, jit_aliases: set[str]) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "jit" \
+            and isinstance(f.value, ast.Name) and f.value.id == "jax":
+        return True
+    return isinstance(f, ast.Name) and f.id in jit_aliases
+
+
+def _jit_aliases(mod) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for a in node.names:
+                if a.name == "jit":
+                    out.add(a.asname or a.name)
+    return out
+
+
+def _donated_positions(call: ast.Call) -> frozenset[int]:
+    """Literal ``donate_argnums`` positions; an ``(0,) if cond else ()``
+    conditional donates conservatively (union of both branches)."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        return frozenset(_int_tuple(kw.value))
+    return frozenset()
+
+
+def _int_tuple(node: ast.expr) -> set[int]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return {e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)}
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, ast.IfExp):
+        return _int_tuple(node.body) | _int_tuple(node.orelse)
+    return set()
+
+
+# (jit-holding targets and donated-argument expressions share one
+# normalizer: _expr_key below)
+
+
+def check(analysis: Analysis):
+    findings: list[Finding] = []
+    for mod in analysis.modules:
+        aliases = _jit_aliases(mod)
+        funcs = analysis.functions(mod)
+
+        # -- jit built inside a loop body ------------------------------
+        for qual, info in funcs.items():
+            if isinstance(info.node, ast.Lambda):
+                continue
+            seen_in_func = 0
+            for call, in_loop in _calls_with_loop_depth(info.node):
+                if in_loop and _is_jit(call, aliases):
+                    seen_in_func += 1
+                    findings.append(Finding(
+                        RULE_ID, mod.rel, call.lineno,
+                        f"jax.jit called inside a loop body in {qual} — "
+                        "every iteration builds a fresh jitted callable "
+                        "(and usually recompiles); hoist the jit out of "
+                        "the loop or cache the callable",
+                        key=f"jit-in-loop:{qual}:{seen_in_func}"))
+
+        # -- donated argument read after the call ----------------------
+        donating: dict[tuple[str | None, str], frozenset[int]] = {}
+        for qual, info in funcs.items():
+            if isinstance(info.node, ast.Lambda):
+                continue
+            for node in ast.walk(info.node):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call) and \
+                        _is_jit(node.value, aliases):
+                    donated = _donated_positions(node.value)
+                    if not donated:
+                        continue
+                    for t in node.targets:
+                        k = _expr_key(t)
+                        if k:
+                            donating[(info.class_name, k)] = donated
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    _is_jit(node.value, aliases):
+                donated = _donated_positions(node.value)
+                if donated:
+                    for t in node.targets:
+                        k = _expr_key(t)
+                        if k:
+                            donating[(None, k)] = donated
+        if donating:
+            for qual, info in funcs.items():
+                if isinstance(info.node, ast.Lambda):
+                    continue
+                findings.extend(
+                    _donated_reads(mod, info, donating))
+    return findings
+
+
+def _calls_with_loop_depth(func: ast.FunctionDef):
+    """Yield ``(call, in_loop)`` for calls in the function body, not
+    descending into nested defs (a jit built once inside a closure
+    factory called from a loop is the factory's business)."""
+
+    def rec(stmts, in_loop):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            here = in_loop or isinstance(stmt, (ast.For, ast.AsyncFor,
+                                                ast.While))
+            for field in stmt._fields:
+                v = getattr(stmt, field, None)
+                exprs = []
+                if isinstance(v, ast.expr):
+                    exprs.append(v)
+                elif isinstance(v, list):
+                    exprs.extend(x for x in v if isinstance(x, ast.expr))
+                    exprs.extend(x.context_expr for x in v
+                                 if isinstance(x, ast.withitem))
+                for e in exprs:
+                    for n in ast.walk(e):
+                        if isinstance(n, ast.Call):
+                            # lambda bodies belong to the lambda
+                            yield n, here
+            for sub in sub_suites(stmt):
+                yield from rec(sub, here)
+
+    yield from rec(func.body, False)
+
+
+def _expr_key(e: ast.expr) -> str | None:
+    if isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name) \
+            and e.value.id == "self":
+        return f"self.{e.attr}"
+    if isinstance(e, ast.Name):
+        return e.id
+    return None
+
+
+def _donated_reads(mod, info: FuncInfo, donating) -> list[Finding]:
+    """Find calls to known-donating jitted targets whose donated
+    arguments are read after the call without being rebound from its
+    result (suite-local: the analysis follows the statement list the
+    call lives in)."""
+    findings: list[Finding] = []
+
+    def scan_suite(stmts: list[ast.stmt]):
+        for i, stmt in enumerate(stmts):
+            # only calls lexically in THIS suite: a call inside a nested
+            # body (try/if/for) is analyzed by that suite's own pass,
+            # where the rebind targets and the read-after horizon are
+            # the nested suite's — checking it against the outer suite
+            # reported a guarded rebind as a use-after-free
+            for call in calls_in(stmt):
+                k = _expr_key(call.func)
+                if k is None:
+                    continue
+                donated = donating.get((info.class_name, k)) \
+                    or donating.get((None, k))
+                if not donated:
+                    continue
+                rebound = _stmt_targets(stmt)
+                for pos in sorted(donated):
+                    if pos >= len(call.args):
+                        continue
+                    argk = _expr_key(call.args[pos])
+                    if argk is None or argk in rebound:
+                        continue
+                    hit = _read_after(stmts[i + 1:], argk)
+                    if hit is not None:
+                        findings.append(Finding(
+                            RULE_ID, mod.rel, hit,
+                            f"{argk} is donated to {k} (donate_argnums "
+                            f"position {pos}) in {info.qualname} and read "
+                            "again after the call without being rebound "
+                            "from its result — the donated buffer is "
+                            "freed, so this read is a use-after-free "
+                            "(\"buffer deleted\" at runtime)",
+                            key=f"donated:{info.qualname}:{k}:{argk}"))
+            # nested suites get their own pass
+            for sub in sub_suites(stmt):
+                scan_suite(sub)
+
+    scan_suite(info.node.body)
+    return findings
+
+
+def _stmt_targets(stmt: ast.stmt) -> set[str]:
+    out: set[str] = set()
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    for t in targets:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                k = _expr_key(e)
+                if k:
+                    out.add(k)
+        else:
+            k = _expr_key(t)
+            if k:
+                out.add(k)
+    return out
+
+
+def _read_after(stmts: list[ast.stmt], key: str) -> int | None:
+    """Line of the first read of ``key`` in the following statements, or
+    None if it is rebound first (or never touched).  Evaluation order
+    matters: an assignment's RHS reads before its targets store, and a
+    rebind inside a nested suite (``if retry: x = y + 1``) counts as a
+    rebind — flagging the read after it was a review-pass false
+    positive.  A store on SOME branch conservatively ends the scan (a
+    linter prefers a missed maybe-hazard to a false alarm)."""
+    for stmt in stmts:
+        verdict = _first_access(stmt, key)
+        if verdict is None:
+            continue
+        kind, line = verdict
+        if kind == "read":
+            return line
+        return None  # rebound (at least on one executed path)
+
+
+def _first_access(stmt: ast.stmt, key: str):
+    """``("read", line)`` / ``("store", line)`` / None for the first
+    access of ``key`` in one statement, honoring RHS-before-targets
+    evaluation order and recursing into nested suites."""
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return None
+
+    def reads_in(expr) -> int | None:
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.Name, ast.Attribute)) \
+                    and _expr_key(node) == key \
+                    and isinstance(getattr(node, "ctx", None), ast.Load):
+                return node.lineno
+        return None
+
+    # the statement's own expressions (RHS, test, iter...) read first
+    for field in stmt._fields:
+        v = getattr(stmt, field, None)
+        exprs = [v] if isinstance(v, ast.expr) else \
+            [x for x in v if isinstance(x, ast.expr)] \
+            if isinstance(v, list) else []
+        for e in exprs:
+            line = reads_in(e)
+            if line is not None:
+                return ("read", line)
+    if key in _stmt_targets(stmt):
+        return ("store", stmt.lineno)
+    store = None
+    for sub in sub_suites(stmt):
+        for s in sub:
+            v = _first_access(s, key)
+            if v is None:
+                continue
+            if v[0] == "read":
+                return v
+            store = v
+            break  # this suite rebound it; later stmts here are safe
+    return store

@@ -1,0 +1,309 @@
+"""blocking-under-lock and lock-order: the deadlock/stall rules.
+
+Incidents encoded (CHANGES.md):
+
+* PR 8 shipped a ``Thread.join`` inside the router lock — the joined
+  serve thread's completion callbacks needed that same lock, so the
+  join could never finish ("join must happen OUTSIDE the router lock or
+  the old thread's completion callbacks deadlock against it").
+  ``blocking-under-lock`` flags joins, subprocess calls, socket/HTTP
+  round-trips, and long sleeps lexically inside a ``with <lock>:``
+  region (one level of same-class/module calls is expanded too).
+  Deliberate bounded waits carry a ``# tpucfn: allow[blocking-under-
+  lock]`` pragma or a baseline entry — never a silent pass.
+* ``lock-order`` builds each module's lock-acquisition graph (lock B
+  acquired while A is held, across same-class method calls) and flags
+  cycles — including the length-1 cycle of re-acquiring a non-reentrant
+  lock you already hold, which is the PR 6 flight-ring shape before the
+  RLock fix.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpucfn.analysis.core import (
+    Analysis,
+    Finding,
+    FuncInfo,
+    _const_test,
+    _terminates,
+    call_consts,
+    calls_in,
+    sub_suites,
+)
+
+BLOCKING_RULE = "blocking-under-lock"
+ORDER_RULE = "lock-order"
+
+SLEEP_THRESHOLD_S = 0.05
+_SUBPROCESS_CALLS = {"run", "call", "check_call", "check_output", "Popen",
+                     "communicate"}
+_NET_FUNCS = {"urlopen", "create_connection", "getaddrinfo"}
+_NET_MODULES = {"requests", "urllib", "socket", "http"}
+# The repo's own join-shaped wrappers: receivers are often unresolvable
+# (`old.server.wait_stopped(...)`), so these names flag by themselves —
+# Server.wait_stopped IS a thread join (the PR 8 relaunch incident ran
+# through exactly this wrapper).
+_BLOCKING_WRAPPERS = {
+    "wait_stopped": "thread join (wait_stopped)",
+    "run_until_idle": "full serve-loop drive (run_until_idle)",
+}
+
+
+def _blocking_desc(call: ast.Call) -> str | None:
+    """A human-readable description when ``call`` is a blocking call the
+    rule cares about, else None."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        recv = f.value
+        if f.attr in _BLOCKING_WRAPPERS:
+            return _BLOCKING_WRAPPERS[f.attr]
+        if f.attr == "join":
+            # Thread.join takes at most one (numeric) timeout; str.join
+            # takes exactly one iterable.  A constant-string receiver,
+            # multiple args, or an iterable-shaped argument is string
+            # work; a bare join, a numeric timeout, or a duration-named
+            # variable is the thread shape.
+            if isinstance(recv, ast.Constant):
+                return None
+            if len(call.args) > 1:
+                return None
+            if any(kw.arg == "timeout" for kw in call.keywords):
+                return "thread/process join"
+            if not call.args and not call.keywords:
+                return "thread/process join"
+            if len(call.args) == 1:
+                a = call.args[0]
+                if isinstance(a, ast.Constant) and \
+                        isinstance(a.value, (int, float)):
+                    return "thread/process join"
+                if isinstance(a, ast.Name) and _duration_name(a.id):
+                    return "thread/process join"
+            return None
+        if f.attr == "sleep" and isinstance(recv, ast.Name) \
+                and recv.id == "time":
+            return _sleep_desc(call)
+        if isinstance(recv, ast.Name) and recv.id == "subprocess" \
+                and f.attr in _SUBPROCESS_CALLS:
+            return f"subprocess.{f.attr}"
+        if f.attr in _NET_FUNCS:
+            return f"network call .{f.attr}()"
+        if isinstance(recv, ast.Name) and recv.id in _NET_MODULES:
+            return f"{recv.id}.{f.attr} network call"
+        if isinstance(recv, ast.Attribute) and \
+                isinstance(recv.value, ast.Name) and \
+                recv.value.id in _NET_MODULES:
+            return f"{recv.value.id}.{recv.attr}.{f.attr} network call"
+    elif isinstance(f, ast.Name):
+        if f.id == "sleep":
+            return _sleep_desc(call)
+        if f.id in _NET_FUNCS:
+            return f"network call {f.id}()"
+        if f.id == "Popen":
+            return "subprocess.Popen"
+    return None
+
+
+def _duration_name(name: str) -> bool:
+    """Does a variable name read as a duration (``timeout``, ``grace_s``,
+    ``RELAUNCH_JOIN_S``)?  A bare ``_s``-substring test flagged ordinary
+    ``sep.join(parts_s)`` string work — lowercase names must carry a
+    duration word; ALL-CAPS ``*_S`` module constants count."""
+    low = name.lower()
+    if any(t in low for t in ("timeout", "grace", "deadline")):
+        return True
+    return name.isupper() and name.endswith("_S")
+
+
+def _sleep_desc(call: ast.Call) -> str | None:
+    """Only constant sleeps at/over the threshold are flagged — a
+    bounded 5 ms poll tick under a lock is a deliberate idiom here, and
+    a non-constant duration cannot be judged statically."""
+    if call.args and isinstance(call.args[0], ast.Constant):
+        v = call.args[0].value
+        if isinstance(v, (int, float)) and v >= SLEEP_THRESHOLD_S:
+            return f"time.sleep({v:g}) >= {SLEEP_THRESHOLD_S:g}s threshold"
+    return None
+
+
+class _Scanner:
+    """One traversal serves both rules: walk every function with a
+    held-locks stack, emitting blocking findings and acquisition-order
+    edges as they appear."""
+
+    def __init__(self, analysis: Analysis):
+        self.analysis = analysis
+        self.blocking: list[Finding] = []
+        # (lock_a, lock_b) -> (mod, line, context) for the module graph
+        self.edges: dict[tuple[str, str], tuple] = {}
+        self.reacquire: list[Finding] = []
+        self._visited: set[tuple] = set()
+        # blocking findings dedupe globally by key: a shared helper
+        # reached from two modules is ONE defect, and _visited resets
+        # per module (the order graph is per-module — a cross-module
+        # memo silently dropped edges depending on scan order)
+        self._blocking_seen: set[tuple[str, str]] = set()
+
+    def scan_module(self, mod):
+        self.edges = {}
+        self.reacquire = []
+        self._visited = set()
+        for qual, info in self.analysis.functions(mod).items():
+            if isinstance(info.node, ast.Lambda):
+                continue
+            self._scan(mod, info, info.node.body, held=(), depth=0)
+        return self._cycle_findings(mod)
+
+    def _blocking_finding(self, mod, info, call, desc, held) -> None:
+        key = f"{info.qualname}:{held[-1][1]}:{desc}"
+        if (mod.rel, key) in self._blocking_seen:
+            return
+        self._blocking_seen.add((mod.rel, key))
+        self.blocking.append(Finding(
+            BLOCKING_RULE, mod.rel, call.lineno,
+            f"{desc} inside `with {held[-1][1]}:` in {info.qualname} — "
+            "callbacks or threads needing that lock can never finish "
+            "what this is waiting for; move the wait outside the lock",
+            key=key))
+
+    # -- traversal ---------------------------------------------------------
+
+    def _scan(self, mod, info: FuncInfo, body: list[ast.stmt],
+              held: tuple, depth: int,
+              consts: dict | None = None) -> None:
+        consts = consts or {}
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.If):
+                # same constant-kwarg pruning as the engine's
+                # live_statements: descending into drain(wait=False)
+                # must analyze only the lock-free arm-only path, not the
+                # blocking wait=True body it never reaches
+                verdict = _const_test(stmt.test, consts)
+                if verdict is True:
+                    self._scan(mod, info, stmt.body, held, depth, consts)
+                    if _terminates(stmt.body):
+                        return
+                    continue
+                if verdict is False:
+                    self._scan(mod, info, stmt.orelse, held, depth, consts)
+                    if stmt.orelse and _terminates(stmt.orelse):
+                        return
+                    continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                # the context expressions themselves evaluate under any
+                # OUTER held locks — `with urlopen(url):` inside a lock
+                # region is a network call under the lock
+                if held:
+                    self._check_calls(mod, info, stmt, held, depth,
+                                      consts)
+                acquired = []
+                for item in stmt.items:
+                    kind, name = self.analysis.lock_kind(
+                        mod, info.class_name, item.context_expr)
+                    if kind is None:
+                        continue
+                    if held:
+                        edge = (held[-1][1], name)
+                        if edge not in self.edges:
+                            self.edges[edge] = (mod, stmt.lineno,
+                                                info.qualname)
+                    if kind == "lock" and any(h[1] == name for h in held):
+                        self.reacquire.append(Finding(
+                            ORDER_RULE, mod.rel, stmt.lineno,
+                            f"{info.qualname} re-acquires non-reentrant "
+                            f"lock {name} it already holds — guaranteed "
+                            "self-deadlock on this path",
+                            key=f"reacquire:{info.qualname}:{name}"))
+                    acquired.append((kind, name))
+                self._scan(mod, info, stmt.body, held + tuple(acquired),
+                           depth, consts)
+                continue
+            if held:
+                self._check_calls(mod, info, stmt, held, depth, consts)
+            # recurse into compound statements with the same held set
+            for sub in sub_suites(stmt):
+                self._scan(mod, info, sub, held, depth, consts)
+
+    def _check_calls(self, mod, info: FuncInfo, stmt: ast.stmt,
+                     held: tuple, depth: int, consts: dict) -> None:
+        """Blocking-call check + bounded callee descent for one
+        statement's own expressions (held is non-empty)."""
+        for call in calls_in(stmt):
+            desc = _blocking_desc(call)
+            if desc is not None:
+                self._blocking_finding(mod, info, call, desc, held)
+                continue
+            callee = self.analysis.resolve_call(mod, info, call)
+            if callee is not None and depth < 2 and \
+                    not isinstance(callee.node, ast.Lambda):
+                ccon = call_consts(call, callee)
+                vkey = (callee.module.rel, callee.qualname,
+                        tuple(h[1] for h in held),
+                        tuple(sorted(ccon.items())))
+                if vkey not in self._visited:
+                    self._visited.add(vkey)
+                    self._scan(callee.module, callee,
+                               callee.node.body, held, depth + 1, ccon)
+
+    # -- cycles ------------------------------------------------------------
+
+    def _cycle_findings(self, mod) -> list[Finding]:
+        graph: dict[str, set[str]] = {}
+        for (a, b) in self.edges:
+            if a != b:
+                graph.setdefault(a, set()).add(b)
+        in_cycle: set[tuple[str, str]] = set()
+        for (a, b) in self.edges:
+            if a != b and self._reaches(graph, b, a):
+                in_cycle.add((a, b))
+        out = list(self.reacquire)
+        for (a, b) in sorted(in_cycle):
+            m, line, context = self.edges[(a, b)]
+            out.append(Finding(
+                ORDER_RULE, m.rel, line,
+                f"lock-order cycle: {context} acquires {b} while holding "
+                f"{a}, but elsewhere in this module {a} is acquired "
+                f"under {b} — two threads taking the locks in opposite "
+                "orders deadlock",
+                key=f"cycle:{a}->{b}"))
+        return out
+
+    @staticmethod
+    def _reaches(graph: dict[str, set[str]], src: str, dst: str) -> bool:
+        seen, stack = set(), [src]
+        while stack:
+            n = stack.pop()
+            if n == dst:
+                return True
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(graph.get(n, ()))
+        return False
+
+
+def _scan_all(analysis: Analysis) -> tuple[list[Finding], list[Finding]]:
+    """One traversal serves both rules (memoized on the Analysis
+    instance — the default run invokes both, and the held-lock
+    call-graph walk is the engine's heaviest pass)."""
+    cached = getattr(analysis, "_lock_scan", None)
+    if cached is not None:
+        return cached
+    sc = _Scanner(analysis)
+    order: list[Finding] = []
+    for mod in analysis.modules:
+        order.extend(sc.scan_module(mod))
+    analysis._lock_scan = (sc.blocking, order)
+    return analysis._lock_scan
+
+
+def check_blocking(analysis: Analysis):
+    return _scan_all(analysis)[0]
+
+
+def check_order(analysis: Analysis):
+    return _scan_all(analysis)[1]

@@ -1,0 +1,236 @@
+"""metric-hygiene: the /metrics surface stays coherent by construction.
+
+Incident encoded (CHANGES.md, PR 8): ``router_request_latency_seconds``
+was built as a bare ``Summary(...)`` and never registered, so /metrics
+silently lost request latency exactly when ``--replicas`` turned on —
+found only because a review pass went looking.  Plus the general
+hygiene the registry cannot check across modules: the same name
+registered as two different instrument types (or with two different
+help strings) splits or silently shadows one series, and names outside
+the fleet prefix convention don't group in dashboards.
+
+Checks:
+
+1. **prefix convention** — every literally-named registration must
+   match ``^(serve|train|ft|router|obs|device|jit|supervisor)_``.
+2. **type conflict** — one name, two instrument types, anywhere in the
+   package.
+3. **help conflict** — one name, two different non-empty help strings
+   (the registry keeps the first and silently drops the second).
+4. **unregistered instrument** — a direct ``Summary(...)`` /
+   ``Counter(...)`` / ... construction whose literal name claims a
+   fleet prefix but is never registered in any registry: it looks like
+   a /metrics series and is invisible there (the lost-Summary bug).
+   Deliberately-private instruments use a non-fleet name (as
+   ``request_latency_s`` does) and stay silent.
+5. **dangling references** — metric-shaped literals (``<prefix>_*_
+   total|seconds|bytes|rate|ratio``) in the repo's tests or README that
+   no registration produces: the test or doc pins a series that does
+   not exist.
+
+Dynamic names are handled conservatively: f-string registrations
+become wildcard patterns; a module that registers through a variable
+(device-gauge tables) contributes its module-level string tables to the
+known-name set.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tpucfn.analysis.core import Analysis, Finding
+
+RULE_ID = "metric-hygiene"
+
+PREFIXES = ("serve", "train", "ft", "router", "obs", "device", "jit",
+            "supervisor")
+PREFIX_RE = re.compile(r"^(%s)_" % "|".join(PREFIXES))
+REF_RE = re.compile(
+    r"^(%s)_[a-z0-9_]*_(total|seconds|bytes|rate|ratio)$" % "|".join(PREFIXES))
+_README_TOKEN = re.compile(
+    r"\b(%s)_[a-z0-9_]*_(?:total|seconds|bytes|rate|ratio)\b"
+    % "|".join(PREFIXES))
+
+REG_METHODS = ("counter", "gauge", "summary", "histogram", "computed_gauge")
+INSTRUMENT_CLASSES = {"Counter": "counter", "Gauge": "gauge",
+                      "Summary": "summary", "Histogram": "histogram",
+                      "ComputedGauge": "computed_gauge"}
+
+
+def _literal_help(call: ast.Call, type_: str) -> str | None:
+    """The literal help string of a registration, if statically
+    visible.  computed_gauge takes (name, fn, help); the others take
+    (name, help)."""
+    pos = 2 if type_ == "computed_gauge" else 1
+    if len(call.args) > pos and isinstance(call.args[pos], ast.Constant) \
+            and isinstance(call.args[pos].value, str):
+        return call.args[pos].value
+    for kw in call.keywords:
+        if kw.arg == "help" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    return None
+
+
+def _joinedstr_pattern(node: ast.JoinedStr) -> str | None:
+    parts = []
+    for v in node.values:
+        if isinstance(v, ast.Constant):
+            parts.append(re.escape(str(v.value)))
+        else:
+            parts.append(r"[A-Za-z0-9_]+")
+    return "^" + "".join(parts) + "$"
+
+
+def check(analysis: Analysis):
+    registrations: list[tuple] = []  # (name, type, mod, line, help)
+    patterns: list[re.Pattern] = []
+    constructions: list[tuple] = []  # (name, type, mod, line)
+    registered_names: set[str] = set()
+
+    for mod in analysis.modules:
+        dynamic_reg = False
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in REG_METHODS \
+                    and node.args:
+                arg0 = node.args[0]
+                if isinstance(arg0, ast.Constant) \
+                        and isinstance(arg0.value, str):
+                    registrations.append(
+                        (arg0.value, f.attr, mod, node.lineno,
+                         _literal_help(node, f.attr)))
+                    registered_names.add(arg0.value)
+                elif isinstance(arg0, ast.JoinedStr):
+                    pat = _joinedstr_pattern(arg0)
+                    if pat:
+                        patterns.append(re.compile(pat))
+                else:
+                    dynamic_reg = True
+            elif isinstance(f, ast.Attribute) and f.attr == "register" \
+                    and node.args:
+                arg0 = node.args[0]
+                if isinstance(arg0, ast.Constant) \
+                        and isinstance(arg0.value, str):
+                    registered_names.add(arg0.value)
+                else:
+                    dynamic_reg = True
+            elif isinstance(f, ast.Name) and f.id in INSTRUMENT_CLASSES \
+                    and node.args:
+                arg0 = node.args[0]
+                if isinstance(arg0, ast.Constant) \
+                        and isinstance(arg0.value, str):
+                    constructions.append(
+                        (arg0.value, INSTRUMENT_CLASSES[f.id], mod,
+                         node.lineno))
+        if dynamic_reg:
+            # variable-named registrations: trust the module's own
+            # string tables (the _HBM_GAUGES pattern) as the name source
+            for node in mod.tree.body:
+                if isinstance(node, ast.Assign):
+                    for sub in ast.walk(node.value):
+                        if isinstance(sub, ast.Constant) \
+                                and isinstance(sub.value, str) \
+                                and PREFIX_RE.match(sub.value):
+                            registered_names.add(sub.value)
+
+    findings: list[Finding] = []
+
+    # 1. prefix convention
+    for name, type_, mod, line, _ in registrations:
+        if not PREFIX_RE.match(name):
+            findings.append(Finding(
+                RULE_ID, mod.rel, line,
+                f"metric {name!r} violates the fleet naming convention "
+                f"^({'|'.join(PREFIXES)})_ — out-of-family names do not "
+                "group in dashboards and bypass fleet-wide checks",
+                key=f"prefix:{name}"))
+
+    # 2./3. same name, different type / help
+    by_name: dict[str, list[tuple]] = {}
+    for reg in registrations:
+        by_name.setdefault(reg[0], []).append(reg)
+    for name, regs in by_name.items():
+        types = {r[1] for r in regs}
+        if len(types) > 1:
+            first_type = regs[0][1]
+            for r in regs:
+                if r[1] != first_type:
+                    findings.append(Finding(
+                        RULE_ID, r[2].rel, r[3],
+                        f"metric {name!r} registered as {r[1]} here but "
+                        f"as {first_type} in {regs[0][2].rel} — the "
+                        "registry raises at runtime, and only on the "
+                        "code path that loses the race",
+                        key=f"type:{name}:{r[1]}"))
+        helps = [r for r in regs if r[4]]
+        distinct = {r[4] for r in helps}
+        if len(distinct) > 1:
+            first_help = helps[0][4]
+            for r in helps:
+                if r[4] != first_help:
+                    findings.append(Finding(
+                        RULE_ID, r[2].rel, r[3],
+                        f"metric {name!r} registered with a different "
+                        f"help string than in {helps[0][2].rel} — the "
+                        "registry keeps the first and silently drops "
+                        "this one",
+                        key=f"help:{name}"))
+
+    # 4. fleet-named instrument never registered (the lost-Summary bug)
+    for name, type_, mod, line in constructions:
+        if PREFIX_RE.match(name) and name not in registered_names \
+                and not any(p.match(name) for p in patterns):
+            findings.append(Finding(
+                RULE_ID, mod.rel, line,
+                f"{type_} {name!r} is constructed directly but never "
+                "registered in any MetricRegistry — it claims a fleet "
+                "metric name yet /metrics will not expose it (register "
+                "it, or use a non-fleet name for a private instrument)",
+                key=f"unregistered:{name}"))
+
+    # 5. dangling references in tests / README
+    def _known(name: str) -> bool:
+        return name in registered_names or \
+            any(p.match(name) for p in patterns)
+
+    if analysis.tests_dir is not None:
+        for p in sorted(analysis.tests_dir.glob("*.py")):
+            try:
+                tree = ast.parse(p.read_text(encoding="utf-8",
+                                             errors="replace"))
+            except SyntaxError:
+                continue
+            rel = p.relative_to(analysis.repo_root).as_posix()
+            seen: set[str] = set()
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str) \
+                        and REF_RE.match(node.value) \
+                        and not _known(node.value) \
+                        and node.value not in seen:
+                    seen.add(node.value)
+                    findings.append(Finding(
+                        RULE_ID, rel, node.lineno,
+                        f"test references metric {node.value!r} which no "
+                        "registration in the package produces — the "
+                        "series this pins does not exist",
+                        key=f"ref:{node.value}"))
+    if analysis.readme is not None:
+        rel = analysis.readme.relative_to(analysis.repo_root).as_posix()
+        seen = set()
+        for i, line_text in enumerate(
+                analysis.readme.read_text(errors="replace").splitlines(), 1):
+            for m in _README_TOKEN.finditer(line_text):
+                name = m.group(0)
+                if not _known(name) and name not in seen:
+                    seen.add(name)
+                    findings.append(Finding(
+                        RULE_ID, rel, i,
+                        f"README documents metric {name!r} which no "
+                        "registration in the package produces",
+                        key=f"ref:{name}"))
+    return findings

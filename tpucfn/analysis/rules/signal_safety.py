@@ -1,0 +1,177 @@
+"""signal-safety: no non-reentrant lock acquisition reachable from a
+signal handler.
+
+The incidents this encodes (CHANGES.md): PR 6 shipped a flight-dump
+SIGTERM handler that self-deadlocked at ``stop_all`` time because the
+handler ran on the main thread *inside* a ``record()`` critical section
+guarded by a plain ``threading.Lock`` (fixed by making it an RLock);
+PR 8's first ``Server.drain(wait=False)`` — the SIGTERM drain hook —
+acquired the non-reentrant server lock the interrupted frame already
+held, deadlocking the process at the exact moment it tried to die
+gracefully (fixed by making the arm-only path lock-free).
+
+The rule finds every handler installed via ``signal.signal(sig, h)``
+(including handlers defined inside ``install_dump_handlers``-style
+installers) and walks the call graph out of it.  Any function reachable
+from the handler that acquires a ``threading.Lock`` (``with self._lock``
+or ``.acquire()``) is a finding; ``RLock`` and conditions over RLocks
+are exempt — reentrancy is precisely the property that makes them
+signal-safe here.  Constant keyword arguments prune branches: a call
+like ``drain(wait=False)`` analyzes only the early-return arm-only path
+(the fixed shape), not the lock-taking ``wait=True`` body it never
+reaches.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpucfn.analysis.core import (
+    Analysis,
+    Finding,
+    FuncInfo,
+    call_consts,
+    calls_in,
+    live_statements,
+)
+
+RULE_ID = "signal-safety"
+_MAX_DEPTH = 8
+
+
+def _is_signal_install(call: ast.Call, aliases: tuple[frozenset[str],
+                                                      frozenset[str]]) -> bool:
+    """``signal.signal(sig, h)`` where the receiver is an import alias
+    of the :mod:`signal` module (``import signal`` / ``import signal as
+    _signal``), or the bare-name form from ``from signal import
+    signal``.  Requiring the receiver to resolve keeps event-bus-style
+    ``obj.signal(name, cb)`` APIs out of the rule."""
+    if len(call.args) < 2:
+        return False
+    module_aliases, name_aliases = aliases
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "signal" \
+            and isinstance(f.value, ast.Name):
+        return f.value.id in module_aliases
+    return isinstance(f, ast.Name) and f.id in name_aliases
+
+
+def _signal_aliases(mod) -> tuple[frozenset[str], frozenset[str]]:
+    """``(module_aliases, name_aliases)`` under which this module can
+    reach ``signal.signal``."""
+    mods, names = set(), set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "signal":
+                    mods.add(a.asname or a.name)
+        elif isinstance(node, ast.ImportFrom) and node.module == "signal":
+            for a in node.names:
+                if a.name == "signal":
+                    names.add(a.asname or a.name)
+    return frozenset(mods), frozenset(names)
+
+
+def _handler_info(analysis: Analysis, mod, caller: FuncInfo,
+                  arg: ast.expr) -> FuncInfo | None:
+    funcs = analysis.functions(mod)
+    if isinstance(arg, ast.Name):
+        nested = f"{caller.qualname}.{arg.id}"
+        return funcs.get(nested) or funcs.get(arg.id)
+    if isinstance(arg, ast.Attribute) and isinstance(arg.value, ast.Name):
+        if arg.value.id == "self" and caller.class_name is not None:
+            return analysis._method(mod, caller.class_name, arg.attr)
+    if isinstance(arg, ast.Lambda):
+        return FuncInfo(f"{caller.qualname}.<lambda>", arg, mod,
+                        caller.class_name)
+    return None
+
+
+def _body(info: FuncInfo) -> list[ast.stmt]:
+    if isinstance(info.node, ast.Lambda):
+        e = ast.Expr(value=info.node.body)
+        ast.copy_location(e, info.node.body)
+        return [e]
+    return info.node.body
+
+
+def check(analysis: Analysis):
+    findings: list[Finding] = []
+    for mod in analysis.modules:
+        aliases = _signal_aliases(mod)
+        if not any(aliases):
+            continue  # module cannot install a signal handler
+        # module-scope installs included: a top-level
+        # ``signal.signal(...)`` arms a handler just as surely as one
+        # inside a function
+        scopes: list[FuncInfo] = [
+            FuncInfo("<module>", mod.tree, mod)]  # type: ignore[arg-type]
+        for qual, info in analysis.functions(mod).items():
+            if not isinstance(info.node, ast.Lambda):
+                scopes.append(info)
+        for info in scopes:
+            for stmt in live_statements(info.node.body):
+                for call in calls_in(stmt):
+                    if not _is_signal_install(call, aliases):
+                        continue
+                    handler = _handler_info(analysis, mod, info,
+                                            call.args[1])
+                    if handler is not None:
+                        findings.extend(
+                            _walk_handler(analysis, handler))
+    return findings
+
+
+def _walk_handler(analysis: Analysis, handler: FuncInfo):
+    findings: list[Finding] = []
+    seen: set[tuple] = set()
+    work: list[tuple[FuncInfo, dict, int]] = [(handler, {}, 0)]
+    flagged: set[tuple[str, str]] = set()
+    while work:
+        info, consts, depth = work.pop()
+        key = (info.module.rel, info.qualname,
+               tuple(sorted(consts.items())))
+        if key in seen or depth > _MAX_DEPTH:
+            continue
+        seen.add(key)
+        mod = info.module
+        for stmt in live_statements(_body(info), consts):
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    kind, name = analysis.lock_kind(
+                        mod, info.class_name, item.context_expr)
+                    if kind == "lock" and (info.qualname, name) \
+                            not in flagged:
+                        flagged.add((info.qualname, name))
+                        findings.append(Finding(
+                            RULE_ID, mod.rel, stmt.lineno,
+                            f"{info.qualname} acquires non-reentrant "
+                            f"lock {name} and is reachable from signal "
+                            f"handler {handler.qualname} — if the signal "
+                            "interrupts a frame already holding it, the "
+                            "process deadlocks while trying to die; use "
+                            "an RLock, a lock-free arm-only path, or "
+                            "defer to the main loop",
+                            key=f"{handler.qualname}->{info.qualname}"
+                                f":{name}"))
+            for call in calls_in(stmt):
+                f = call.func
+                if isinstance(f, ast.Attribute) and f.attr == "acquire":
+                    kind, name = analysis.lock_kind(
+                        mod, info.class_name, f.value)
+                    if kind == "lock" and (info.qualname, name) \
+                            not in flagged:
+                        flagged.add((info.qualname, name))
+                        findings.append(Finding(
+                            RULE_ID, mod.rel, call.lineno,
+                            f"{info.qualname} calls .acquire() on "
+                            f"non-reentrant lock {name} and is reachable "
+                            f"from signal handler {handler.qualname}",
+                            key=f"{handler.qualname}->{info.qualname}"
+                                f":{name}"))
+                callee = analysis.resolve_call(mod, info, call)
+                if callee is not None and not isinstance(callee.node,
+                                                         ast.Lambda):
+                    work.append((callee, call_consts(call, callee),
+                                 depth + 1))
+    return findings

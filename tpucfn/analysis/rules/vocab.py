@@ -1,0 +1,231 @@
+"""vocab-drift: stringly-typed vocabularies stay on their canonical set.
+
+The repo's control planes speak in string literals: ft incident events
+(``events.jsonl`` rows with a ``kind``), the goodput ledger's record
+kinds, flight-dump headers, and ``ServeRequest.status`` terminal values.
+Before ISSUE 10 these were scattered literals across coordinator /
+router / frontend / postmortem — one typo'd emitter or consumer and an
+event silently never matches (the same drift ``HB_GLOB`` was introduced
+to stop for heartbeat file names in PR 5).
+
+Ground truth is read from the package itself, by ast — no imports:
+module-level tuples of strings whose name ends in ``_KINDS`` (e.g.
+``EVENT_KINDS`` in ``ft/events.py``, ``LEDGER_KINDS`` in
+``obs/goodput.py``) and the ``REQUEST_STATUSES`` tuple in
+``serve/frontend.py``.  The rule then flags:
+
+* ``x._event("lit", ...)`` emitters whose literal is outside
+  ``EVENT_KINDS`` — a kind nothing will ever match;
+* comparisons of ``rec.get("kind")`` / ``rec["kind"]`` / a bare ``kind``
+  variable against a literal outside the union of every ``*_KINDS``
+  vocabulary — a consumer waiting for an event that never comes;
+* ``.status`` assignments/comparisons (and ``status="lit"`` keywords)
+  whose literal is outside ``REQUEST_STATUSES``.
+
+A package that defines no canonical tuples gets no findings — the rule
+activates the moment the vocabulary is centralized.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpucfn.analysis.core import Analysis, Finding
+
+RULE_ID = "vocab-drift"
+
+
+def _collect_vocab(analysis: Analysis):
+    kinds_union: set[str] = set()
+    event_kinds: set[str] | None = None
+    statuses: set[str] | None = None
+    for mod in analysis.modules:
+        for node in mod.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                vals = _str_tuple(node.value)
+                if vals is None:
+                    continue
+                if t.id.endswith("_KINDS"):
+                    kinds_union.update(vals)
+                    if t.id == "EVENT_KINDS":
+                        event_kinds = set(vals)
+                elif t.id == "REQUEST_STATUSES":
+                    statuses = set(vals)
+    return event_kinds, kinds_union or None, statuses
+
+
+def _str_tuple(node: ast.expr) -> list[str] | None:
+    if not isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return None
+    out = []
+    for e in node.elts:
+        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+            out.append(e.value)
+        else:
+            return None
+    return out
+
+
+def _is_field_lookup(e: ast.expr, field: str) -> bool:
+    """``x.get("<field>")`` / ``x["<field>"]``."""
+    if isinstance(e, ast.Call) and isinstance(e.func, ast.Attribute) \
+            and e.func.attr == "get" and e.args \
+            and isinstance(e.args[0], ast.Constant) \
+            and e.args[0].value == field:
+        return True
+    return (isinstance(e, ast.Subscript)
+            and isinstance(e.slice, ast.Constant)
+            and e.slice.value == field)
+
+
+def _lookup_bound_names(scope_stmts, field: str) -> set[str]:
+    """Variable names assigned from a ``["<field>"]`` lookup inside this
+    scope — ``kind = e.get("kind")`` binds ``kind`` as a kind variable,
+    while an unrelated local that happens to be called ``kind`` (a lock
+    kind, a dataset kind) stays out of the vocabulary check."""
+    out: set[str] = set()
+    for stmt in scope_stmts:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Assign):
+                continue
+            values = [node.value]
+            if isinstance(node.value, ast.Tuple):
+                values = list(node.value.elts)
+            srcs = [any(_is_field_lookup(v, field)
+                        for v in ast.walk(val) if isinstance(v, ast.expr))
+                    for val in values]
+            targets = node.targets
+            if len(targets) == 1 and isinstance(targets[0], ast.Tuple):
+                targets = targets[0].elts
+            for t in targets:
+                if isinstance(t, ast.Name) and any(srcs):
+                    if t.id == field:
+                        out.add(t.id)
+    return out
+
+
+def _compared_literals(node: ast.Compare, match) -> list[str]:
+    """String literals compared (==, !=, in, not in) against a matching
+    lookup expression."""
+    sides = [node.left, *node.comparators]
+    if not any(match(s) for s in sides):
+        return []
+    out: list[str] = []
+    for s in sides:
+        if isinstance(s, ast.Constant) and isinstance(s.value, str):
+            out.append(s.value)
+        elif isinstance(s, (ast.Tuple, ast.List, ast.Set)):
+            vals = _str_tuple(s)
+            if vals:
+                out.extend(vals)
+    return out
+
+
+def _scope_walk(body):
+    """All nodes of a scope's statements, without descending into
+    nested function/class definitions (those are their own scopes)."""
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        stack: list[ast.AST] = [stmt]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def check(analysis: Analysis):
+    event_kinds, kinds_union, statuses = _collect_vocab(analysis)
+    findings: list[Finding] = []
+
+    def bad(mod, line, msg, key):
+        findings.append(Finding(RULE_ID, mod.rel, line, msg, key=key))
+
+    for mod in analysis.modules:
+        # ServeRequest.status is the serve plane's vocabulary; other
+        # planes have their own status-shaped fields (GCP op states).
+        check_status = statuses is not None and "serve/" in mod.rel
+        scopes = [mod.tree.body]
+        for qual, info in analysis.functions(mod).items():
+            if not isinstance(info.node, ast.Lambda):
+                scopes.append(info.node.body)
+        for body in scopes:
+            kind_vars = (_lookup_bound_names(body, "kind")
+                         if kinds_union is not None else set())
+            status_vars = (_lookup_bound_names(body, "status")
+                           if check_status else set())
+
+            def is_kind(e: ast.expr) -> bool:
+                if _is_field_lookup(e, "kind"):
+                    return True
+                return isinstance(e, ast.Name) and e.id in kind_vars
+
+            def is_status(e: ast.expr) -> bool:
+                if isinstance(e, ast.Attribute) and e.attr == "status":
+                    return True
+                if _is_field_lookup(e, "status"):
+                    return True
+                return isinstance(e, ast.Name) and e.id in status_vars
+
+            for node in _scope_walk(body):
+                if event_kinds is not None and isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "_event" and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    lit = node.args[0].value
+                    if lit not in event_kinds:
+                        bad(mod, node.lineno,
+                            f"event kind {lit!r} is not in the canonical "
+                            "EVENT_KINDS tuple — consumers matching on "
+                            "kind will never see it (add it to "
+                            "EVENT_KINDS or fix the typo)",
+                            f"event:{lit}")
+                if isinstance(node, ast.Compare):
+                    if kinds_union is not None:
+                        for lit in _compared_literals(node, is_kind):
+                            if lit not in kinds_union:
+                                bad(mod, node.lineno,
+                                    f"kind literal {lit!r} is outside "
+                                    "every canonical *_KINDS vocabulary "
+                                    "— this comparison can never match "
+                                    "an emitted record",
+                                    f"kind:{lit}")
+                    if check_status:
+                        for lit in _compared_literals(node, is_status):
+                            if lit not in statuses:
+                                bad(mod, node.lineno,
+                                    f"status literal {lit!r} is outside "
+                                    "the canonical REQUEST_STATUSES "
+                                    "tuple",
+                                    f"status:{lit}")
+                if not check_status:
+                    continue
+                lit = None
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Attribute) \
+                        and node.targets[0].attr == "status" \
+                        and isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, str):
+                    lit = node.value.value
+                elif isinstance(node, ast.keyword) \
+                        and node.arg == "status" \
+                        and isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, str):
+                    lit = node.value.value
+                if lit is not None and lit not in statuses:
+                    bad(mod, node.value.lineno,
+                        f"status literal {lit!r} is outside the "
+                        "canonical REQUEST_STATUSES tuple — routers and "
+                        "tests branching on status will never match it",
+                        f"status:{lit}")
+    return findings

@@ -929,6 +929,112 @@ def cmd_obs_diff(args) -> int:
     return 0
 
 
+def cmd_check(args) -> int:
+    """Static analysis (ISSUE 10): run the concurrency/fleet-invariant
+    rule pack over the package — jax-free, seconds, rc 1 on findings —
+    so the bug classes the repo has already shipped (signal-handler
+    deadlocks, joins under locks, unregistered metrics, vocabulary
+    drift) are machine-checked before every PR instead of rediscovered
+    by reviewers.  Exit codes: 0 clean, 1 findings, 2 usage error."""
+    import json as _json
+
+    from tpucfn.analysis import (apply_baseline, changed_files,
+                                 load_baseline, resolve_rules, run_check,
+                                 write_baseline)
+
+    if args.path:
+        package_root = Path(args.path).resolve()
+        if not package_root.is_dir():
+            print(f"error: {package_root} is not a directory",
+                  file=sys.stderr)
+            return 2
+    else:
+        import tpucfn
+
+        package_root = Path(tpucfn.__file__).resolve().parent
+    repo_root = package_root.parent
+
+    rules = None
+    if args.rules:
+        rules = [r for chunk in args.rules for r in chunk.split(",") if r]
+        try:
+            resolve_rules(rules)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+
+    # every pure usage error is decided BEFORE the (~2s) package scan
+    if args.update_baseline:
+        # a --diff or --rules run sees only a SUBSET of findings;
+        # rewriting the baseline from that partial view would silently
+        # drop every suppression the subset didn't reproduce
+        if args.diff is not None:
+            print("error: --update-baseline cannot run with --diff "
+                  "(a partial view would drop unrelated suppressions)",
+                  file=sys.stderr)
+            return 2
+        if rules is not None:
+            print("error: --update-baseline cannot run with --rules "
+                  "(the unselected rules' suppressions would be "
+                  "dropped)", file=sys.stderr)
+            return 2
+
+    baseline_path = Path(args.baseline) if args.baseline \
+        else repo_root / "runs" / "analysis_baseline.json"
+    baseline: dict = {}
+    if baseline_path.is_file():
+        try:
+            baseline = load_baseline(baseline_path)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    elif args.baseline and not args.update_baseline:
+        # an explicit baseline that doesn't exist is a typo'd path, not
+        # a clean slate — unless we're about to create it
+        print(f"error: baseline {baseline_path} not found", file=sys.stderr)
+        return 2
+
+    only = None
+    if args.diff is not None:
+        try:
+            only = changed_files(repo_root, args.diff)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+
+    findings = run_check(package_root, rules=rules, repo_root=repo_root,
+                         only=only)
+
+    if args.update_baseline:
+        p = write_baseline(baseline_path, findings, baseline)
+        print(f"baseline updated: {p} ({len(findings)} suppression(s); "
+              "fill in any TODO justifications before committing)")
+        return 0
+
+    active, suppressed, stale = apply_baseline(findings, baseline)
+    if args.json:
+        for f in active:
+            print(_json.dumps(f.to_json()))
+    else:
+        for f in active:
+            print(f"{f.path}:{f.line}: [{f.rule}] {f.message}  "
+                  f"(fingerprint {f.fingerprint})")
+        scope = f"{len(only)} changed file(s)" if only is not None \
+            else str(package_root)
+        print(f"tpucfn check: {len(active)} finding(s), "
+              f"{len(suppressed)} baselined, over {scope}",
+              file=sys.stderr)
+    # under --rules (or --diff) the unselected rules' suppressions look
+    # stale without being stale — and the prune hint would point at a
+    # command this partial view refuses
+    if stale and only is None and rules is None:
+        print(f"note: {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} no longer match any "
+              "finding — prune with --update-baseline",
+              file=sys.stderr)
+    return 1 if active else 0
+
+
 def cmd_ft_status(args) -> int:
     """Render the fault-tolerance plane's fleet view: per-host heartbeat
     verdicts (LIVE/STRAGGLER/SUSPECT/DEAD), the supervisor's ft_*
@@ -1287,6 +1393,36 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write request-lifecycle trace spans (queue_wait/"
                          "prefill/decode_round/request_done JSONL) to DIR")
     sv.set_defaults(fn=cmd_serve)
+
+    ck = sub.add_parser(
+        "check",
+        help="static analysis: concurrency/fleet-invariant rule pack "
+             "(signal safety, locks, metric hygiene, jax hazards, "
+             "vocabulary drift) — jax-free, rc 1 on findings")
+    ck.add_argument("path", nargs="?", default=None,
+                    help="package root to analyze (default: the "
+                         "installed tpucfn package)")
+    ck.add_argument("--json", action="store_true",
+                    help="one machine-readable JSON line per finding "
+                         "(file, line, rule, fingerprint, message)")
+    ck.add_argument("--rules", action="append", metavar="ID[,ID...]",
+                    help="run only these rules (repeatable / comma-"
+                         "separated); unknown ids are a usage error")
+    ck.add_argument("--baseline", metavar="PATH",
+                    help="suppression file (default runs/"
+                         "analysis_baseline.json next to the package); "
+                         "every entry needs a one-line justification")
+    ck.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to cover exactly the "
+                         "current findings (existing justifications are "
+                         "preserved; new entries get a TODO)")
+    ck.add_argument("--diff", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="report findings only in files changed vs the "
+                         "git ref (default HEAD); the whole package is "
+                         "still parsed so cross-module rules keep "
+                         "context")
+    ck.set_defaults(fn=cmd_check)
 
     ob = sub.add_parser(
         "obs",
