@@ -1,0 +1,27 @@
+# Build-time verification targets (ISSUE 11 satellite: `tpucfn check
+# --diff` belongs in the builder loop, not the review loop — it costs
+# ~2 s and is jax-free).  `make verify` is the full tier-1 recipe from
+# ROADMAP.md with the static gate in front.
+
+.PHONY: check tier1 verify
+
+# Static analysis over the files changed vs origin/main (the whole
+# package is still parsed, so cross-module rules keep context).  Falls
+# back to the full-package check when the ref is absent (fresh clone
+# without the seed remote).
+check:
+	@if git rev-parse --verify -q origin/main >/dev/null 2>&1; then \
+		python -m tpucfn.cli check --diff origin/main; \
+	else \
+		python -m tpucfn.cli check; \
+	fi
+
+# Tier-1 test suite (the ROADMAP.md recipe, verbatim semantics).
+tier1:
+	set -o pipefail; rm -f /tmp/_t1.log; \
+	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+		-m 'not slow' --continue-on-collection-errors \
+		-p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
+		| tee /tmp/_t1.log
+
+verify: check tier1

@@ -140,6 +140,111 @@ def bench_decode(jpeg_shards, raw_shards, batch: int, image_size: int,
     return out
 
 
+class _SleepDecode:
+    """Deterministic synthetic 'decode': a per-example sleep.  The
+    input-bound shape from the bench record (5.50 s loader vs 0.101 s
+    step), scaled down — sleep releases the GIL, so the service's
+    thread-pooled decode genuinely parallelizes it."""
+
+    def __init__(self, seconds: float):
+        self.seconds = seconds
+
+    def __call__(self, ex, rs):
+        time.sleep(self.seconds)
+        return ex
+
+
+def bench_service(tmp: Path, *, batches: int, batch: int, compute_s: float,
+                  decode_s: float, workers: int, num_shards: int = 4) -> dict:
+    """ISSUE 11 acceptance row: step time on a synthetic INPUT-BOUND
+    workload, three ways —
+
+    * ``prestaged_step_s``  every batch already in RAM (the floor:
+      pure 'compute'),
+    * ``loader_step_s``     the local single-threaded loader (decode
+      serializes with compute — the recorded stall, in miniature),
+    * ``served_step_s``     fed by an in-process InputService whose
+      decode runs ``workers`` wide and OVERLAPS compute through the
+      adaptive prefetcher.
+
+    ``ok`` gates the acceptance bound: served within 1.5x of prestaged.
+    The first few served steps pay the cold stream (no head start) and
+    are excluded from the steady-state mean, exactly like a compile
+    warmup step.
+    """
+    from tpucfn.data import write_dataset_shards
+    from tpucfn.data.pipeline import ShardedDataset
+    from tpucfn.data.service import (AdaptivePrefetcher, InputService,
+                                     ServiceBatchStream)
+
+    rs = np.random.RandomState(0)
+    d = tmp / "service"
+    d.mkdir()
+    n = batches * batch
+    shards = write_dataset_shards(
+        ({"x": rs.randn(64).astype(np.float32)} for _ in range(n)),
+        d, num_shards=num_shards)
+    tf = _SleepDecode(decode_s)
+    # the steady-state window must keep at least one sample, however
+    # small --service-batches is
+    warmup = min(3, max(0, batches - 1))
+
+    def steady(waits: list, steps: list) -> tuple[float, float]:
+        w, s = waits[warmup:], steps[warmup:]
+        step = sum(s) / len(s)
+        share = sum(w) / sum(s) if sum(s) else 0.0
+        return step, share
+
+    def drive(it) -> tuple[float, float]:
+        waits, steps = [], []
+        for _ in range(batches):
+            t0 = time.perf_counter()
+            next(it)
+            t_wait = time.perf_counter() - t0
+            time.sleep(compute_s)
+            waits.append(t_wait)
+            steps.append(time.perf_counter() - t0)
+        return steady(waits, steps)
+
+    def ds(**kw):
+        return ShardedDataset(shards, batch_size_per_process=batch, seed=0,
+                              process_index=0, process_count=1,
+                              transform=tf, **kw)
+
+    # prestaged floor: decode fully paid before the loop starts
+    staged = list(ds().epoch(0))[:batches]
+    t0 = time.perf_counter()
+    for _ in staged:
+        time.sleep(compute_s)
+    prestaged_step = (time.perf_counter() - t0) / len(staged)
+
+    loader_step, stall_local = drive(iter(ds().batches(None)))
+
+    svc = InputService(shards, num_trainers=1, batch_size_per_process=batch,
+                       seed=0, transform=tf, num_workers=workers,
+                       queue_batches=4, host="127.0.0.1").start()
+    try:
+        served_step, stall_served = drive(AdaptivePrefetcher(
+            ServiceBatchStream(svc.address, 0, process_count=1,
+                               batch_size=batch, seed=0)))
+    finally:
+        svc.close()
+    return {
+        "phase": "data_service",
+        "loader_step_s": round(loader_step, 5),
+        "served_step_s": round(served_step, 5),
+        "prestaged_step_s": round(prestaged_step, 5),
+        "stall_share_local": round(stall_local, 4),
+        "stall_share_served": round(stall_served, 4),
+        "batch": batch,
+        "batches": batches,
+        "decode_s_per_example": decode_s,
+        "compute_s": compute_s,
+        "service_workers": workers,
+        "ok": served_step <= 1.5 * prestaged_step,
+    }
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--examples", type=int, default=256)
@@ -149,10 +254,30 @@ def main() -> int:
     p.add_argument("--workers", type=int, default=8,
                    help="also measure the thread-pool decode path at this "
                         "worker count (0 skips)")
+    p.add_argument("--service", action="store_true",
+                   help="measure ONLY the disaggregated-input row "
+                        "(ISSUE 11): local loader vs service-fed vs "
+                        "prestaged step time on a synthetic input-bound "
+                        "workload; rc 1 unless served is within 1.5x of "
+                        "prestaged")
+    p.add_argument("--service-batches", type=int, default=24)
+    p.add_argument("--service-batch", type=int, default=16)
+    p.add_argument("--service-compute-ms", type=float, default=50.0)
+    p.add_argument("--service-decode-ms", type=float, default=4.0,
+                   help="synthetic per-example decode cost")
+    p.add_argument("--service-workers", type=int, default=8)
     args = p.parse_args()
 
     tmp = Path(tempfile.mkdtemp(prefix="tpucfn-data-bench-"))
     try:
+        if args.service:
+            row = bench_service(
+                tmp, batches=args.service_batches, batch=args.service_batch,
+                compute_s=args.service_compute_ms / 1e3,
+                decode_s=args.service_decode_ms / 1e3,
+                workers=args.service_workers)
+            print(json.dumps(row), flush=True)
+            return 0 if row["ok"] else 1
         raw = _write_raw_shards(tmp, args.examples, args.image_size,
                                 args.num_shards)
         jpeg = _write_jpeg_shards(tmp, args.examples, args.image_size,

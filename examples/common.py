@@ -314,7 +314,20 @@ def _train_loop_body(trainer, ds, mesh, args, items_per_step, extra_axes,
         metrics = {}
         step = int(state.step)
         with profile_steps(run_dir / "profile", enabled=args.profile):
-            batches = iter(prefetch_to_mesh(ds.batches(None), mesh,
+            # Disaggregated input plane (ISSUE 11): when the launcher
+            # fanned out input hosts (TPUCFN_INPUT_ADDRS), the local
+            # loader is swapped for the service client — resilient
+            # stream (failover, degrade-to-local at the exact cursor)
+            # behind a data_wait-driven adaptive prefetcher.  Without
+            # the env this is ds.batches(None), byte-for-byte as before.
+            from tpucfn.data.service import service_or_local_batches
+
+            stream = service_or_local_batches(
+                ds, num_epochs=None,
+                on_degrade=lambda reason: print(
+                    f"input plane degraded to local loading: {reason}",
+                    flush=True))
+            batches = iter(prefetch_to_mesh(stream, mesh,
                                             extra_axes=extra_axes))
             _end = object()
             while True:
@@ -389,6 +402,13 @@ def _train_loop_body(trainer, ds, mesh, args, items_per_step, extra_axes,
                     print(f"preemption drain: stopping cleanly at step "
                           f"{step}", flush=True)
                     break
+            # A step-target/drain exit leaves the (unbounded) service
+            # stream live: close it, or the prefetcher keeps buffering
+            # up to its byte bound and the input host keeps decoding
+            # batches nobody will consume through eval/final-save.
+            close_stream = getattr(stream, "close", None)
+            if close_stream is not None:
+                close_stream()
         run_eval(state, int(state.step))
         t0_ckpt = time.monotonic()
         if ckpt.save(int(state.step), state, force=True):

@@ -1,0 +1,123 @@
+"""Deterministic toy trainer for the disaggregated-input e2e drill
+(ISSUE 11).
+
+Numpy-only (no jax, no checkpoints — the input plane is orthogonal to
+both): consumes its batch stream through
+``service_or_local_batches`` — the service client with failover and
+degrade-to-local when ``TPUCFN_INPUT_ADDRS`` is fanned out, the plain
+local loader otherwise — and folds every batch into an exactly
+deterministic trajectory (``w ← 0.9·w + mean(batch.x)``) appended to a
+per-host JSONL.  Two runs agree bit-for-bit iff they consumed the same
+batch sequence, which is the drill's whole point: killing the input
+host mid-run must not change the numbers, only the ``data_wait``
+goodput bucket.
+
+The LOCAL dataset carries a per-example sleep 'decode' while the
+service streams pre-decoded batches — the input-bound shape from the
+bench record, in miniature.
+"""
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+from tpucfn.data.pipeline import ShardedDataset  # noqa: E402
+from tpucfn.data.service import service_or_local_batches  # noqa: E402
+from tpucfn.ft import HeartbeatWriter  # noqa: E402
+from tpucfn.obs.goodput import GoodputLedger  # noqa: E402
+
+
+class _SleepDecode:
+    """Value-preserving synthetic decode cost (consumes no RNG, so the
+    served stream — which skips it — stays bit-identical)."""
+
+    def __init__(self, seconds: float):
+        self.seconds = seconds
+
+    def __call__(self, ex, rs):
+        if self.seconds > 0:
+            time.sleep(self.seconds)
+        return ex
+
+
+def main() -> int:
+    host = int(os.environ.get("TPUCFN_HOST_ID", "0"))
+    trainers = int(os.environ["TPUCFN_WORKERS_COUNT"])
+    run_dir = Path(os.environ["INPUT_E2E_RUN_DIR"])
+    shards_dir = Path(os.environ["INPUT_E2E_SHARDS"])
+    batch = int(os.environ.get("INPUT_E2E_BATCH", "8"))
+    seed = int(os.environ.get("INPUT_E2E_SEED", "0"))
+    epochs = int(os.environ.get("INPUT_E2E_EPOCHS", "1"))
+    step_sleep = float(os.environ.get("INPUT_E2E_STEP_SLEEP", "0.05"))
+    decode_sleep = float(os.environ.get("INPUT_E2E_DECODE_SLEEP", "0.004"))
+    ft_dir = os.environ.get("TPUCFN_FT_DIR", "").strip()
+
+    hb = None
+    if ft_dir:
+        hb = HeartbeatWriter(
+            ft_dir, host_id=host, role="trainer",
+            interval_s=float(
+                os.environ.get("TPUCFN_FT_HEARTBEAT_S", "0.2") or 0.2)
+        ).start()
+    ledger = GoodputLedger(run_dir / "goodput", host_id=host,
+                           role="trainer")
+    run_dir.mkdir(parents=True, exist_ok=True)
+    mode = {"used_service": False, "degraded": False, "reason": ""}
+
+    def on_degrade(reason: str) -> None:
+        mode["degraded"] = True
+        mode["reason"] = reason
+        print(f"degraded to local loading: {reason}", flush=True)
+
+    ds = ShardedDataset(
+        sorted(shards_dir.glob("*.tpurec")),
+        batch_size_per_process=batch, seed=seed,
+        process_index=host, process_count=trainers,
+        transform=_SleepDecode(decode_sleep))
+    mode["used_service"] = bool(
+        (os.environ.get("TPUCFN_INPUT_ADDRS") or "").strip())
+    stream = service_or_local_batches(ds, num_epochs=epochs,
+                                      on_degrade=on_degrade)
+    losses = run_dir / f"losses-host{host:03d}.jsonl"
+    w = 10.0
+    step = 0
+    try:
+        with open(losses, "a") as f:
+            while True:
+                t0_wait = time.monotonic()
+                b = next(stream, None)
+                t_wait = time.monotonic() - t0_wait
+                if b is None:
+                    break
+                step += 1
+                if t_wait >= 1e-4:
+                    ledger.account("data_wait", t_wait, step=step)
+                t0_step = time.monotonic()
+                w = 0.9 * w + float(np.mean(b["x"]))
+                f.write(json.dumps({"step": step, "w": w}) + "\n")
+                f.flush()
+                if hb is not None:
+                    hb.update_step(step)
+                time.sleep(step_sleep)
+                ledger.account("step", time.monotonic() - t0_step,
+                               step=step)
+    finally:
+        close_stream = getattr(stream, "close", None)
+        if close_stream is not None:
+            close_stream()
+        (run_dir / f"mode-host{host:03d}.json").write_text(
+            json.dumps({**mode, "steps": step}))
+        if hb is not None:
+            hb.stop()
+        ledger.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -958,3 +958,96 @@ class S:
         edges_by_mod[mod.rel] = set(sc.edges)
     assert ("S._lock", "Z._ring") in edges_by_mod["pkg/a.py"]
     assert ("S._lock", "Z._ring") in edges_by_mod["pkg/b.py"]
+
+
+# -- registry-cardinality ---------------------------------------------------
+
+# The shape ISSUE 11's input service would have shipped without the
+# rule: one gauge name per fleet member, registered in a loop.
+CARDINALITY_BUG = '''
+class Service:
+    def __init__(self, registry, num_trainers):
+        for i in range(num_trainers):
+            registry.gauge(f"input_host_queue_{i}",
+                           "queued batches for trainer i")
+'''
+
+# The shipped fix: ONE aggregate series over all members.
+CARDINALITY_FIXED = '''
+class Service:
+    def __init__(self, registry, streams):
+        registry.computed_gauge(
+            "input_queue_depth",
+            lambda: float(sum(len(s.queue) for s in streams)),
+            "batches buffered across all trainer streams")
+'''
+
+
+def test_cardinality_fires_on_loop_variable_name_family(tmp_path):
+    fs = check(tmp_path, {"svc.py": CARDINALITY_BUG},
+               rules=["registry-cardinality"])
+    assert len(fs) == 1
+    assert fs[0].rule == "registry-cardinality"
+    assert "input_host_queue_" in fs[0].message
+    assert "'i'" in fs[0].message
+
+
+def test_cardinality_silent_on_aggregate_series(tmp_path):
+    assert check(tmp_path, {"svc.py": CARDINALITY_FIXED},
+                 rules=["registry-cardinality"]) == []
+
+
+def test_cardinality_fires_inside_comprehensions_and_direct_builds(tmp_path):
+    src = '''
+import threading
+
+
+def build(registry, replicas):
+    gauges = [registry.counter(f"router_sent_{r}_total") for r in replicas]
+    return gauges
+
+
+def direct(ids):
+    return [Summary(f"serve_lat_{i}_seconds") for i in ids]
+'''
+    fs = check(tmp_path, {"m.py": src}, rules=["registry-cardinality"])
+    assert len(fs) == 2
+    assert {("'r'" in f.message or "'i'" in f.message) for f in fs} == {True}
+
+
+def test_cardinality_silent_on_config_formatted_names(tmp_path):
+    """f-strings over non-loop values (a role prefix, a constant) are
+    one series, not a fleet family."""
+    src = '''
+def build(registry, role):
+    registry.gauge(f"{role}_queue_depth", "per-role depth")
+    suffix = "bytes"
+    registry.counter(f"input_streamed_{suffix}_total")
+'''
+    assert check(tmp_path, {"m.py": src},
+                 rules=["registry-cardinality"]) == []
+
+
+def test_cardinality_loop_var_does_not_leak_into_nested_defs(tmp_path):
+    """A def inside a loop runs later on its own frame — registering a
+    constant-named metric from it is not fleet-scaled."""
+    src = '''
+def build(registry, hosts):
+    fns = []
+    for h in hosts:
+        def make():
+            registry.gauge("input_active_streams", "one series")
+        fns.append(make)
+    return fns
+'''
+    assert check(tmp_path, {"m.py": src},
+                 rules=["registry-cardinality"]) == []
+
+
+def test_cardinality_fingerprint_stable_under_line_motion(tmp_path):
+    a = check(tmp_path, {"svc.py": CARDINALITY_BUG},
+              rules=["registry-cardinality"])[0]
+    b = check(tmp_path, {"svc.py": "# moved\n# down\n" + CARDINALITY_BUG},
+              rules=["registry-cardinality"])[0]
+    assert a.fingerprint == b.fingerprint
+    assert a.line != b.line

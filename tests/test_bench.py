@@ -320,3 +320,33 @@ def test_serve_bench_availability_row_schema():
     # generous deadline on CPU: the kill must be absorbed, not paid for
     assert d["availability"] >= 0.99
     assert d["router"]["failed"] == 0
+
+
+def test_data_bench_service_row_schema():
+    """ISSUE 11 CI satellite: `data_bench --service` emits the
+    disaggregated-input comparison row — local loader vs service-fed vs
+    prestaged step time with stall shares — and gates rc on the
+    served-within-1.5x-of-prestaged acceptance bound.  Tiny synthetic
+    sleeps keep it fast; only the schema and the ordering invariants
+    (loader stalls, served does not) are pinned, not absolute times."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, str(REPO / "benches" / "data_bench.py"),
+         "--service", "--service-batches", "12", "--service-batch", "8",
+         "--service-compute-ms", "30", "--service-decode-ms", "3",
+         "--service-workers", "8"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, f"stderr:\n{r.stderr[-2000:]}"
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["phase"] == "data_service"
+    for key in ("loader_step_s", "served_step_s", "prestaged_step_s",
+                "stall_share_local", "stall_share_served", "batch",
+                "batches", "decode_s_per_example", "compute_s",
+                "service_workers", "ok"):
+        assert key in rec, (key, rec)
+    # the local loader pays decode serially; the served path must not
+    assert rec["stall_share_local"] > 0.2
+    assert rec["stall_share_served"] < rec["stall_share_local"]
+    assert rec["served_step_s"] < rec["loader_step_s"]
+    assert rec["ok"] is True  # served within 1.5x of prestaged (rc gate)

@@ -267,3 +267,109 @@ def test_multiprocess_loader_detects_killed_worker(tmp_path):
         while True:
             loader._get(0, timeout_s=0.2)
             loader._get(1, timeout_s=0.2)
+
+
+# -- MultiProcessLoader shutdown / torn-queue edges (ISSUE 11 satellite) ----
+# The disaggregated input service reuses these exact paths per trainer
+# stream, so they are pinned here rather than rediscovered over a socket.
+
+
+class _SlowTransform:
+    """Module-level so spawn can pickle it by reference."""
+
+    def __init__(self, seconds):
+        self.seconds = seconds
+
+    def __call__(self, ex, rs):
+        import time
+
+        time.sleep(self.seconds)
+        return ex
+
+
+@pytest.mark.slow
+def test_multiprocess_loader_worker_death_surfaces_via_batches(tmp_path):
+    """The public batches() path (not just _get) must raise the clean
+    dead-worker error when a worker is killed mid-batch without posting
+    — the stream must never hang the consumer."""
+    import pytest
+
+    from tpucfn.data.pipeline import MultiProcessLoader
+
+    shards = _mp_shards(tmp_path, n=96, num_shards=6)
+    loader = MultiProcessLoader(shards, num_workers=2, process_index=0,
+                                process_count=1, batch_size_per_process=4,
+                                prefetch=1,
+                                transform=_SlowTransform(0.02))
+    it = loader.batches(None)
+    next(it)  # workers up and producing
+    for p in loader._procs:
+        p.kill()  # OOM-killer shape: no "error" message posted
+    with pytest.raises(RuntimeError, match="died"):
+        for _ in range(10_000):
+            next(it)
+
+
+@pytest.mark.slow
+def test_multiprocess_loader_close_during_iteration(tmp_path):
+    """close() from another thread mid-iteration (the input service's
+    stream teardown) ends the iteration with a clean RuntimeError, not
+    an IndexError on the torn queue list — and close is idempotent."""
+    import threading
+
+    import pytest
+
+    from tpucfn.data.pipeline import MultiProcessLoader
+
+    shards = _mp_shards(tmp_path, n=96, num_shards=6)
+    loader = MultiProcessLoader(shards, num_workers=2, process_index=0,
+                                process_count=1, batch_size_per_process=4,
+                                prefetch=1,
+                                transform=_SlowTransform(0.01))
+    it = loader.batches(None)
+    next(it)
+    t = threading.Thread(target=loader.close)
+    t.start()
+    with pytest.raises(RuntimeError, match="closed|died"):
+        for _ in range(10_000):
+            next(it)
+    t.join(timeout=10)
+    assert not t.is_alive()
+    loader.close()  # double close is a no-op
+    assert loader._procs == [] and loader._queues == []
+
+
+@pytest.mark.slow
+def test_multiprocess_loader_get_timeout_polls_until_batch(tmp_path):
+    """_get with a timeout shorter than the batch build time polls
+    through queue.Empty cycles while the worker is ALIVE and returns
+    the batch — a slow worker is slow, not dead."""
+    from tpucfn.data.pipeline import MultiProcessLoader
+
+    shards = _mp_shards(tmp_path, n=16, num_shards=2)
+    loader = MultiProcessLoader(shards, num_workers=2, process_index=0,
+                                process_count=1, batch_size_per_process=8,
+                                prefetch=1,
+                                transform=_SlowTransform(0.05))
+    try:
+        loader._start(1)
+        tag, payload = loader._get(0, timeout_s=0.05)
+        assert tag == "batch"
+        assert payload["uid"].shape == (8,)
+    finally:
+        loader.close()
+
+
+@pytest.mark.slow
+def test_multiprocess_loader_get_after_close_raises_cleanly(tmp_path):
+    import pytest
+
+    from tpucfn.data.pipeline import MultiProcessLoader
+
+    shards = _mp_shards(tmp_path)
+    loader = MultiProcessLoader(shards, num_workers=2, process_index=0,
+                                process_count=1, batch_size_per_process=4)
+    loader._start(1)
+    loader.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        loader._get(0, timeout_s=0.05)

@@ -720,3 +720,112 @@ def test_dead_process_detection_latency(tmp_path):
     # second of detection latency is a polling bug
     assert elapsed < 3.0
     assert registry.varz()["metrics"]["supervisor_last_exit_code"] == -9
+
+
+# -- disaggregated input plane (ISSUE 11) -----------------------------------
+
+def _input_launcher(tmp_path, n=3, input_argv=None, **kw) -> Launcher:
+    return Launcher(_contract(tmp_path, n), LocalTransport(),
+                    input_hosts=1,
+                    input_argv=input_argv or [
+                        sys.executable, "-c", "import time; time.sleep(60)"],
+                    **kw)
+
+
+def test_dead_input_host_degrades_without_gang_restart(tmp_path):
+    """Chaos-killing the input host records input_degraded and nothing
+    else: no detect/decide incident, no relaunch, budget untouched, the
+    trainers run to completion and the run exits 0."""
+    from tpucfn.obs import MetricRegistry
+
+    ft_dir = tmp_path / "ft"
+    registry = MetricRegistry()
+    coord = GangCoordinator(
+        _input_launcher(tmp_path),
+        [sys.executable, "-c", "import time; time.sleep(1.0)"],
+        policy=GangRestart(RestartBudget(0)), registry=registry,
+        ft_dir=ft_dir, poll_interval=0.02, term_grace_s=1.0,
+        kill_host_after=(2, 0.3))
+    assert coord.run() == 0
+    kinds = _kinds(ft_dir)
+    assert "input_degraded" in kinds
+    assert "detect" not in kinds and "recovered" not in kinds
+    assert "input_recovered" not in kinds  # restart off by default
+    assert coord.policy.budget.used == 0
+    v = registry.varz()["metrics"]
+    assert v["ft_input_degradations_total"] == 1
+    assert v["supervisor_restarts_total"] == 0
+    degraded = next(e for e in _events(ft_dir)
+                    if e["kind"] == "input_degraded")
+    assert degraded["host"] == 2
+    assert degraded["failure"] == "crash"
+
+
+@pytest.mark.slow
+def test_input_host_restart_when_enabled(tmp_path):
+    """restart_input_hosts solo-relaunches the input slot (bounded) and
+    records input_recovered — still zero budget, zero gang restarts."""
+    from tpucfn.obs import MetricRegistry
+
+    ft_dir = tmp_path / "ft"
+    registry = MetricRegistry()
+    coord = GangCoordinator(
+        _input_launcher(tmp_path),
+        [sys.executable, "-c", "import time; time.sleep(1.2)"],
+        policy=GangRestart(RestartBudget(0)), registry=registry,
+        ft_dir=ft_dir, poll_interval=0.02, term_grace_s=1.0,
+        kill_host_after=(2, 0.3), restart_input_hosts=True,
+        max_input_restarts=1)
+    assert coord.run() == 0
+    kinds = _kinds(ft_dir)
+    i = kinds.index("input_degraded")
+    assert "solo_launch" in kinds[i:]
+    assert "input_recovered" in kinds[i:]
+    v = registry.varz()["metrics"]
+    assert v["ft_input_restarts_total"] == 1
+    assert v["ft_gang_restarts_total"] == 0
+    assert coord.policy.budget.used == 0
+
+
+@pytest.mark.slow
+def test_idle_input_hosts_released_when_trainers_finish(tmp_path):
+    """An input service that serves until SIGTERM must not hold the run
+    open after every trainer exited: the coordinator stops it and the
+    run ends with the trainers' rc."""
+    ft_dir = tmp_path / "ft"
+    coord = GangCoordinator(
+        _input_launcher(tmp_path),
+        [sys.executable, "-c", "import time; time.sleep(0.3)"],
+        policy=GangRestart(RestartBudget(0)),
+        ft_dir=ft_dir, poll_interval=0.02, term_grace_s=1.0)
+    t0 = time.monotonic()
+    assert coord.run() == 0
+    assert time.monotonic() - t0 < 20.0  # not the input host's sleep(60)
+    exits = [e for e in _events(ft_dir) if e["kind"] == "host_exit"]
+    assert any(e.get("note") for e in exits if e["host"] == 2)
+
+
+@pytest.mark.slow
+def test_trainer_failure_still_restarts_gang_with_input_plane(tmp_path):
+    """Input-role routing must not swallow TRAINER failures: a trainer
+    crash goes through the normal detect->decide->gang restart, which
+    relaunches the input host too."""
+    import os
+
+    ft_dir = tmp_path / "ft"
+    os.environ["FLAG"] = str(tmp_path / "ran_once")
+    try:
+        coord = GangCoordinator(
+            _input_launcher(tmp_path),
+            [sys.executable, "-c", FAIL_ONCE],
+            policy=GangRestart(RestartBudget(2)),
+            ft_dir=ft_dir, poll_interval=0.02, term_grace_s=1.0)
+        assert coord.run() == 0
+    finally:
+        del os.environ["FLAG"]
+    kinds = _kinds(ft_dir)
+    assert "detect" in kinds and "recovered" in kinds
+    assert "input_degraded" not in kinds
+    # two gang launches, each covering all 3 hosts
+    launches = [e for e in _events(ft_dir) if e["kind"] == "launch"]
+    assert len(launches) == 2 and all(e["hosts"] == 3 for e in launches)

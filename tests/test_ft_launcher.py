@@ -165,3 +165,68 @@ def test_shrink_contract_bumps_generation_and_renumbers(tmp_path):
         shrink_contract(s, [0, 1])
     with pytest.raises(ValueError):
         shrink_contract(s, [7])  # out of range
+
+
+# -- input-plane role fan-out (ISSUE 11) ------------------------------------
+
+def test_input_hosts_role_env_fanout(tmp_path):
+    """The last N hosts are input-role: TPUCFN_ROLE, a per-host input
+    port, TPUCFN_INPUT_ADDRS everywhere, and the trainer ranks' jax
+    rendezvous shrunk to the TRAINER count."""
+    launcher = Launcher(_contract(tmp_path, n=4), LocalTransport(),
+                        input_hosts=2, input_port=9100)
+    assert launcher.trainer_host_ids == [0, 1]
+    assert launcher.input_host_ids == [2, 3]
+    t_env = launcher.host_env(0)
+    assert t_env["TPUCFN_ROLE"] == "trainer"
+    assert t_env["TPUCFN_WORKERS_COUNT"] == "2"
+    assert t_env["TPUCFN_INPUT_ADDRS"] == "127.0.0.1:9102,127.0.0.1:9103"
+    assert "TPUCFN_INPUT_PORT" not in t_env
+    i_env = launcher.host_env(3)
+    assert i_env["TPUCFN_ROLE"] == "input"
+    assert i_env["TPUCFN_INPUT_PORT"] == "9103"
+    assert i_env["TPUCFN_WORKERS_COUNT"] == "2"
+
+
+def test_input_hosts_zero_keeps_env_byte_identical(tmp_path):
+    """input_hosts=0 (every existing caller) must not grow the env —
+    the role vars appear only when the input plane is on."""
+    plain = Launcher(_contract(tmp_path, n=2), LocalTransport())
+    env = plain.host_env(1)
+    assert "TPUCFN_ROLE" not in env
+    assert "TPUCFN_INPUT_ADDRS" not in env
+    assert env["TPUCFN_WORKERS_COUNT"] == "2"
+
+
+def test_input_hosts_run_input_argv(tmp_path):
+    """Input hosts run --input-cmd's argv; trainers run the job's."""
+    import subprocess
+
+    class Recording(LocalTransport):
+        def __init__(self):
+            self.calls = []
+
+        def run(self, host, argv, env):
+            self.calls.append((env.get("TPUCFN_ROLE"), list(argv)))
+            return subprocess.Popen([sys.executable, "-c", "pass"])
+
+    tr = Recording()
+    launcher = Launcher(_contract(tmp_path, n=3), LocalTransport(),
+                        input_hosts=1,
+                        input_argv=["serve-input"])
+    launcher.transport = tr
+    procs = launcher.launch(["train"])
+    launcher.stop_all(procs)
+    assert tr.calls == [("trainer", ["train"]), ("trainer", ["train"]),
+                       ("input", ["serve-input"])]
+    # solo relaunch of the input host keeps its argv too
+    tr.calls.clear()
+    launcher.launch_host(["train"], 2).wait()
+    assert tr.calls == [("input", ["serve-input"])]
+
+
+def test_input_hosts_must_leave_a_trainer(tmp_path):
+    launcher = Launcher(_contract(tmp_path, n=2), LocalTransport(),
+                        input_hosts=2)
+    with pytest.raises(ValueError, match="no trainer"):
+        launcher.host_env(0)

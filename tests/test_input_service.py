@@ -1,0 +1,468 @@
+"""Disaggregated input plane (ISSUE 11): wire protocol, service/client
+parity with the local loaders, failover + degrade-to-local at the exact
+cursor, backpressure bounds, and the data_wait-driven prefetch
+controller.  Everything here is numpy + sockets on localhost — no jax,
+sub-second per test."""
+
+import itertools
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpucfn.data import write_dataset_shards
+from tpucfn.data.pipeline import MultiProcessLoader, ShardedDataset
+from tpucfn.data.service import (
+    AdaptivePrefetcher,
+    InputService,
+    PrefetchController,
+    ResilientBatchStream,
+    ServiceBatchStream,
+    ServiceError,
+    decode_batch,
+    encode_batch,
+    input_addrs_from_env,
+)
+
+
+def _shards(tmp_path, n=48, num_shards=6, dim=3):
+    rs = np.random.RandomState(0)
+    examples = [{"x": rs.randn(dim).astype(np.float32),
+                 "uid": np.int32(i)} for i in range(n)]
+    return write_dataset_shards(iter(examples), tmp_path,
+                                num_shards=num_shards)
+
+
+def _local(shards, trainer=0, pc=1, batch=4, seed=3, **kw):
+    return ShardedDataset(shards, batch_size_per_process=batch, seed=seed,
+                          process_index=trainer, process_count=pc, **kw)
+
+
+def _assert_streams_equal(got, ref):
+    assert len(got) == len(ref)
+    for a, b in zip(got, ref):
+        assert sorted(a) == sorted(b)
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k])
+
+
+# -- wire protocol ----------------------------------------------------------
+
+def test_encode_decode_roundtrip_dtypes_shapes_and_writability():
+    b = {"img": np.arange(24, dtype=np.uint8).reshape(2, 3, 4),
+         "x": np.linspace(0, 1, 6, dtype=np.float32).reshape(3, 2),
+         "label": np.int32(7),             # 0-d must stay 0-d
+         "mask": np.array([True, False])}
+    d = decode_batch(encode_batch(b))
+    assert sorted(d) == sorted(b)
+    for k in b:
+        assert d[k].dtype == np.asarray(b[k]).dtype
+        assert d[k].shape == np.asarray(b[k]).shape
+        np.testing.assert_array_equal(d[k], b[k])
+    d["x"][0, 0] = 42.0  # decoded arrays are writable, like local batches
+
+
+def test_encode_handles_noncontiguous_input():
+    b = {"x": np.arange(24, dtype=np.float64).reshape(4, 6).T}
+    np.testing.assert_array_equal(decode_batch(encode_batch(b))["x"],
+                                  b["x"])
+
+
+def test_decode_rejects_torn_payloads():
+    payload = encode_batch({"x": np.ones(8, np.float32)})
+    with pytest.raises(ServiceError, match="torn|truncated"):
+        decode_batch(payload[: len(payload) - 5])
+    with pytest.raises(ServiceError):
+        decode_batch(b"\x01")
+
+
+def test_input_addrs_from_env():
+    assert input_addrs_from_env({}) == []
+    assert input_addrs_from_env(
+        {"TPUCFN_INPUT_ADDRS": "h1:7641, h2:7642 ,"}) == \
+        ["h1:7641", "h2:7642"]
+
+
+# -- service <-> local parity ----------------------------------------------
+
+def test_served_stream_matches_local_sharded_dataset(tmp_path):
+    shards = _shards(tmp_path)
+    with InputService(shards, num_trainers=2, batch_size_per_process=4,
+                      seed=3, host="127.0.0.1") as svc:
+        for trainer in (0, 1):
+            got = list(ServiceBatchStream(
+                svc.address, trainer, process_count=2, batch_size=4,
+                seed=3, num_epochs=2))
+            ref = list(_local(shards, trainer, pc=2).batches(2))
+            _assert_streams_equal(got, ref)
+    m = svc.registry.varz()["metrics"]
+    assert m["input_batches_streamed_total"] == len(got) * 2
+    assert m["input_bytes_streamed_total"] > 0
+    assert m["input_connections_total"] == 2
+
+
+def test_served_stream_matches_multiprocess_loader(tmp_path):
+    """mp_workers>0 runs the stream through MultiProcessLoader — the
+    stage an input host exists to scale — and the sequence must equal
+    the local MultiProcessLoader's for the same identity."""
+    shards = _shards(tmp_path)
+    with InputService(shards, num_trainers=1, batch_size_per_process=4,
+                      seed=1, mp_workers=2, host="127.0.0.1") as svc:
+        got = list(ServiceBatchStream(svc.address, 0, process_count=1,
+                                      batch_size=4, seed=1, num_epochs=1))
+    with MultiProcessLoader(shards, num_workers=2, process_index=0,
+                            process_count=1, batch_size_per_process=4,
+                            seed=1) as loader:
+        ref = list(loader.batches(1))
+    _assert_streams_equal(got, ref)
+
+
+def test_start_batch_skips_but_preserves_the_stream(tmp_path):
+    shards = _shards(tmp_path)
+    with InputService(shards, num_trainers=1, batch_size_per_process=4,
+                      seed=3, host="127.0.0.1") as svc:
+        ref = list(_local(shards).batches(1))
+        got = list(ServiceBatchStream(svc.address, 0, process_count=1,
+                                      batch_size=4, seed=3, num_epochs=1,
+                                      start_batch=5))
+    _assert_streams_equal(got, ref[5:])
+
+
+def test_handshake_refuses_mismatched_identity(tmp_path):
+    """The determinism contract's loud half: a trainer whose fleet
+    size / batch / seed disagrees must be refused (it would otherwise
+    train on a different sequence than its local fallback)."""
+    shards = _shards(tmp_path)
+    with InputService(shards, num_trainers=2, batch_size_per_process=4,
+                      seed=3, host="127.0.0.1") as svc:
+        for kw, pat in (
+                (dict(process_count=3), "fleet size"),
+                (dict(process_count=2, batch_size=8), "batch_size"),
+                (dict(process_count=2, seed=9), "seed"),
+        ):
+            with pytest.raises(ServiceError, match=pat):
+                next(iter(ServiceBatchStream(svc.address, 0,
+                                             num_epochs=1, **kw)))
+        with pytest.raises(ServiceError, match="out of range"):
+            next(iter(ServiceBatchStream(svc.address, 7, process_count=2,
+                                         num_epochs=1)))
+    assert svc.registry.varz()["metrics"]["input_stream_errors_total"] >= 4
+
+
+def test_queue_depth_stays_bounded_under_slow_consumer(tmp_path):
+    """Backpressure: a trainer that never reads must not grow the
+    service's memory past queue_batches (+ the socket buffers)."""
+    shards = _shards(tmp_path, n=96, num_shards=6)
+    with InputService(shards, num_trainers=1, batch_size_per_process=4,
+                      seed=0, queue_batches=2, host="127.0.0.1") as svc:
+        stream = ServiceBatchStream(svc.address, 0, process_count=1,
+                                    batch_size=4, seed=0, num_epochs=4)
+        next(stream)  # handshake done, producer running
+        time.sleep(0.25)  # consumer stalls; producer must block, not grow
+        depth = svc.registry.varz()["metrics"]["input_queue_depth"]
+        assert depth <= 2, depth
+        stream.close()
+
+
+# -- failover + degradation -------------------------------------------------
+
+def test_resilient_stream_fails_over_to_second_input_host(tmp_path):
+    shards = _shards(tmp_path)
+    ref = list(_local(shards).batches(1))
+    svc_a = InputService(shards, num_trainers=1, batch_size_per_process=4,
+                         seed=3, host="127.0.0.1").start()
+    svc_b = InputService(shards, num_trainers=1, batch_size_per_process=4,
+                         seed=3, host="127.0.0.1").start()
+    try:
+        stream = ResilientBatchStream(
+            [svc_a.address, svc_b.address], 0,
+            local_factory=lambda skip: itertools.islice(
+                _local(shards).batches(1), skip, None),
+            process_count=1, batch_size=4, seed=3, num_epochs=1)
+        got = [next(stream) for _ in range(3)]
+        svc_a.close()  # the primary dies mid-stream
+        got += list(stream)
+    finally:
+        svc_a.close()
+        svc_b.close()
+    _assert_streams_equal(got, ref)
+    assert not stream.degraded  # host B absorbed it
+
+
+def test_resilient_stream_degrades_to_local_bit_identically(tmp_path):
+    """The acceptance property in miniature: kill the only input host
+    mid-stream — the continuation comes from the LOCAL loader at the
+    exact cursor and the full sequence equals the uninterrupted one."""
+    shards = _shards(tmp_path)
+    ref = list(_local(shards).batches(2))
+    svc = InputService(shards, num_trainers=1, batch_size_per_process=4,
+                       seed=3, host="127.0.0.1").start()
+    reasons = []
+    stream = ResilientBatchStream(
+        [svc.address], 0,
+        local_factory=lambda skip: itertools.islice(
+            _local(shards).batches(2), skip, None),
+        process_count=1, batch_size=4, seed=3, num_epochs=2,
+        on_degrade=reasons.append)
+    got = [next(stream) for _ in range(4)]
+    svc.close()
+    got += list(stream)
+    _assert_streams_equal(got, ref)
+    assert stream.degraded and len(reasons) == 1
+
+
+def test_resilient_stream_with_no_reachable_host_goes_local(tmp_path):
+    shards = _shards(tmp_path)
+    ref = list(_local(shards).batches(1))
+    stream = ResilientBatchStream(
+        ["127.0.0.1:1"], 0,  # nothing listens on port 1
+        local_factory=lambda skip: itertools.islice(
+            _local(shards).batches(1), skip, None),
+        process_count=1, batch_size=4, seed=3, num_epochs=1,
+        connect_timeout_s=0.5, connect_retry_s=0.0)
+    got = list(stream)
+    _assert_streams_equal(got, ref)
+    assert stream.degraded
+
+
+def test_trainers_spread_across_input_hosts():
+    """Trainer i's PRIMARY is addrs[i % n] (load spreads), with the
+    remaining hosts as its failover order."""
+    addrs = ["a:1", "b:2", "c:3"]
+    for trainer in range(6):
+        s = ResilientBatchStream(addrs, trainer,
+                                 local_factory=lambda skip: iter(()))
+        assert s._addrs[0] == addrs[trainer % 3]
+        assert sorted(s._addrs) == sorted(addrs)
+
+
+# -- adaptive prefetch ------------------------------------------------------
+
+def test_controller_deepens_while_input_bound_and_decays_idle():
+    c = PrefetchController(min_depth=1, max_depth=16, deepen_share=0.05,
+                           shrink_share=0.01, window=4)
+    # input-bound: waits dominate -> depth climbs toward max
+    for _ in range(10):
+        c.observe(wait_s=0.05, busy_s=0.05)
+    assert c.depth == 16
+    # healthy: zero waits over full windows -> decay to min, one per window
+    for _ in range(16 * 4):
+        c.observe(wait_s=0.0, busy_s=0.1)
+    assert c.depth == 1
+
+
+def test_controller_holds_depth_in_the_dead_band():
+    c = PrefetchController(min_depth=2, max_depth=8, deepen_share=0.5,
+                           shrink_share=0.0, window=4)
+    c.depth = 4
+    for _ in range(20):
+        c.observe(wait_s=0.01, busy_s=0.09)  # 10% share: inside the band
+    assert c.depth == 4
+
+
+def test_controller_validates_bounds():
+    with pytest.raises(ValueError):
+        PrefetchController(min_depth=0)
+    with pytest.raises(ValueError):
+        PrefetchController(deepen_share=0.01, shrink_share=0.5)
+
+
+def test_adaptive_prefetcher_yields_everything_in_order():
+    src = [{"x": np.full(4, i, np.float32)} for i in range(20)]
+    got = list(AdaptivePrefetcher(iter(src)))
+    _assert_streams_equal(got, src)
+
+
+def test_adaptive_prefetcher_propagates_source_errors():
+    def bad():
+        yield {"x": np.ones(2, np.float32)}
+        raise RuntimeError("input host exploded")
+
+    it = AdaptivePrefetcher(bad())
+    next(it)
+    with pytest.raises(RuntimeError, match="exploded"):
+        list(it)
+
+
+def test_adaptive_prefetcher_respects_byte_bound():
+    produced = []
+
+    def src():
+        for i in range(100):
+            produced.append(i)
+            yield {"x": np.zeros(1024, np.float32)}  # 4 KiB each
+
+    ctl = PrefetchController(min_depth=8, max_depth=8)
+    it = AdaptivePrefetcher(src(), controller=ctl, max_bytes=3 * 4096)
+    next(it)
+    time.sleep(0.2)  # producer runs ahead as far as the bound allows
+    # depth allows 8 buffered, the byte bound allows ~3 (+1 in flight)
+    assert len(produced) <= 6, produced
+    it.close()
+
+
+def test_adaptive_prefetcher_exports_depth_gauge():
+    from tpucfn.obs.registry import MetricRegistry
+
+    r = MetricRegistry()
+    it = AdaptivePrefetcher(iter([{"x": np.ones(2, np.float32)}]),
+                            registry=r)
+    list(it)
+    assert r.varz()["metrics"]["input_prefetch_depth"] >= 1.0
+
+
+def test_service_close_is_idempotent_and_unblocks_clients(tmp_path):
+    shards = _shards(tmp_path)
+    svc = InputService(shards, num_trainers=1, batch_size_per_process=4,
+                       seed=0, host="127.0.0.1").start()
+    stream = ServiceBatchStream(svc.address, 0, process_count=1,
+                                batch_size=4, seed=0)  # unbounded epochs
+    next(stream)
+
+    t = threading.Thread(target=svc.close)
+    t.start()
+    with pytest.raises((ServiceError, StopIteration)):
+        for _ in range(10_000):
+            next(stream)
+    t.join(timeout=10)
+    assert not t.is_alive()
+    svc.close()  # second close is a no-op
+
+
+def test_request_close_is_noticed_by_wait_idle(tmp_path):
+    shards = _shards(tmp_path)
+    svc = InputService(shards, num_trainers=1, batch_size_per_process=4,
+                       seed=0, host="127.0.0.1").start()
+    t = threading.Thread(target=svc.wait_idle)
+    t.start()
+    time.sleep(0.1)
+    svc.request_close()  # the SIGTERM-handler form: one plain store
+    t.join(timeout=5)
+    assert not t.is_alive()
+    svc.close()
+
+
+def test_mp_workers_and_thread_workers_are_exclusive(tmp_path):
+    """The CLI always forwards num_workers (default 0): mp_workers must
+    tolerate the 0 and REFUSE a real double-configuration (caught by the
+    jax-blocked `tpucfn data serve --mp-workers` verify drive)."""
+    shards = _shards(tmp_path)
+    # the CLI shape: num_workers=0 alongside mp_workers is fine
+    with InputService(shards, num_trainers=1, batch_size_per_process=4,
+                      seed=1, mp_workers=2, num_workers=0,
+                      host="127.0.0.1") as svc:
+        got = list(ServiceBatchStream(svc.address, 0, process_count=1,
+                                      batch_size=4, seed=1, num_epochs=1))
+    assert got  # the stream actually ran through MultiProcessLoader
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        InputService(shards, num_trainers=1, batch_size_per_process=4,
+                     mp_workers=2, num_workers=4)
+
+
+def test_server_num_epochs_bound_applies_when_client_defers(tmp_path):
+    """Every shipped client sends num_epochs=None ('no opinion'): the
+    service's --num-epochs bound must still apply, or the configured
+    epoch cap is dead config and streams never end."""
+    shards = _shards(tmp_path)
+    with InputService(shards, num_trainers=1, batch_size_per_process=4,
+                      seed=3, num_epochs=1, host="127.0.0.1") as svc:
+        got = list(ServiceBatchStream(svc.address, 0, process_count=1,
+                                      batch_size=4, seed=3,
+                                      num_epochs=None))
+    _assert_streams_equal(got, list(_local(shards).batches(1)))
+
+
+def test_adaptive_prefetcher_repeated_next_keeps_raising():
+    it = AdaptivePrefetcher(iter([{"x": np.ones(2, np.float32)}]))
+    assert len(list(it)) == 1
+    for _ in range(3):  # iterator protocol: exhausted stays exhausted
+        with pytest.raises(StopIteration):
+            next(it)
+
+
+def test_service_prunes_finished_streams(tmp_path):
+    """Dead _Stream objects must not accumulate per connection ever
+    accepted — a week of reconnect churn is a memory leak otherwise."""
+    shards = _shards(tmp_path)
+    with InputService(shards, num_trainers=1, batch_size_per_process=4,
+                      seed=3, host="127.0.0.1") as svc:
+        for _ in range(5):
+            list(ServiceBatchStream(svc.address, 0, process_count=1,
+                                    batch_size=4, seed=3, num_epochs=1))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with svc._lock:
+                if all(s.done.is_set() for s in svc._streams):
+                    break
+            time.sleep(0.05)
+        # one more accept prunes everything the churn left behind
+        list(ServiceBatchStream(svc.address, 0, process_count=1,
+                                batch_size=4, seed=3, num_epochs=1))
+        with svc._lock:
+            assert len(svc._streams) <= 2, len(svc._streams)
+
+
+def test_loader_shape_mismatch_is_refused(tmp_path):
+    """A service running MultiProcessLoader streams (merge order depends
+    on worker count) must refuse a client whose declared FALLBACK is the
+    plain loader — the degrade handoff would swap permutations."""
+    shards = _shards(tmp_path)
+    with InputService(shards, num_trainers=1, batch_size_per_process=4,
+                      seed=1, mp_workers=2, host="127.0.0.1") as svc:
+        with pytest.raises(ServiceError, match="loader shape"):
+            next(iter(ServiceBatchStream(svc.address, 0, process_count=1,
+                                         batch_size=4, seed=1,
+                                         num_epochs=1, mp_workers=0)))
+        # a matching declaration streams fine
+        got = list(ServiceBatchStream(svc.address, 0, process_count=1,
+                                      batch_size=4, seed=1, num_epochs=1,
+                                      mp_workers=2))
+    assert got
+
+
+def test_adaptive_prefetcher_close_with_empty_buffer_unblocks_next():
+    """close() racing an empty buffer must end the iteration, not leave
+    next() waiting forever on an END sentinel that will never come."""
+    def slow():
+        while True:
+            time.sleep(0.05)
+            yield {"x": np.ones(2, np.float32)}
+
+    it = AdaptivePrefetcher(slow())
+    next(it)
+    done = threading.Event()
+
+    def consume():
+        try:
+            for _ in it:
+                pass
+        except Exception:
+            pass
+        done.set()
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    it.close()
+    assert done.wait(timeout=5), "consumer still blocked after close()"
+
+
+def test_clean_disconnect_is_not_a_stream_error(tmp_path):
+    """The shipped integration ends an UNBOUNDED stream by just
+    disconnecting — input_stream_errors_total must stay 0 or every
+    healthy run trips the alerting metric."""
+    shards = _shards(tmp_path)
+    with InputService(shards, num_trainers=1, batch_size_per_process=4,
+                      seed=3, host="127.0.0.1") as svc:
+        stream = ServiceBatchStream(svc.address, 0, process_count=1,
+                                    batch_size=4, seed=3)  # unbounded
+        next(stream)
+        stream.close()  # the trainer reached its step target and left
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if not svc._live_streams():
+                break
+            time.sleep(0.05)
+        assert svc.registry.varz()["metrics"][
+            "input_stream_errors_total"] == 0

@@ -1,0 +1,206 @@
+"""ISSUE 11 acceptance drill: 1 input host + 2 trainer hosts under the
+real launch fan-out.
+
+Three runs over the same shards, same seeds:
+
+* **reference** — trainers load locally (no input plane); also the
+  bit-identical ground truth and the input-bound goodput baseline
+  (every batch pays the synthetic decode serially with compute).
+* **served** — `tpucfn launch`-shaped fan-out with one input host
+  running the real `tpucfn data serve` CLI; trajectory must equal the
+  reference bit-for-bit and the fleet ``data_wait`` share must be
+  STRICTLY lower (with buckets still summing to wall time) — the
+  goodput half of the acceptance criteria.
+* **chaos** — same fan-out, input host chaos-killed mid-run: the
+  coordinator records ``input_degraded`` (no detect/decide incident, no
+  gang restart, budget untouched), trainers degrade to local loading at
+  the exact batch cursor, the run completes rc 0, and the trajectory is
+  STILL bit-identical to the reference.
+"""
+
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tpucfn.bootstrap import EnvContract
+from tpucfn.data import write_dataset_shards
+from tpucfn.ft import (
+    ChaosEvent,
+    ChaosSpec,
+    GangCoordinator,
+    GangRestart,
+    HeartbeatMonitor,
+    MonitorConfig,
+    RestartBudget,
+)
+from tpucfn.launch import Launcher, LocalTransport
+
+pytestmark = pytest.mark.slow
+
+REPO = Path(__file__).resolve().parent.parent
+WORKER = Path(__file__).resolve().parent / "input_e2e_worker.py"
+
+TRAINERS = 2
+BATCH = 8
+SEED = 5
+EPOCHS = 1
+# 480 examples over 8 shards -> 4 shards (240 examples, 30 batches) per
+# trainer per epoch
+EXAMPLES, SHARDS = 480, 8
+STEPS_PER_TRAINER = 30
+
+
+def _write_shards(tmp_path) -> Path:
+    d = tmp_path / "shards"
+    d.mkdir()
+    rs = np.random.RandomState(1)
+    # 16 KB/example -> 128 KB/batch: bigger than the socket buffers, so
+    # a killed input host is NOTICED mid-stream (tiny batches would let
+    # the whole epoch hide in TCP buffering and the drill would pass
+    # vacuously without ever degrading)
+    write_dataset_shards(
+        ({"x": rs.randn(4096).astype(np.float32)} for _ in range(EXAMPLES)),
+        d, num_shards=SHARDS)
+    return d
+
+
+def _contract(tmp_path, n) -> EnvContract:
+    hostfile = tmp_path / f"hostfile{n}"
+    hostfile.write_text("".join("127.0.0.1:0\n" for _ in range(n)))
+    return EnvContract(
+        workers_path=str(hostfile), workers_count=n, worker_chip_count=1,
+        coordinator="127.0.0.1:1234", host_id=0, storage=str(tmp_path),
+        generation=1)
+
+
+def _worker_env(run_dir: Path, shards: Path) -> dict[str, str]:
+    return {
+        "INPUT_E2E_RUN_DIR": str(run_dir),
+        "INPUT_E2E_SHARDS": str(shards),
+        "INPUT_E2E_BATCH": str(BATCH),
+        "INPUT_E2E_SEED": str(SEED),
+        "INPUT_E2E_EPOCHS": str(EPOCHS),
+        "INPUT_E2E_STEP_SLEEP": "0.05",
+        "INPUT_E2E_DECODE_SLEEP": "0.004",
+        "TPUCFN_INPUT_RCVBUF": str(64 * 1024),
+    }
+
+
+def _serve_argv(shards: Path) -> list[str]:
+    # tight socket buffers: in-flight batches must not hide the chaos
+    # kill (auto-tuned loopback windows would buffer the whole epoch)
+    return [sys.executable, "-m", "tpucfn.cli", "data", "serve",
+            "--shards", str(shards), "--batch-size", str(BATCH),
+            "--seed", str(SEED), "--num-epochs", str(EPOCHS),
+            "--host", "127.0.0.1", "--idle-exit", "2.0",
+            "--queue-batches", "2", "--sndbuf-kb", "64"]
+
+
+def _run(tmp_path, shards, run_dir, *, input_plane: bool,
+         chaos: ChaosSpec | None = None, input_port: int) -> GangCoordinator:
+    run_dir.mkdir(parents=True, exist_ok=True)
+    n = TRAINERS + (1 if input_plane else 0)
+    ft_dir = run_dir / "ft"
+    launcher = Launcher(
+        _contract(tmp_path, n), LocalTransport(),
+        ft_dir=str(ft_dir), ft_heartbeat_s=0.2,
+        input_hosts=1 if input_plane else 0,
+        input_port=input_port,
+        input_argv=_serve_argv(shards) if input_plane else None,
+        extra_env=_worker_env(run_dir, shards))
+    monitor = HeartbeatMonitor(
+        ft_dir, expected_hosts=n,
+        config=MonitorConfig(interval_s=0.2, startup_grace_s=60.0))
+    coord = GangCoordinator(
+        launcher, [sys.executable, str(WORKER)],
+        policy=GangRestart(RestartBudget(0)), monitor=monitor,
+        ft_dir=ft_dir, poll_interval=0.02, term_grace_s=2.0,
+        chaos=chaos)
+    assert coord.run() == 0
+    return coord
+
+
+def _trajectories(run_dir: Path) -> dict[int, list[str]]:
+    out = {}
+    for h in range(TRAINERS):
+        p = run_dir / f"losses-host{h:03d}.jsonl"
+        out[h] = [ln for ln in p.read_text().splitlines() if ln.strip()]
+        assert len(out[h]) == STEPS_PER_TRAINER * EPOCHS, (h, len(out[h]))
+    return out
+
+
+def _mode(run_dir: Path, h: int) -> dict:
+    return json.loads((run_dir / f"mode-host{h:03d}.json").read_text())
+
+
+def _events(run_dir: Path) -> list[dict]:
+    p = run_dir / "ft" / "events.jsonl"
+    return [json.loads(s) for s in p.read_text().splitlines() if s.strip()]
+
+
+def _goodput(run_dir: Path) -> dict:
+    from tpucfn.obs.goodput import goodput_report
+
+    rep = goodput_report(run_dir / "goodput",
+                         run_dir / "ft" / "events.jsonl")
+    assert rep["num_hosts"] == TRAINERS
+    # the acceptance invariant: buckets (derived fillers included) sum
+    # to wall time — residual is float noise
+    assert abs(rep["unaccounted_s"]) <= 0.05 * max(rep["wall_s"], 1e-9)
+    return rep
+
+
+def test_input_plane_e2e_degradation_and_goodput(tmp_path):
+    shards = _write_shards(tmp_path)
+
+    # -- reference: local loading, also the goodput baseline -------------
+    ref_dir = tmp_path / "ref"
+    _run(tmp_path, shards, ref_dir, input_plane=False, input_port=9310)
+    ref = _trajectories(ref_dir)
+    assert not _mode(ref_dir, 0)["used_service"]
+    ref_rep = _goodput(ref_dir)
+    ref_share = ref_rep["buckets"]["data_wait"] / ref_rep["wall_s"]
+    assert ref_share > 0.2, ref_share  # the workload IS input-bound
+
+    # -- served: full fan-out, no chaos ----------------------------------
+    served_dir = tmp_path / "served"
+    _run(tmp_path, shards, served_dir, input_plane=True, input_port=9320)
+    served = _trajectories(served_dir)
+    assert served == ref  # bit-identical trajectory, service-fed
+    for h in range(TRAINERS):
+        m = _mode(served_dir, h)
+        assert m["used_service"] and not m["degraded"], m
+    served_rep = _goodput(served_dir)
+    served_share = (served_rep["buckets"]["data_wait"]
+                    / served_rep["wall_s"])
+    # the goodput acceptance: data_wait share STRICTLY lower with the
+    # service enabled (decode left the trainers' critical path)
+    assert served_share < ref_share, (served_share, ref_share)
+    kinds = [e["kind"] for e in _events(served_dir)]
+    assert "input_degraded" not in kinds
+    assert "detect" not in kinds
+
+    # -- chaos: kill the input host mid-run ------------------------------
+    chaos_dir = tmp_path / "chaos"
+    chaos = ChaosSpec(seed=0, events=(
+        ChaosEvent(action="kill", at_step=10, host=TRAINERS),))
+    coord = _run(tmp_path, shards, chaos_dir, input_plane=True,
+                 chaos=chaos, input_port=9330)
+    got = _trajectories(chaos_dir)
+    assert got == ref  # the whole point: degradation changed NOTHING
+    degraded = [h for h in range(TRAINERS)
+                if _mode(chaos_dir, h)["degraded"]]
+    assert degraded, "the kill landed mid-run; someone must have degraded"
+    kinds = [e["kind"] for e in _events(chaos_dir)]
+    assert "input_degraded" in kinds
+    # no gang incident, no restart, budget untouched
+    assert "detect" not in kinds and "recovered" not in kinds
+    assert coord.policy.budget.used == 0
+    v = coord.registry.varz()["metrics"]
+    assert v["ft_input_degradations_total"] == 1
+    assert v["supervisor_restarts_total"] == 0
+    _goodput(chaos_dir)  # invariant still holds through the degradation

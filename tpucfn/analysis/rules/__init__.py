@@ -13,6 +13,7 @@ import dataclasses
 from typing import Callable, Iterable
 
 from tpucfn.analysis.rules import (
+    cardinality,
     jax_hazards,
     locks,
     metrics_hygiene,
@@ -54,6 +55,13 @@ ALL_RULES: dict[str, Rule] = {r.id: r for r in (
          "PR 8 router_request_latency_seconds Summary never registered "
          "— /metrics lost latency exactly when --replicas turned on",
          metrics_hygiene.check),
+    Rule("registry-cardinality",
+         "no metric name family formatted with a fleet-scaled loop "
+         "variable — aggregate, or use a label",
+         "PR 8 router_replica_state_{i} per-replica names (baselined: "
+         "CLI-bounded count); the input service (ISSUE 11) is the "
+         "surface that would ship this at fleet scale",
+         cardinality.check),
     Rule("jax-hazards",
          "no donated-buffer read after the jitted call that donated it; "
          "no jax.jit in a loop body",

@@ -1,0 +1,153 @@
+"""registry-cardinality: metric NAME families must not grow with the
+fleet (ROADMAP correctness-tooling follow-on, shipped with ISSUE 11).
+
+The bug class: registering ``f"input_host_queue_{i}"`` inside a loop
+over hosts/replicas/trainers mints one time series PER fleet member —
+/metrics cardinality grows unbounded with scale, dashboards cannot
+aggregate the family, and every scrape pays for it forever.  The fix
+is one aggregate series (what the input service ships:
+``input_queue_depth`` sums across streams) or a label on one name.
+
+Detection is deliberately narrow and static: a registration call
+(``counter``/``gauge``/``summary``/``histogram``/``computed_gauge``/
+``register``, or a direct instrument construction) whose name argument
+is an f-string interpolating a variable bound by an ENCLOSING ``for``
+loop or comprehension.  A loop variable is the one shape that is
+fleet-scaled by construction; f-strings over constants or config
+attributes (``f"{prefix}_depth"``) stay silent, as does every
+aggregate registration.
+
+The shipped ``router_replica_state_{i}`` family (PR 8) fires here by
+design — it is exactly the shape this rule exists to catch — and is
+baselined with a justification (replica count is a small CLI-bounded
+constant with slot-stable indices), which is the escape hatch's job:
+visible, justified, and re-litigated the moment the baseline goes
+stale.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tpucfn.analysis.core import Analysis, Finding, sub_suites
+from tpucfn.analysis.rules.metrics_hygiene import (
+    INSTRUMENT_CLASSES,
+    REG_METHODS,
+    _joinedstr_pattern,
+)
+
+RULE_ID = "registry-cardinality"
+
+_REG_ATTRS = frozenset(REG_METHODS) | {"register"}
+
+
+def _is_registration(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr in _REG_ATTRS:
+        return True
+    return isinstance(f, ast.Name) and f.id in INSTRUMENT_CLASSES
+
+
+def _target_names(target: ast.expr) -> set[str]:
+    return {n.id for n in ast.walk(target) if isinstance(n, ast.Name)}
+
+
+def _loop_vars_in_name(call: ast.Call, loop_names: frozenset[str]
+                       ) -> tuple[str, ...]:
+    """Loop-bound variable names referenced inside the f-string name
+    argument of a registration call (empty tuple -> not fleet-scaled)."""
+    if not call.args or not isinstance(call.args[0], ast.JoinedStr):
+        return ()
+    hits = []
+    for part in call.args[0].values:
+        if not isinstance(part, ast.FormattedValue):
+            continue
+        for n in ast.walk(part.value):
+            if isinstance(n, ast.Name) and n.id in loop_names:
+                hits.append(n.id)
+    return tuple(dict.fromkeys(hits))
+
+
+def _calls_outside_nested_defs(expr: ast.expr) -> Iterable[ast.Call]:
+    """Call nodes of one expression, not descending into lambdas or
+    comprehensions (comprehensions get their own loop-name scope in
+    :func:`_scan`)."""
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.Lambda, ast.ListComp, ast.SetComp,
+                             ast.DictComp, ast.GeneratorExp)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _comp_calls(expr: ast.expr) -> Iterable[tuple[ast.Call, frozenset[str]]]:
+    """(call, comprehension-bound names) pairs for registration calls
+    INSIDE comprehensions/lambdas anywhere in ``expr`` — the
+    ``[r.gauge(f"x_{i}") for i in range(n)]`` shape."""
+    for node in ast.walk(expr):
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            names = frozenset().union(
+                *(_target_names(g.target) for g in node.generators))
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    yield sub, names
+
+
+def check(analysis: Analysis):
+    findings: list[Finding] = []
+
+    def emit(mod, call: ast.Call, vars_: tuple[str, ...]) -> None:
+        pat = _joinedstr_pattern(call.args[0]) or "<f-string>"
+        findings.append(Finding(
+            RULE_ID, mod.rel, call.lineno,
+            f"metric name family {pat!r} is formatted with the "
+            f"fleet-scaled loop variable{'s' if len(vars_) > 1 else ''} "
+            f"{', '.join(repr(v) for v in vars_)} — one series per "
+            "fleet member grows /metrics cardinality unboundedly; "
+            "export one aggregate series (sum/min over members) or put "
+            "the member id in a label",
+            key=f"cardinality:{pat}"))
+
+    def scan(mod, body, loop_names: frozenset[str]) -> None:
+        for stmt in body:
+            inner = loop_names
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                inner = loop_names | _target_names(stmt.target)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                # a nested def runs later, on its own frame: the outer
+                # loop variable is not its registration cadence
+                scan(mod, stmt.body, frozenset())
+                continue
+            # header/expression positions of this statement (everything
+            # that is not a nested suite)
+            for field, value in ast.iter_fields(stmt):
+                exprs = (value if isinstance(value, list)
+                         else [value]) if field not in (
+                    "body", "orelse", "finalbody", "handlers", "cases") \
+                    else []
+                for v in exprs:
+                    if not isinstance(v, ast.expr):
+                        continue
+                    for call in _calls_outside_nested_defs(v):
+                        if _is_registration(call):
+                            vars_ = _loop_vars_in_name(call, inner)
+                            if vars_:
+                                emit(mod, call, vars_)
+                    for call, comp_names in _comp_calls(v):
+                        if _is_registration(call):
+                            vars_ = _loop_vars_in_name(
+                                call, inner | comp_names)
+                            if vars_:
+                                emit(mod, call, vars_)
+            for suite in sub_suites(stmt):
+                scan(mod, suite, inner)
+
+    for mod in analysis.modules:
+        scan(mod, mod.tree.body, frozenset())
+    return findings

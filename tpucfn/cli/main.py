@@ -132,11 +132,34 @@ def cmd_launch(args) -> int:
     contract = converge(rec, _run_dir(args, args.name))
     transport = SSHTransport() if args.transport == "ssh" else LocalTransport()
     ft_dir = _run_dir(args, args.name) / "ft" if args.ft else None
+    if args.input_hosts and args.input_hosts >= contract.workers_count:
+        print(f"error: --input-hosts {args.input_hosts} leaves no trainer "
+              f"in a {contract.workers_count}-host cluster", file=sys.stderr)
+        return 2
+    if args.input_hosts and not args.input_cmd:
+        # No shipped job switches on TPUCFN_ROLE, so defaulting to the
+        # trainer argv would silently run a ROGUE extra trainer (a
+        # second "rank 0" writing the same run dir) while the trainers
+        # degrade to local loading — the feature must refuse loudly,
+        # not no-op.
+        print("error: --input-hosts needs --input-cmd (e.g. "
+              "--input-cmd 'python -m tpucfn.cli data serve --shards D "
+              "--batch-size B') — input hosts must run the input "
+              "service, not a copy of the trainer argv", file=sys.stderr)
+        return 2
+    input_argv = None
+    if args.input_cmd:
+        import shlex
+
+        input_argv = shlex.split(args.input_cmd)
     launcher = Launcher(contract, transport,
                         obs_base_port=args.obs_port or None,
                         ft_dir=str(ft_dir) if ft_dir else None,
                         ft_heartbeat_s=(args.ft_heartbeat_interval
-                                        if args.ft else None))
+                                        if args.ft else None),
+                        input_hosts=args.input_hosts,
+                        input_port=args.input_port or None,
+                        input_argv=input_argv)
     argv = list(args.cmd)
     if argv and argv[0] == "--":
         argv = argv[1:]
@@ -242,7 +265,8 @@ def cmd_launch(args) -> int:
                 max_ckpt_retries=args.ft_max_ckpt_retries,
                 straggler_guard=StragglerGuard(
                     hysteresis_s=args.ft_straggler_hysteresis,
-                    flap_budget=args.ft_straggler_flap_budget))
+                    flap_budget=args.ft_straggler_flap_budget),
+                restart_input_hosts=args.ft_restart_input_hosts)
             rc = coordinator.run()
         else:
             rc = run_with_restarts(launcher, argv, max_restarts=args.restarts,
@@ -313,6 +337,110 @@ def cmd_stage_data(args) -> int:
 
     paths = stage_url(args.url, args.dest)
     print(f"staged {len(paths)} shards into {args.dest}")
+    return 0
+
+
+def cmd_data_serve(args) -> int:
+    """Run the disaggregated input plane's service on this host
+    (ISSUE 11 tentpole): per connected trainer, the exact
+    ShardedDataset/MultiProcessLoader stage the trainer would run
+    locally, streamed as ready batches.  jax is never imported — input
+    hosts are pure CPU/RAM capacity.
+
+    Under the ``tpucfn launch --input-hosts N`` fan-out everything
+    defaults from the env contract (bind port from TPUCFN_INPUT_PORT,
+    trainer count from TPUCFN_WORKERS_COUNT, heartbeats into
+    TPUCFN_FT_DIR, /metrics on TPUCFN_OBS_PORT); standalone use passes
+    the flags explicitly."""
+    import json as _json
+    import signal as _signal
+    import time as _time
+
+    from tpucfn.data.service import INPUT_PORT_ENV, InputService
+
+    shards = sorted(Path(args.shards).glob("*.tpurec"))
+    if not shards:
+        print(f"error: no *.tpurec shards under {args.shards}",
+              file=sys.stderr)
+        return 2
+    num_trainers = args.num_trainers
+    if num_trainers is None:
+        raw = os.environ.get("TPUCFN_WORKERS_COUNT", "").strip()
+        if not raw:
+            print("error: --num-trainers required outside a `tpucfn "
+                  "launch --input-hosts` fan-out (TPUCFN_WORKERS_COUNT "
+                  "unset)", file=sys.stderr)
+            return 2
+        num_trainers = int(raw)
+    port = args.port
+    if port is None:
+        port = int(os.environ.get(INPUT_PORT_ENV, "0") or 0)
+
+    from tpucfn.obs import MetricRegistry, start_obs_server
+
+    host_id = int(os.environ.get("TPUCFN_HOST_ID", "0") or 0)
+    registry = MetricRegistry(labels={"role": "input",
+                                      "host": str(host_id)})
+    hb = obs_srv = None
+    service = InputService(
+        shards, num_trainers=num_trainers,
+        batch_size_per_process=args.batch_size, seed=args.seed,
+        num_epochs=args.num_epochs, host=args.host, port=port,
+        queue_batches=args.queue_batches, mp_workers=args.mp_workers,
+        sndbuf_bytes=args.sndbuf_kb * 1024 if args.sndbuf_kb else None,
+        registry=registry, shuffle=not args.no_shuffle,
+        cache_in_memory=not args.stream,
+        num_workers=args.workers)
+    try:
+        service.start()
+        print(f"input service listening on {service.address} "
+              f"({len(shards)} shards, {num_trainers} trainer stream(s))",
+              file=sys.stderr)
+        obs_srv = start_obs_server(registry, port=args.obs_port,
+                                   role="input", host_id=host_id)
+        if obs_srv is not None:
+            print(f"obs endpoint: {obs_srv.url()}", file=sys.stderr)
+        # Under the ft fan-out an input host is a first-class fleet
+        # member: it beats like any rank, and its death is routed as
+        # input_degraded (trainers fall back to local loading) instead
+        # of a gang incident.
+        ft_dir = os.environ.get("TPUCFN_FT_DIR", "").strip()
+        if ft_dir:
+            from tpucfn.ft.heartbeat import HeartbeatWriter
+
+            hb = HeartbeatWriter(
+                ft_dir, host_id, role="input",
+                interval_s=float(
+                    os.environ.get("TPUCFN_FT_HEARTBEAT_S", "1.0") or 1.0))
+            hb.start()
+
+        def _on_term(signum, frame):
+            # one lock-free store; wait_idle notices and the main
+            # thread runs the real close (a handler must never take
+            # this object's locks — the PR 8 drain lesson)
+            service.request_close()
+            print("SIGTERM: input service closing", file=sys.stderr)
+
+        try:
+            _signal.signal(_signal.SIGTERM, _on_term)
+        except ValueError:
+            pass  # not the main thread (embedded use)
+        t0 = _time.monotonic()
+        service.wait_idle(args.idle_exit if args.idle_exit > 0 else None)
+    finally:
+        service.close()
+        if hb is not None:
+            hb.stop()
+        if obs_srv is not None:
+            obs_srv.close()
+    m = registry.varz()["metrics"]
+    print(_json.dumps({
+        "served_s": round(_time.monotonic() - t0, 3),
+        "batches_streamed": m.get("input_batches_streamed_total", 0),
+        "bytes_streamed": m.get("input_bytes_streamed_total", 0),
+        "connections": m.get("input_connections_total", 0),
+        "stream_errors": m.get("input_stream_errors_total", 0),
+    }))
     return 0
 
 
@@ -1260,6 +1388,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="checkpoint-corruption retries (each blacklists "
                         "one bad step and resumes from the previous) "
                         "before the normal restart policy decides")
+    l.add_argument("--input-hosts", type=int, default=0, metavar="N",
+                   help="disaggregated input plane: the LAST N hosts of "
+                        "the slice stream batches (`tpucfn data serve` or "
+                        "--input-cmd) instead of training; trainers get "
+                        "TPUCFN_INPUT_ADDRS and the rendezvous shrinks to "
+                        "the trainer count")
+    l.add_argument("--input-port", type=int, default=0, metavar="BASE",
+                   help="input service base port (input host h binds "
+                        "BASE + h; 0 = the default base)")
+    l.add_argument("--input-cmd", metavar="CMD",
+                   help="command input hosts run (shlex-split; usually "
+                        "`python -m tpucfn.cli data serve ...`); required "
+                        "with --input-hosts")
+    l.add_argument("--ft-restart-input-hosts", action="store_true",
+                   help="solo-relaunch a dead input host (bounded, budget "
+                        "untouched); default: trainers just degrade to "
+                        "local loading")
     l.add_argument("cmd", nargs=argparse.REMAINDER)
     l.set_defaults(fn=cmd_launch)
 
@@ -1319,6 +1464,57 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--url", required=True, help="gs://, s3://, file://, or path")
     st.add_argument("--dest", required=True)
     st.set_defaults(fn=cmd_stage_data)
+
+    da = sub.add_parser(
+        "data", help="input-plane commands (disaggregated batch service)")
+    dasub = da.add_subparsers(dest="data_command", required=True)
+    dsv = dasub.add_parser(
+        "serve",
+        help="stream ready batches to trainer hosts: the input-host "
+             "role of `tpucfn launch --input-hosts N` (jax-free)")
+    dsv.add_argument("--shards", required=True, metavar="DIR",
+                     help="directory of *.tpurec shards (must match the "
+                          "trainers' local fallback dataset)")
+    dsv.add_argument("--batch-size", type=int, required=True,
+                     help="per-trainer batch size (handshake-validated)")
+    dsv.add_argument("--num-trainers", type=int, default=None, metavar="T",
+                     help="trainer fleet size (default: "
+                          "TPUCFN_WORKERS_COUNT from the launch fan-out)")
+    dsv.add_argument("--seed", type=int, default=0)
+    dsv.add_argument("--num-epochs", type=int, default=None,
+                     help="epochs per trainer stream (default: unbounded)")
+    dsv.add_argument("--host", default="0.0.0.0",
+                     help="bind address (default all interfaces)")
+    dsv.add_argument("--port", type=int, default=None,
+                     help="bind port (default: TPUCFN_INPUT_PORT from the "
+                          "launch fan-out, else ephemeral)")
+    dsv.add_argument("--queue-batches", type=int, default=4,
+                     help="encoded batches buffered per trainer stream "
+                          "(the memory bound; TCP backpressure beyond it)")
+    dsv.add_argument("--sndbuf-kb", type=int, default=0, metavar="KB",
+                     help="cap the kernel send buffer per stream (makes "
+                          "the per-trainer memory bound exact; 0 = OS "
+                          "auto-tuning, right for high-bandwidth links)")
+    dsv.add_argument("--mp-workers", type=int, default=0, metavar="W",
+                     help="decode across W worker PROCESSES per stream "
+                          "(MultiProcessLoader; 0 = in-process)")
+    dsv.add_argument("--workers", type=int, default=0,
+                     help="transform thread pool per stream "
+                          "(ShardedDataset num_workers; 0 = inline)")
+    dsv.add_argument("--no-shuffle", action="store_true")
+    dsv.add_argument("--stream", action="store_true",
+                     help="constant-memory shard streaming instead of "
+                          "caching decoded examples in RAM")
+    dsv.add_argument("--idle-exit", type=float, default=0.0,
+                     metavar="SECONDS",
+                     help="exit rc 0 after this long with no connected "
+                          "trainer (0 = serve until SIGTERM); the launch "
+                          "fan-out needs this so the supervisor can end "
+                          "the run")
+    dsv.add_argument("--obs-port", type=int, default=None, metavar="PORT",
+                     help="serve /metrics /healthz /varz (default: "
+                          "TPUCFN_OBS_PORT from the launch fan-out)")
+    dsv.set_defaults(fn=cmd_data_serve)
 
     sv = sub.add_parser(
         "serve",

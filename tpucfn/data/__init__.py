@@ -39,3 +39,12 @@ from tpucfn.data.packing import (  # noqa: F401
     packed_attention_fn,
     packed_causal_lm_loss,
 )
+from tpucfn.data.service import (  # noqa: F401
+    AdaptivePrefetcher,
+    InputService,
+    PrefetchController,
+    ResilientBatchStream,
+    ServiceBatchStream,
+    input_addrs_from_env,
+    service_or_local_batches,
+)

@@ -26,9 +26,12 @@ from __future__ import annotations
 
 from typing import Iterable
 
-import jax
-import jax.numpy as jnp
 import numpy as np
+
+# jax is imported lazily inside the two jax-consuming functions (the
+# annotations are strings via __future__): the data package must stay
+# importable on jax-free INPUT hosts (ISSUE 11 — `tpucfn data serve`
+# pulls tpucfn.data.__init__, which pulls this module).
 
 
 def pack_sequences(
@@ -136,6 +139,8 @@ def packed_causal_lm_loss(
 ) -> tuple[jax.Array, jax.Array]:
     """Next-token CE averaged over positions whose TARGET shares the
     input's segment (and is not padding). Returns (loss, accuracy)."""
+    import jax
+    import jax.numpy as jnp
     import optax
 
     targets = tokens[:, 1:]

@@ -16,11 +16,20 @@ import threading
 from pathlib import Path
 from typing import Any, Iterator, Sequence
 
-import jax
 import numpy as np
 
 from tpucfn.data import records
-from tpucfn.parallel.sharding import shard_batch
+
+# jax is imported lazily (process-identity defaults, the device-transfer
+# leg of prefetch_to_mesh): the disaggregated input plane (ISSUE 11)
+# runs these loaders on dedicated INPUT hosts that never touch a
+# device — `tpucfn data serve` must not pay (or require) a jax import.
+
+
+def _jax_process_identity() -> tuple[int, int]:
+    import jax
+
+    return jax.process_index(), jax.process_count()
 
 
 class ShardedDataset:
@@ -69,8 +78,12 @@ class ShardedDataset:
         if not shard_paths:
             raise ValueError("no shard paths given")
         self.all_shards = sorted(str(p) for p in shard_paths)
-        self.pi = jax.process_index() if process_index is None else process_index
-        self.pc = jax.process_count() if process_count is None else process_count
+        if process_index is None or process_count is None:
+            pi, pc = _jax_process_identity()
+            process_index = pi if process_index is None else process_index
+            process_count = pc if process_count is None else process_count
+        self.pi = process_index
+        self.pc = process_count
         self.local_shards = self.all_shards[self.pi :: self.pc]
         if not self.local_shards:
             raise ValueError(
@@ -279,8 +292,11 @@ class MultiProcessLoader:
     ):
         if num_workers < 1:
             raise ValueError(f"num_workers must be >= 1, got {num_workers}")
-        pi = jax.process_index() if process_index is None else process_index
-        pc = jax.process_count() if process_count is None else process_count
+        if process_index is None or process_count is None:
+            jpi, jpc = _jax_process_identity()
+            process_index = jpi if process_index is None else process_index
+            process_count = jpc if process_count is None else process_count
+        pi, pc = process_index, process_count
         local = sorted(str(p) for p in shard_paths)[pi::pc]
         if len(local) < num_workers:
             raise ValueError(
@@ -345,11 +361,22 @@ class MultiProcessLoader:
     def _get(self, w: int, timeout_s: float = 10.0):
         """Queue read that notices a dead worker: a spawn process killed
         without posting (OOM SIGKILL) would otherwise block the parent
-        forever on Queue.get (ADVICE r3)."""
+        forever on Queue.get (ADVICE r3).  A ``close()`` that raced the
+        read (another thread shutting the loader down mid-iteration —
+        the input service's stream teardown path) surfaces as a clean
+        RuntimeError instead of an IndexError on the torn queue list."""
         while True:
+            if w >= len(self._queues):
+                raise RuntimeError(
+                    f"loader closed while reading worker {w} — "
+                    "close() raced an in-flight iteration")
             try:
                 return self._queues[w].get(timeout=timeout_s)
             except queue.Empty:
+                if w >= len(self._procs):
+                    raise RuntimeError(
+                        f"loader closed while reading worker {w} — "
+                        "close() raced an in-flight iteration") from None
                 p = self._procs[w]
                 if not p.is_alive():
                     raise RuntimeError(
@@ -399,6 +426,8 @@ def prefetch_to_mesh(
     A daemon thread stays ``depth`` global batches ahead; the consumer
     always finds its next batch already resident on the mesh.
     """
+    from tpucfn.parallel.sharding import shard_batch
+
     q: queue.Queue = queue.Queue(maxsize=depth)
     _END = object()
 
@@ -420,3 +449,16 @@ def prefetch_to_mesh(
         if isinstance(item, Exception):
             raise item
         yield item
+
+
+# The disaggregated-input client (ISSUE 11) is part of the pipeline's
+# public surface: trainers swap `ds.batches(...)` for
+# `service_or_local_batches(ds, ...)` and everything downstream
+# (prefetch_to_mesh included) is unchanged.
+from tpucfn.data.service import (  # noqa: E402,F401
+    AdaptivePrefetcher,
+    PrefetchController,
+    ResilientBatchStream,
+    ServiceBatchStream,
+    service_or_local_batches,
+)

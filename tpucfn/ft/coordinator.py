@@ -115,6 +115,8 @@ class GangCoordinator(ChaosTarget):
         reacquire_check: Callable[[str], bool] | None = None,
         max_ckpt_retries: int = 3,
         straggler_guard: StragglerGuard | None = None,
+        restart_input_hosts: bool = False,
+        max_input_restarts: int = 1,
     ):
         """Graceful-degradation knobs (ISSUE 7): ``drain_grace_s`` caps
         how long a preemption drain waits for clean exits when the
@@ -151,6 +153,17 @@ class GangCoordinator(ChaosTarget):
         self.max_ckpt_retries = max_ckpt_retries
         self.straggler_guard = (straggler_guard if straggler_guard is not None
                                 else StragglerGuard(clock=clock))
+        # Disaggregated input plane (ISSUE 11): failures of input-role
+        # hosts NEVER restart the gang or burn budget — trainers degrade
+        # to local loading on their own (the service client's resilient
+        # stream), the coordinator just records it and, optionally,
+        # solo-relaunches the input host (bounded per host so a
+        # crash-looping service cannot relaunch forever).
+        self.input_host_ids = frozenset(
+            getattr(launcher, "input_host_ids", ()) or ())
+        self.restart_input_hosts = restart_input_hosts
+        self.max_input_restarts = max_input_restarts
+        self._input_restarts: dict[int, int] = {}
 
         if registry is None:
             # Throwaway registry: identical flow, nothing exported —
@@ -212,6 +225,13 @@ class GangCoordinator(ChaosTarget):
         self.ft_evictions_c = r.counter(
             "ft_straggler_evictions_total",
             "stragglers evicted past hysteresis/flap budget")
+        # Input-plane surface (ISSUE 11)
+        self.ft_input_degraded_c = r.counter(
+            "ft_input_degradations_total",
+            "input hosts lost; trainers degraded to local loading")
+        self.ft_input_restarts_c = r.counter(
+            "ft_input_restarts_total",
+            "input hosts solo-relaunched (budget untouched)")
 
         hosts = self.launcher.contract.hosts()[
             : self.launcher.contract.workers_count]
@@ -413,6 +433,7 @@ class GangCoordinator(ChaosTarget):
         self._finished.clear()
         self.straggler_guard.reset_all()
         self._suppressed_hangs.clear()
+        self._input_restarts.clear()
         self.attempts_c.add()
         self.hosts_g.set(len(procs))
         if self.monitor is not None:
@@ -553,7 +574,13 @@ class GangCoordinator(ChaosTarget):
                 if self.chaos is not None and not self.chaos.done():
                     self.chaos.tick(now - start, self._last_fleet_step)
                 failures = self._detect(now)
+                if failures and self.input_host_ids:
+                    # Input-role failures are degradations, not
+                    # incidents: handled apart from the policy so they
+                    # can never gang-restart trainers or burn budget.
+                    failures = self._handle_input_failures(failures)
                 if not failures:
+                    self._release_idle_input_hosts()
                     if not self._procs:  # every supervised rank exited
                         rc = next((r for r in self._finished.values() if r),
                                   0)
@@ -571,6 +598,59 @@ class GangCoordinator(ChaosTarget):
                                        poll_interval=self.poll_interval)
                 self._procs.clear()
             self._write_snapshot()
+
+    def _handle_input_failures(self, failures: list[Failure]
+                               ) -> list[Failure]:
+        """Strip and absorb failures of input-role hosts (ISSUE 11).
+
+        A dead input host is a capacity loss, not a gang failure: the
+        trainers' resilient streams fail over to the surviving input
+        hosts and then degrade to LOCAL loading from the exact batch
+        cursor — the run's trajectory is unchanged, only its input
+        throughput.  So: stop/reap the host, retire its heartbeat,
+        record ``input_degraded``, optionally solo-relaunch (bounded,
+        budget untouched), and hand everything else back to the normal
+        detect→decide path."""
+        inputs = [f for f in failures if f.host_id in self.input_host_ids]
+        if not inputs:
+            return failures
+        for f in inputs:
+            if f.host_id in self._procs:
+                # a hung service still holds its socket: stop it so
+                # trainer recv calls fail fast instead of timing out
+                self._stop_hosts([f.host_id])
+            self._finished.setdefault(f.host_id, 0)
+            self._suppressed_hangs.discard(f.host_id)
+            if self.monitor is not None:
+                self.monitor.retire_host(f.host_id)
+            self.ft_input_degraded_c.add()
+            self._event("input_degraded", host=f.host_id,
+                        failure=f.kind.value, rc=f.rc, detail=f.detail)
+            used = self._input_restarts.get(f.host_id, 0)
+            if self.restart_input_hosts and used < self.max_input_restarts:
+                self._input_restarts[f.host_id] = used + 1
+                self._launch_solo(f.host_id)
+                self.ft_input_restarts_c.add()
+                self._event("input_recovered", host=f.host_id,
+                            restarts=used + 1)
+        return [f for f in failures if f.host_id not in self.input_host_ids]
+
+    def _release_idle_input_hosts(self) -> None:
+        """Once every trainer rank has finished, surviving input hosts
+        are holding the run open for nobody — stop them cleanly so the
+        supervisor can declare the run done (the trainer rc decides)."""
+        if not self.input_host_ids or not self._procs:
+            return
+        if any(h not in self.input_host_ids for h in self._procs):
+            return  # a trainer is still running
+        ids = sorted(self._procs)
+        self._stop_hosts(ids)
+        for h in ids:
+            self._finished.setdefault(h, 0)
+            if self.monitor is not None:
+                self.monitor.retire_host(h)
+            self._event("host_exit", host=h, rc=0,
+                        note="input host stopped after trainers finished")
 
     def _handle_incident(self, failures: list[Failure]) -> int | None:
         """One detect→decide→act→recovered cycle; returns the run's exit
@@ -804,6 +884,13 @@ class GangCoordinator(ChaosTarget):
         target = None
         if self._last_fleet_step is not None:
             target = self._last_fleet_step + self.drain_step_margin
+        # Input hosts don't watch drain.json (they have no step to
+        # converge on) — stop them up front (SIGTERM drains the service
+        # cleanly) so the wait below covers only trainer ranks instead
+        # of burning the whole grace on a role that can never exit it.
+        input_live = [h for h in self._procs if h in self.input_host_ids]
+        if input_live:
+            self._stop_hosts(input_live)
         drain_file = None
         if self.ft_dir is not None:
             drain_file = request_drain(self.ft_dir, step=target)

@@ -38,6 +38,11 @@ EVENT_KINDS = (
     "ckpt_blacklist_expired",  # a newer finalized step retired the blacklist
     # serve-tier specifics (ISSUE 9)
     "relaunch_skipped",  # old serve thread outlived the join; slot stays dead
+    # disaggregated input plane (ISSUE 11): input-host failures degrade
+    # trainers to local loading — they never restart the gang or touch
+    # the restart budget
+    "input_degraded",    # an input host died/hung; trainers load locally
+    "input_recovered",   # the input host was solo-relaunched
     # chaos bookkeeping (ISSUE 4/7 harness)
     "chaos_preempt_notice",
     "chaos_ckpt_corrupted",

@@ -90,6 +90,36 @@ class Launcher:
     # step blacklist on a corruption retry, ISSUE 7) reaches the ranks
     # without the contract changing.  Applied last, so it can override.
     extra_env: dict[str, str] = dataclasses.field(default_factory=dict)
+    # Disaggregated input plane (ISSUE 11): the LAST ``input_hosts`` of
+    # the launched slice serve batches instead of training.  They run
+    # ``input_argv`` (default: the same argv — role-switching jobs read
+    # TPUCFN_ROLE), bind ``input_port + host_id``, and every host gets
+    # TPUCFN_INPUT_ADDRS so trainers know where the batches are.
+    # Trainer ranks see TPUCFN_WORKERS_COUNT = the TRAINER count — the
+    # jax.distributed rendezvous is over accelerator hosts only; input
+    # hosts never join it (they never import jax at all).
+    input_hosts: int = 0
+    input_port: int | None = None
+    input_argv: list[str] | None = dataclasses.field(default=None)
+
+    @property
+    def trainer_count(self) -> int:
+        return self.contract.workers_count - self.input_hosts
+
+    @property
+    def trainer_host_ids(self) -> list[int]:
+        return list(range(self.trainer_count))
+
+    @property
+    def input_host_ids(self) -> list[int]:
+        return list(range(self.trainer_count, self.contract.workers_count))
+
+    def _input_base_port(self) -> int:
+        if self.input_port is not None:
+            return self.input_port
+        from tpucfn.data.service import DEFAULT_INPUT_PORT
+
+        return DEFAULT_INPUT_PORT
 
     def host_env(self, host_id: int) -> dict[str, str]:
         env = self.contract.to_env()
@@ -100,8 +130,31 @@ class Launcher:
             env["TPUCFN_FT_DIR"] = self.ft_dir
             if self.ft_heartbeat_s is not None:
                 env["TPUCFN_FT_HEARTBEAT_S"] = repr(float(self.ft_heartbeat_s))
+        if self.input_hosts > 0:
+            if self.trainer_count < 1:
+                raise ValueError(
+                    f"input_hosts={self.input_hosts} leaves no trainer in "
+                    f"a {self.contract.workers_count}-host slice")
+            base = self._input_base_port()
+            hosts = self.contract.hosts()[: self.contract.workers_count]
+            env["TPUCFN_ROLE"] = ("input" if host_id in self.input_host_ids
+                                  else "trainer")
+            # the rendezvous (and every per-trainer shard split) is over
+            # trainer ranks only
+            env["TPUCFN_WORKERS_COUNT"] = str(self.trainer_count)
+            env["TPUCFN_INPUT_ADDRS"] = ",".join(
+                f"{hosts[h].rsplit(':', 1)[0]}:{base + h}"
+                for h in self.input_host_ids)
+            if host_id in self.input_host_ids:
+                env["TPUCFN_INPUT_PORT"] = str(base + host_id)
         env.update(self.extra_env)
         return env
+
+    def _argv_for_host(self, argv: Sequence[str], host_id: int) -> list[str]:
+        if self.input_hosts > 0 and host_id in self.input_host_ids \
+                and self.input_argv is not None:
+            return list(self.input_argv)
+        return list(argv)
 
     def launch(
         self,
@@ -132,7 +185,9 @@ class Launcher:
             )
         procs = []
         for host_id, host in enumerate(hosts):
-            procs.append(self.transport.run(host, argv, self.host_env(host_id)))
+            procs.append(self.transport.run(
+                host, self._argv_for_host(argv, host_id),
+                self.host_env(host_id)))
         if kill_host_after is not None:
             import threading
 
@@ -156,7 +211,8 @@ class Launcher:
         if not 0 <= host_id < len(hosts):
             raise ValueError(
                 f"host_id {host_id} out of range for {len(hosts)} hosts")
-        return self.transport.run(hosts[host_id], argv,
+        return self.transport.run(hosts[host_id],
+                                  self._argv_for_host(argv, host_id),
                                   self.host_env(host_id))
 
     def stop_all(self, procs: Sequence[subprocess.Popen], *,
