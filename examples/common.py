@@ -408,7 +408,16 @@ def _train_loop_body(trainer, ds, mesh, args, items_per_step, extra_axes,
             # batches nobody will consume through eval/final-save.
             close_stream = getattr(stream, "close", None)
             if close_stream is not None:
-                close_stream()
+                try:
+                    close_stream()
+                except ValueError:
+                    # A plain LOCAL generator can still be mid-__next__
+                    # in the prefetch thread ("generator already
+                    # executing") — close is best-effort cleanup there;
+                    # the service-backed stream (what the close exists
+                    # for) closes through its own object, not the
+                    # generator protocol.
+                    pass
         run_eval(state, int(state.step))
         t0_ckpt = time.monotonic()
         if ckpt.save(int(state.step), state, force=True):

@@ -1,0 +1,70 @@
+"""Coordinator child process for the crash-safety e2e drill
+(ISSUE 12): built the way ``tpucfn launch --ft`` builds it, run under
+``run_supervised`` by the test.  All knobs come from CRASHSAFE_* env:
+
+* ``CRASHSAFE_CHAOS`` — "" (reference), "kill_step" (SIGKILL host 0 at
+  fleet step CRASHSAFE_KILL_STEP), or "kill_coordinator" (the op
+  SIGKILLs the coordinator itself at CRASHSAFE_KILL_AT_S);
+* ``TPUCFN_CRASH_AT`` — crash-point label the coordinator honors
+  (e.g. after_intent: die between a decision's intent and its act).
+
+The relaunched incarnation runs this same script; finding the
+unfinished journal, it adopts the fleet instead of launching one —
+which is the whole point of the drill."""
+
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tpucfn.bootstrap import EnvContract  # noqa: E402
+from tpucfn.ft import (  # noqa: E402
+    ChaosEvent,
+    ChaosSpec,
+    GangCoordinator,
+    HeartbeatMonitor,
+    MonitorConfig,
+    RestartBudget,
+    SoloRestart,
+)
+from tpucfn.launch import Launcher, LocalTransport  # noqa: E402
+
+
+def main() -> int:
+    run_dir = Path(os.environ["CRASHSAFE_RUN_DIR"])
+    n = int(os.environ.get("CRASHSAFE_HOSTS", "2"))
+    ft_dir = run_dir / "ft"
+    hostfile = run_dir / "hostfile"
+    if not hostfile.exists():
+        hostfile.write_text("".join("127.0.0.1:0\n" for _ in range(n)))
+    contract = EnvContract(
+        workers_path=str(hostfile), workers_count=n, worker_chip_count=1,
+        coordinator="127.0.0.1:1234", host_id=0, storage=str(run_dir),
+        generation=1)
+    launcher = Launcher(contract, LocalTransport(), ft_dir=str(ft_dir),
+                        ft_heartbeat_s=0.05)
+    chaos = None
+    mode = os.environ.get("CRASHSAFE_CHAOS", "")
+    if mode == "kill_step":
+        chaos = ChaosSpec(events=(ChaosEvent(
+            action="kill", at_step=int(os.environ["CRASHSAFE_KILL_STEP"]),
+            host=0),))
+    elif mode == "kill_coordinator":
+        chaos = ChaosSpec(events=(ChaosEvent(
+            action="kill_coordinator",
+            at_s=float(os.environ.get("CRASHSAFE_KILL_AT_S", "0.8"))),))
+    monitor = HeartbeatMonitor(
+        ft_dir, expected_hosts=n,
+        config=MonitorConfig(interval_s=0.05, startup_grace_s=15.0))
+    worker = str(Path(__file__).resolve().parent
+                 / "crashsafe_e2e_worker.py")
+    coord = GangCoordinator(
+        launcher, [sys.executable, worker],
+        policy=SoloRestart(RestartBudget(3)), monitor=monitor,
+        ft_dir=ft_dir, poll_interval=0.02, term_grace_s=1.0, chaos=chaos)
+    return coord.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

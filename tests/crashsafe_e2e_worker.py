@@ -1,0 +1,65 @@
+"""Stdlib-only deterministic toy trainer for the coordinator
+crash-safety drill (ISSUE 12).
+
+Mirrors ft_e2e_worker.py's recovery-plane behavior without the
+jax/orbax import cost (the drill kills the COORDINATOR, not jax):
+heartbeats via HeartbeatWriter (jax-free), a JSON checkpoint host 0
+atomically rewrites every CRASHSAFE_CKPT_EVERY steps, resume-from-
+checkpoint on startup, and a per-step loss trajectory appended to
+JSONL.  The math is exactly deterministic — w ← 0.9·w + 0.1 — so any
+two runs agree bit-for-bit wherever their step ranges overlap, which
+is what lets the drill compare a twice-supervised run against an
+uninterrupted reference."""
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tpucfn.ft import HeartbeatWriter  # noqa: E402  (jax-free)
+
+
+def main() -> int:
+    host = int(os.environ.get("TPUCFN_HOST_ID", "0"))
+    run_dir = Path(os.environ["CRASHSAFE_RUN_DIR"])
+    total = int(os.environ.get("CRASHSAFE_TOTAL_STEPS", "40"))
+    ckpt_every = int(os.environ.get("CRASHSAFE_CKPT_EVERY", "10"))
+    step_sleep = float(os.environ.get("CRASHSAFE_STEP_SLEEP", "0.05"))
+    ft_dir = os.environ.get("TPUCFN_FT_DIR", "").strip()
+    hb_s = float(os.environ.get("TPUCFN_FT_HEARTBEAT_S", "0.05") or 0.05)
+    hb = None
+    if ft_dir:
+        hb = HeartbeatWriter(ft_dir, host_id=host, interval_s=hb_s,
+                             role="trainer").start()
+    ckpt = run_dir / "ckpt.json"
+    step, w = 0, 10.0
+    if ckpt.exists():
+        rec = json.loads(ckpt.read_text())
+        step, w = int(rec["step"]), float(rec["w"])
+    losses = run_dir / f"losses-host{host:03d}.jsonl"
+    try:
+        with open(losses, "a") as f:
+            while step < total:
+                w = 0.9 * w + 0.1
+                step += 1
+                f.write(json.dumps({"step": step, "w": w,
+                                    "pid": os.getpid()}) + "\n")
+                f.flush()
+                if hb is not None:
+                    hb.update_step(step)
+                time.sleep(step_sleep)
+                if host == 0 and step % ckpt_every == 0:
+                    tmp = ckpt.with_suffix(".tmp")
+                    tmp.write_text(json.dumps({"step": step, "w": w}))
+                    tmp.replace(ckpt)  # atomic: a kill never tears it
+    finally:
+        if hb is not None:
+            hb.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1051,3 +1051,146 @@ def test_cardinality_fingerprint_stable_under_line_motion(tmp_path):
               rules=["registry-cardinality"])[0]
     assert a.fingerprint == b.fingerprint
     assert a.line != b.line
+
+
+# -- decision-totality (ISSUE 12 satellite) ---------------------------------
+
+# A FailureKind-shaped enum whose decision table misses a member: the
+# class exists, is detected, and silently falls through to the default.
+TOTALITY_MISSING_ROW = '''
+import enum
+
+
+class Kind(enum.Enum):
+    CRASH = "crash"
+    HANG = "hang"
+    PREEMPT = "preempt"
+
+
+class Act(enum.Enum):
+    NONE = "none"
+    RESTART = "restart"
+
+
+DECISION_TABLE = {
+    Kind.CRASH: Act.RESTART,
+    Kind.HANG: Act.RESTART,
+}
+
+
+def decide(kind):
+    act = DECISION_TABLE.get(kind, Act.NONE)
+    if act is Act.RESTART:
+        return "restart"
+    return None
+'''
+
+# Total table, every action acted on: must stay silent.
+TOTALITY_TOTAL = TOTALITY_MISSING_ROW.replace(
+    "    Kind.HANG: Act.RESTART,\n}",
+    "    Kind.HANG: Act.RESTART,\n    Kind.PREEMPT: Act.NONE,\n}")
+
+# Total table whose decided action nothing references outside the
+# table: decided, then dropped on the floor.
+TOTALITY_UNREACHABLE = '''
+import enum
+
+
+class Kind(enum.Enum):
+    CRASH = "crash"
+
+
+class Act(enum.Enum):
+    RESTART = "restart"
+    EVICT = "evict"
+
+
+DECISION_TABLE = {
+    Kind.CRASH: Act.EVICT,
+}
+
+
+def decide(kind):
+    return DECISION_TABLE.get(kind)
+'''
+
+# A partial enum-keyed dict NOT named *TABLE*: partial maps are often
+# intentional — only decision tables claim totality by their name.
+TOTALITY_PARTIAL_NON_TABLE = '''
+import enum
+
+
+class Kind(enum.Enum):
+    CRASH = "crash"
+    HANG = "hang"
+
+
+PRETTY = {
+    Kind.CRASH: "a crash",
+}
+
+
+def label(kind):
+    if kind is Kind.HANG:
+        return "a hang"
+    return PRETTY.get(kind)
+'''
+
+
+def test_totality_missing_row_fires(tmp_path):
+    fs = check(tmp_path, {"policy.py": TOTALITY_MISSING_ROW},
+               rules=["decision-totality"])
+    assert len(fs) == 1
+    assert fs[0].rule == "decision-totality"
+    assert "Kind.PREEMPT" in fs[0].message
+    assert fs[0].key == "missing:DECISION_TABLE:Kind.PREEMPT"
+
+
+def test_totality_total_table_is_silent(tmp_path):
+    assert check(tmp_path, {"policy.py": TOTALITY_TOTAL},
+                 rules=["decision-totality"]) == []
+
+
+def test_totality_unreachable_action_fires(tmp_path):
+    fs = check(tmp_path, {"policy.py": TOTALITY_UNREACHABLE},
+               rules=["decision-totality"])
+    assert len(fs) == 1
+    assert "no actor" in fs[0].message
+    assert fs[0].key == "unreachable:DECISION_TABLE:Act.EVICT"
+
+
+def test_totality_partial_non_table_dict_is_silent(tmp_path):
+    assert check(tmp_path, {"m.py": TOTALITY_PARTIAL_NON_TABLE},
+                 rules=["decision-totality"]) == []
+
+
+def test_totality_unknown_member_in_row_fires(tmp_path):
+    src = TOTALITY_TOTAL.replace("Kind.PREEMPT: Act.NONE",
+                                 "Kind.PREEMTP: Act.NONE")
+    fs = check(tmp_path, {"policy.py": src}, rules=["decision-totality"])
+    keys = {f.key for f in fs}
+    # the typo'd key is unknown AND the real member now has no row
+    assert "unknown-key:DECISION_TABLE:Kind.PREEMTP" in keys
+    assert "missing:DECISION_TABLE:Kind.PREEMTP" not in keys
+    assert "missing:DECISION_TABLE:Kind.PREEMPT" in keys
+
+
+def test_totality_cross_module_actor_counts(tmp_path):
+    """The actor may live in another module (the repo's own shape: the
+    coordinator branches on actions policy.py decides)."""
+    policy = TOTALITY_UNREACHABLE
+    actor = '''
+from pkg.policy import Act
+
+
+def act(decision):
+    if decision is Act.EVICT:
+        return "evicting"
+'''
+    assert check(tmp_path, {"policy.py": policy, "coord.py": actor},
+                 rules=["decision-totality"]) == []
+
+
+def test_totality_silent_without_enums(tmp_path):
+    assert check(tmp_path, {"m.py": "X_TABLE = {1: 2}\n"},
+                 rules=["decision-totality"]) == []

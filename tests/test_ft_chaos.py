@@ -251,3 +251,79 @@ def test_serve_ops_default_to_not_implemented():
                  lambda: base.slow_replica(0, 0.1, 1.0)):
         with pytest.raises(NotImplementedError):
             call()
+
+
+def test_kill_coordinator_op_roundtrip_dispatch_and_fire_hook():
+    """ISSUE 12: kill_coordinator rides the same spec machinery, never
+    draws an RNG victim (hostless — later unpinned events must resolve
+    the same victims with or without it), and the on_fire hook runs
+    BEFORE dispatch (the write-ahead contract: a kill_coordinator must
+    be journaled before it kills the journaler)."""
+
+    class CoordRecorder(ChaosTarget):
+        def __init__(self):
+            self.calls = []
+
+        def num_hosts(self):
+            return 2
+
+        def kill_host(self, host_id):
+            self.calls.append(("kill", host_id))
+
+        def kill_coordinator(self):
+            self.calls.append(("kill_coordinator",))
+
+    spec = ChaosSpec(events=(
+        ChaosEvent(action="kill_coordinator", at_s=1.0),
+        ChaosEvent(action="kill", at_s=2.0),
+    ), seed=3)
+    assert ChaosSpec.from_json(json.dumps(spec.to_json())) == spec
+    fired_hook = []
+    t = CoordRecorder()
+    eng = ChaosEngine(
+        spec, t, on_fire=lambda i, ev, host: fired_hook.append(
+            (i, ev.action, host, list(t.calls))))
+    eng.tick(2.5)
+    assert t.calls[0] == ("kill_coordinator",)
+    # the hook saw each firing BEFORE its action ran
+    assert fired_hook[0][:3] == (0, "kill_coordinator", None)
+    assert fired_hook[0][3] == []  # no calls yet at hook time
+    assert fired_hook[1][1] == "kill"
+    # the unpinned kill drew the same victim a no-kill_coordinator spec
+    # would (hostless actions never consume the seeded RNG)
+    t2 = CoordRecorder()
+    ChaosEngine(ChaosSpec(events=(ChaosEvent(action="kill", at_s=2.0),),
+                          seed=3), t2).tick(2.5)
+    assert t.calls[1] == t2.calls[0]
+    with pytest.raises(NotImplementedError):
+        ChaosTarget().kill_coordinator()
+
+
+def test_skip_fired_drops_already_fired_events():
+    """An adopting coordinator replays chaos_fired journal records into
+    skip_fired: those spec indices must not re-fire (a kill_coordinator
+    would otherwise kill every incarnation forever)."""
+
+    class R(ChaosTarget):
+        def __init__(self):
+            self.calls = []
+
+        def num_hosts(self):
+            return 2
+
+        def kill_host(self, host_id):
+            self.calls.append(("kill", host_id))
+
+        def kill_coordinator(self):
+            self.calls.append(("kill_coordinator",))
+
+    spec = ChaosSpec(events=(
+        ChaosEvent(action="kill_coordinator", at_s=0.5),
+        ChaosEvent(action="kill", at_s=1.0, host=1),
+    ))
+    t = R()
+    eng = ChaosEngine(spec, t)
+    eng.skip_fired({0})  # index 0 fired in a previous incarnation
+    eng.tick(2.0)
+    assert t.calls == [("kill", 1)]
+    assert eng.done()

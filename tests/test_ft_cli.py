@@ -78,3 +78,50 @@ def test_launch_without_ft_has_no_ft_dir(tmp_path, capsys):
             "sys.exit(1 if 'TPUCFN_FT_DIR' in os.environ else 0)")
     assert _cli(tmp_path, "launch", "--name", "plain", "--",
                 sys.executable, "-c", code) == 0
+
+
+def test_supervise_requires_ft(tmp_path, capsys):
+    """--supervise without --ft must refuse loudly: the journal and
+    adoption live under the ft dir (ISSUE 12)."""
+    assert _cli(tmp_path, "create-stack", "--name", "sup",
+                "--accelerator", "v4-16") == 0
+    rc = _cli(tmp_path, "launch", "--name", "sup", "--supervise", "--",
+              sys.executable, "-c", "pass")
+    assert rc == 2
+    assert "--supervise needs --ft" in capsys.readouterr().err
+
+
+def test_launch_ft_journals_and_no_adopt_flag(tmp_path, capsys):
+    """A --ft launch writes the run journal; a second run over the same
+    ft dir with --no-adopt starts fresh (the first run's journal is
+    rotated aside, not adopted)."""
+    from tpucfn.ft import replay_journal
+    from tpucfn.ft.journal import journal_path
+
+    assert _cli(tmp_path, "create-stack", "--name", "jrn",
+                "--accelerator", "v4-16") == 0
+    assert _cli(tmp_path, "launch", "--name", "jrn", "--ft", "--",
+                sys.executable, "-c", "pass") == 0
+    ft_dir = tmp_path / "state" / "clusters" / "jrn" / "ft"
+    st, _, _ = replay_journal(journal_path(ft_dir))
+    assert st.started and st.done_rc == 0
+    capsys.readouterr()
+    assert _cli(tmp_path, "launch", "--name", "jrn", "--ft", "--no-adopt",
+                "--", sys.executable, "-c", "pass") == 0
+    assert (ft_dir / "journal" / "journal-prev.jsonl").is_file()
+    st2, _, _ = replay_journal(journal_path(ft_dir))
+    assert st2.done_rc == 0 and st2.adoptions == 0
+
+
+def test_adopt_and_no_adopt_are_mutually_exclusive(tmp_path, capsys):
+    """--adopt --no-adopt on one command line is a usage error, not a
+    silent resolution in --adopt's favor (an alias that already carried
+    --adopt must not adopt a stale fleet when the operator appends
+    --no-adopt asking for a fresh launch)."""
+    import pytest
+
+    with pytest.raises(SystemExit) as e:
+        _cli(tmp_path, "launch", "--name", "x", "--ft", "--adopt",
+             "--no-adopt", "--", sys.executable, "-c", "pass")
+    assert e.value.code == 2
+    assert "not allowed with" in capsys.readouterr().err

@@ -1,0 +1,787 @@
+"""Coordinator crash-safety (ISSUE 12): the write-ahead run journal,
+replay, crash-point injection, and fleet adoption.
+
+The acceptance properties pinned here in fast tests:
+
+* replay of EVERY byte prefix of a recorded journal yields a valid
+  state (the torn final record is the crash boundary, by design);
+* a checksum-corrupt record anywhere else refuses loudly;
+* a coordinator crash injected between a decision's intent and commit
+  records neither drops nor doubles the restart on adoption, and the
+  restart budget continues from its pre-crash value.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from tpucfn.bootstrap import EnvContract
+from tpucfn.ft import (
+    GangCoordinator,
+    GangRestart,
+    JournalError,
+    JournalWriter,
+    RestartBudget,
+    SoloRestart,
+    replay_journal,
+)
+from tpucfn.ft.journal import (
+    AdoptedProcess,
+    crash_point,
+    decode_record,
+    encode_record,
+    journal_path,
+    write_rc,
+)
+from tpucfn.launch import Launcher, LocalTransport
+from tpucfn.obs import MetricRegistry
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _contract(tmp_path, n=2) -> EnvContract:
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("".join("127.0.0.1:0\n" for _ in range(n)))
+    return EnvContract(
+        workers_path=str(hostfile), workers_count=n, worker_chip_count=1,
+        coordinator="127.0.0.1:1234", host_id=0, storage=str(tmp_path),
+        generation=1)
+
+
+def _launcher(tmp_path, n=2, **kw) -> Launcher:
+    return Launcher(_contract(tmp_path, n), LocalTransport(), **kw)
+
+
+def _events(ft_dir) -> list[dict]:
+    p = Path(ft_dir) / "events.jsonl"
+    if not p.is_file():
+        return []
+    return [json.loads(s) for s in p.read_text().splitlines() if s.strip()]
+
+
+# -- record framing ---------------------------------------------------------
+
+def test_record_roundtrip_and_checksum():
+    rec = {"seq": 1, "kind": "run_start", "argv": ["a", "b"], "ts": 1.5}
+    line = encode_record(rec)
+    assert decode_record(line) == rec
+    # a flipped payload byte fails the checksum
+    bad = line[:12] + ("x" if line[12] != "x" else "y") + line[13:]
+    assert decode_record(bad) is None
+    # garbage framing is None, not an exception
+    assert decode_record("nonsense") is None
+    assert decode_record("") is None
+
+
+def test_writer_appends_and_replay_reconstructs(tmp_path):
+    p = tmp_path / "journal.jsonl"
+    with JournalWriter(p) as j:
+        j.append("run_start", argv=["x"], hosts=2, policy="gang",
+                 max_restarts=3)
+        j.append("gang_launched", first=True, pids={"0": 11, "1": 12})
+        j.append("incident_open", incident=1,
+                 failures=[{"host": 0, "kind": "crash", "rc": 9}])
+        j.append("restart_intent", incident=1, action="gang_restart",
+                 hosts=[], budget_used=1)
+        j.append("gang_launched", first=False, pids={"0": 21, "1": 22})
+        j.append("restart_commit", incident=1, action="gang_restart")
+        j.append("host_exit", host=1, rc=0)
+    st, records, torn = replay_journal(p)
+    assert not torn and len(records) == 7
+    assert st.started and st.done_rc is None
+    assert st.budget_used == 1 and st.incident == 1
+    assert st.procs == {0: 21} and st.finished == {1: 0}
+    assert st.pending is None  # committed
+
+
+def test_unknown_kind_refused_at_append(tmp_path):
+    with JournalWriter(tmp_path / "j.jsonl") as j:
+        with pytest.raises(ValueError, match="JOURNAL_KINDS"):
+            j.append("restart_intnet")  # the typo the tuple exists for
+
+
+def test_every_byte_prefix_replays_to_valid_state(tmp_path):
+    """The acceptance property: any prefix — including one cut mid-
+    record — replays without error, and the state is monotone in the
+    prefix length (seq never decreases)."""
+    p = tmp_path / "journal.jsonl"
+    with JournalWriter(p) as j:
+        j.append("run_start", argv=["x"], hosts=2, policy="solo",
+                 max_restarts=2)
+        j.append("gang_launched", first=True, pids={"0": 11, "1": 12})
+        j.append("incident_open", incident=1,
+                 failures=[{"host": 1, "kind": "crash", "rc": 1}])
+        j.append("restart_intent", incident=1, action="solo_restart",
+                 hosts=[1], budget_used=1)
+        j.append("solo_launched", host=1, pid=33)
+        j.append("restart_commit", incident=1, action="solo_restart")
+        j.append("host_exit", host=0, rc=0)
+        j.append("host_exit", host=1, rc=0)
+        j.append("done", rc=0)
+    data = p.read_bytes()
+    prev_seq = 0
+    for cut in range(len(data) + 1):
+        q = tmp_path / "prefix.jsonl"
+        q.write_bytes(data[:cut])
+        st, records, torn = replay_journal(q)
+        assert 0 <= st.seq <= 9
+        assert st.seq == len(records)
+        assert st.seq >= prev_seq  # monotone in the prefix length
+        prev_seq = st.seq
+        if st.pending is not None:
+            assert st.pending.action == "solo_restart"
+            assert st.seq >= 4
+        if cut == len(data):
+            assert st.done_rc == 0 and not torn
+    # the full replay agrees with the writer
+    st, _, _ = replay_journal(p)
+    assert st.seq == 9 and st.budget_used == 1
+
+
+def test_corrupt_middle_record_refuses_loudly(tmp_path):
+    p = tmp_path / "journal.jsonl"
+    with JournalWriter(p) as j:
+        for _ in range(3):
+            j.append("incident_open", incident=1, failures=[])
+    lines = p.read_text().splitlines()
+    lines[1] = lines[1][:-3] + "xxx"  # corrupt the MIDDLE record
+    p.write_text("\n".join(lines) + "\n")
+    with pytest.raises(JournalError, match="corrupt"):
+        replay_journal(p)
+    # ...but the same damage on the FINAL record is the crash boundary
+    with JournalWriter(tmp_path / "j2.jsonl") as j:
+        for _ in range(3):
+            j.append("incident_open", incident=1, failures=[])
+    p2 = tmp_path / "j2.jsonl"
+    lines = p2.read_text().splitlines()
+    lines[-1] = lines[-1][:-3] + "xxx"
+    p2.write_text("\n".join(lines) + "\n")
+    st, records, torn = replay_journal(p2)
+    assert torn and len(records) == 2
+
+
+def test_sequence_gap_is_corruption(tmp_path):
+    p = tmp_path / "journal.jsonl"
+    with JournalWriter(p) as j:
+        j.append("incident_open", incident=1, failures=[])
+        j.append("incident_open", incident=2, failures=[])
+        j.append("incident_open", incident=3, failures=[])
+    lines = p.read_text().splitlines()
+    del lines[1]  # a validly-checksummed stream with a missing middle
+    p.write_text("\n".join(lines) + "\n")
+    with pytest.raises(JournalError, match="sequence gap"):
+        replay_journal(p)
+
+
+# -- crash points -----------------------------------------------------------
+
+def test_crash_point_sigkills_once(tmp_path):
+    script = (
+        "import os, sys\n"
+        "sys.path.insert(0, os.environ['REPO'])\n"
+        "from tpucfn.ft.journal import crash_point\n"
+        "crash_point('here', os.environ['MARKER_DIR'])\n"
+        "print('survived')\n")
+    env = {**os.environ, "REPO": str(REPO), "TPUCFN_CRASH_AT": "here",
+           "MARKER_DIR": str(tmp_path)}
+    r1 = subprocess.run([sys.executable, "-c", script], env=env,
+                        capture_output=True, text=True, timeout=30)
+    assert r1.returncode == -signal.SIGKILL
+    assert (tmp_path / "crashed-here").is_file()
+    # second incarnation: the marker makes the same label a no-op
+    r2 = subprocess.run([sys.executable, "-c", script], env=env,
+                        capture_output=True, text=True, timeout=30)
+    assert r2.returncode == 0 and "survived" in r2.stdout
+    # unrelated label never fires
+    env2 = {**env, "TPUCFN_CRASH_AT": "elsewhere"}
+    r3 = subprocess.run([sys.executable, "-c", script], env=env2,
+                        capture_output=True, text=True, timeout=30)
+    assert r3.returncode == 0
+
+
+def test_crash_point_noop_without_env(tmp_path):
+    os.environ.pop("TPUCFN_CRASH_AT", None)
+    crash_point("anything", tmp_path)  # must simply return
+
+
+# -- adopted process handles -------------------------------------------------
+
+def test_adopted_process_liveness_and_signals(tmp_path):
+    p = subprocess.Popen([sys.executable, "-c",
+                          "import time; time.sleep(30)"])
+    try:
+        a = AdoptedProcess(p.pid, ft_dir=tmp_path)
+        assert a.poll() is None
+        a.terminate()
+        # the test process is the real parent: reap the zombie so the
+        # pid actually disappears (in production init/--supervise does)
+        p.wait()
+        # no rc file, but WE sent the TERM: the exit is attributed to it
+        assert a.wait(timeout=10) == -signal.SIGTERM
+        assert a.poll() == -signal.SIGTERM
+    finally:
+        p.kill()
+        p.wait()
+
+
+def test_adopted_process_reads_reaper_rc_file(tmp_path):
+    p = subprocess.Popen([sys.executable, "-c", "import sys; sys.exit(0)"])
+    p.wait()
+    write_rc(tmp_path, p.pid, 0)
+    a = AdoptedProcess(p.pid, ft_dir=tmp_path)
+    assert a.poll() == 0  # a clean adopted exit reads clean, not CRASH
+
+
+def test_adopted_process_unknown_death_degrades_to_failure(tmp_path):
+    p = subprocess.Popen([sys.executable, "-c", "import sys; sys.exit(3)"])
+    p.wait()  # dead, and nobody wrote an rc file
+    t = {"now": 100.0}
+    a = AdoptedProcess(p.pid, ft_dir=tmp_path, rc_grace_s=2.0,
+                       clock=lambda: t["now"])
+    assert a.poll() is None  # inside the reaper grace: not judged yet
+    t["now"] += 2.5
+    assert a.poll() == 1  # unexplained death is a failure, never clean
+
+
+# -- fleet adoption ---------------------------------------------------------
+
+# Two-host protocol for the crash drills: host 1 sleeps until killed,
+# its SECOND incarnation writes h1_done and exits; host 0 (the healthy
+# host adoption must not disturb) exits clean once h1_done appears.
+CRASH_WORKER = (
+    "import os, pathlib, sys, time\n"
+    "fd = pathlib.Path(os.environ['FLAG_DIR'])\n"
+    "if os.environ['TPUCFN_HOST_ID'] == '1':\n"
+    "    if (fd / 'second_1').exists():\n"
+    "        (fd / 'h1_done').write_text('x'); sys.exit(0)\n"
+    "    (fd / 'second_1').write_text('x')\n"
+    "    time.sleep(30); sys.exit(1)\n"
+    "deadline = time.time() + 30\n"
+    "while not (fd / 'h1_done').exists():\n"
+    "    time.sleep(0.02)\n"
+    "    assert time.time() < deadline\n"
+    "sys.exit(0)\n")
+
+
+def _be_subreaper():
+    """Make the test process the child subreaper so a killed
+    coordinator subprocess's workers reparent to US (not init) and can
+    be reaped into rc files — exactly what --supervise does in
+    production.  Returns an undo callable."""
+    import ctypes
+
+    libc = ctypes.CDLL(None, use_errno=True)
+    assert libc.prctl(36, 1, 0, 0, 0) == 0  # PR_SET_CHILD_SUBREAPER
+    return lambda: libc.prctl(36, 0, 0, 0, 0)
+
+
+def _reap_orphans_into_rc(ft_dir, pids):
+    """Background reaper for the orphans we inherited as subreaper:
+    per-pid waitpid (never waitpid(-1) — that would steal the adopting
+    coordinator's own children) landing real rcs in <ft>/rc/."""
+    import threading
+
+    def reap(pid):
+        try:
+            _, status = os.waitpid(pid, 0)
+        except ChildProcessError:
+            return  # reaped before orphaning (its parent saw it die)
+        rc = (-os.WTERMSIG(status) if os.WIFSIGNALED(status)
+              else os.WEXITSTATUS(status))
+        write_rc(ft_dir, pid, rc)
+
+    threads = [threading.Thread(target=reap, args=(p,), daemon=True)
+               for p in pids]
+    for t in threads:
+        t.start()
+    return threads
+
+
+def _run_crashing_coordinator(tmp_path, crash_at, *, budget=3):
+    """Run a SoloRestart coordinator in a SUBPROCESS with a crash point
+    armed; the scripted chaos kills host 1 at t=0.4s, so the incident's
+    intent is in flight when the crash label fires.  Returns the
+    subprocess result (expected: SIGKILL) and the ft dir."""
+    ft_dir = tmp_path / "ft"
+    script = f"""
+import os, sys
+sys.path.insert(0, {str(REPO)!r})
+from tpucfn.bootstrap import EnvContract
+from tpucfn.ft import (ChaosEvent, ChaosSpec, GangCoordinator,
+                       RestartBudget, SoloRestart)
+from tpucfn.launch import Launcher, LocalTransport
+
+tmp = {str(tmp_path)!r}
+hostfile = os.path.join(tmp, 'hostfile')
+contract = EnvContract(workers_path=hostfile, workers_count=2,
+                       worker_chip_count=1, coordinator='127.0.0.1:1234',
+                       host_id=0, storage=tmp, generation=1)
+launcher = Launcher(contract, LocalTransport())
+coord = GangCoordinator(
+    launcher, [sys.executable, '-c', {CRASH_WORKER!r}],
+    policy=SoloRestart(RestartBudget({budget})),
+    ft_dir={str(ft_dir)!r}, poll_interval=0.01, term_grace_s=0.5,
+    chaos=ChaosSpec(events=(ChaosEvent(action='kill', at_s=0.4,
+                                       host=1),)))
+sys.exit(coord.run())
+"""
+    (tmp_path / "hostfile").write_text("127.0.0.1:0\n127.0.0.1:0\n")
+    env = {**os.environ, "FLAG_DIR": str(tmp_path),
+           "TPUCFN_CRASH_AT": crash_at}
+    # No capture_output: the coordinator's workers inherit its pipes,
+    # so capturing would block this call until the ORPHANS exit — the
+    # exact confusion adoption exists to clean up.
+    return subprocess.run([sys.executable, "-c", script], env=env,
+                          timeout=60), ft_dir
+
+
+def _adopting_coordinator(tmp_path, ft_dir, *, budget=3, registry=None):
+    return GangCoordinator(
+        _launcher(tmp_path, n=2), [sys.executable, "-c", CRASH_WORKER],
+        policy=SoloRestart(RestartBudget(budget)),
+        registry=registry, ft_dir=ft_dir, poll_interval=0.01,
+        term_grace_s=0.5)
+
+
+def _journal_pids(records) -> list[int]:
+    pids = []
+    for r in records:
+        if r["kind"] == "gang_launched":
+            pids.extend(r["pids"].values())
+        elif r["kind"] == "solo_launched":
+            pids.append(r["pid"])
+    return pids
+
+
+def test_crash_between_intent_and_act_restarts_exactly_once(tmp_path):
+    """TPUCFN_CRASH_AT=after_intent: the budget draw is journaled, the
+    relaunch never ran.  Adoption must perform the solo restart ONCE,
+    keep the healthy host's process untouched, and continue the budget
+    at 1 — not reset it, not draw a second slot."""
+    undo = _be_subreaper()
+    try:
+        r, ft_dir = _run_crashing_coordinator(tmp_path, "after_intent")
+        assert r.returncode == -signal.SIGKILL
+        st, records0, _ = replay_journal(journal_path(ft_dir))
+        assert st.pending is not None and not st.pending.launched
+        assert st.pending.action == "solo_restart"
+        assert st.budget_used == 1
+        host0_pid_before = st.procs[0]
+        _reap_orphans_into_rc(ft_dir, _journal_pids(records0))
+        os.environ["FLAG_DIR"] = str(tmp_path)
+        registry = MetricRegistry()
+        try:
+            coord = _adopting_coordinator(tmp_path, ft_dir,
+                                          registry=registry)
+            assert coord.run() == 0
+        finally:
+            del os.environ["FLAG_DIR"]
+    finally:
+        undo()
+    assert coord._adopted
+    assert coord.policy.budget.used == 1  # continued, not reset/redrawn
+    st2, records, _ = replay_journal(journal_path(ft_dir))
+    assert st2.done_rc == 0
+    # exactly one intent, one commit, one solo launch for incident 1
+    intents = [x for x in records if x["kind"] == "restart_intent"]
+    commits = [x for x in records if x["kind"] == "restart_commit"]
+    solos = [x for x in records if x["kind"] == "solo_launched"]
+    assert len(intents) == 1 and len(commits) == 1
+    assert commits[0]["incident"] == intents[0]["incident"]
+    assert len(solos) == 1 and solos[0]["host"] == 1
+    # the healthy host kept its ORIGINAL pid through adoption
+    adopted = next(e for e in _events(ft_dir)
+                   if e["kind"] == "coordinator_adopted")
+    assert 0 in adopted["hosts"]
+    gang_launches = [x for x in records if x["kind"] == "gang_launched"]
+    assert len(gang_launches) == 1  # only the original first launch
+    assert gang_launches[0]["pids"]["0"] == host0_pid_before
+    recovered = [e for e in _events(ft_dir) if e["kind"] == "recovered"]
+    assert len(recovered) == 1 and recovered[0]["adopted"] is True
+    v = registry.varz()["metrics"]
+    assert v["coordinator_adoptions_total"] == 1
+    assert v["ft_solo_restarts_total"] == 1
+
+
+def test_crash_between_act_and_commit_does_not_double_restart(tmp_path):
+    """TPUCFN_CRASH_AT=before_commit: the relaunch ALREADY ran when the
+    coordinator died.  Adoption must only write the commit — the
+    already-relaunched host keeps running; no second restart."""
+    undo = _be_subreaper()
+    try:
+        r, ft_dir = _run_crashing_coordinator(tmp_path, "before_commit")
+        assert r.returncode == -signal.SIGKILL
+        st, records0, _ = replay_journal(journal_path(ft_dir))
+        assert st.pending is not None and st.pending.launched
+        solos_before = [x for x in records0
+                        if x["kind"] == "solo_launched"]
+        assert len(solos_before) == 1
+        relaunched_pid = solos_before[0]["pid"]
+        _reap_orphans_into_rc(ft_dir, _journal_pids(records0))
+        os.environ["FLAG_DIR"] = str(tmp_path)
+        try:
+            coord = _adopting_coordinator(tmp_path, ft_dir)
+            assert coord.run() == 0
+        finally:
+            del os.environ["FLAG_DIR"]
+    finally:
+        undo()
+    assert coord.policy.budget.used == 1
+    st2, records2, _ = replay_journal(journal_path(ft_dir))
+    assert st2.done_rc == 0
+    solos = [x for x in records2 if x["kind"] == "solo_launched"]
+    assert len(solos) == 1 and solos[0]["pid"] == relaunched_pid
+    assert sum(1 for x in records2
+               if x["kind"] == "restart_commit") == 1
+    recovered = [e for e in _events(ft_dir) if e["kind"] == "recovered"]
+    assert len(recovered) == 1
+
+
+def test_finished_journal_starts_fresh_and_rotates(tmp_path):
+    """A done journal is history, not a fleet: the next run must launch
+    fresh, rotate the old journal aside, and start a new one."""
+    coord = GangCoordinator(
+        _launcher(tmp_path, n=1), [sys.executable, "-c", "pass"],
+        ft_dir=tmp_path / "ft", poll_interval=0.01)
+    assert coord.run() == 0
+    jp = journal_path(tmp_path / "ft")
+    st, _, _ = replay_journal(jp)
+    assert st.done_rc == 0
+    coord2 = GangCoordinator(
+        _launcher(tmp_path, n=1), [sys.executable, "-c", "pass"],
+        ft_dir=tmp_path / "ft", poll_interval=0.01)
+    assert coord2.run() == 0
+    assert not coord2._adopted
+    assert (jp.parent / "journal-prev.jsonl").is_file()
+    st2, _, _ = replay_journal(jp)
+    assert st2.done_rc == 0 and st2.adoptions == 0
+
+
+def test_no_adopt_forces_fresh_launch(tmp_path):
+    """adopt=False over an unfinished journal: fresh run, old journal
+    rotated, nothing adopted (the operator's --no-adopt escape)."""
+    ft_dir = tmp_path / "ft"
+    (ft_dir / "journal").mkdir(parents=True)
+    with JournalWriter(journal_path(ft_dir)) as j:
+        j.append("run_start", argv=["x"], hosts=1, policy="gang",
+                 max_restarts=1)
+        j.append("gang_launched", first=True, pids={"0": 999999})
+    coord = GangCoordinator(
+        _launcher(tmp_path, n=1), [sys.executable, "-c", "pass"],
+        ft_dir=ft_dir, poll_interval=0.01, adopt=False)
+    assert coord.run() == 0
+    assert not coord._adopted
+    assert (ft_dir / "journal" / "journal-prev.jsonl").is_file()
+
+
+def test_corrupt_journal_refuses_adoption_loudly(tmp_path):
+    ft_dir = tmp_path / "ft"
+    (ft_dir / "journal").mkdir(parents=True)
+    with JournalWriter(journal_path(ft_dir)) as j:
+        j.append("run_start", argv=["x"], hosts=1, policy="gang",
+                 max_restarts=1)
+        j.append("gang_launched", first=True, pids={"0": 4242})
+        j.append("incident_open", incident=1, failures=[])
+    jp = journal_path(ft_dir)
+    lines = jp.read_text().splitlines()
+    lines[1] = lines[1][:-4] + "zzzz"
+    jp.write_text("\n".join(lines) + "\n")
+    coord = GangCoordinator(
+        _launcher(tmp_path, n=1), [sys.executable, "-c", "pass"],
+        ft_dir=ft_dir, poll_interval=0.01)
+    with pytest.raises(JournalError):
+        coord.run()
+
+
+def test_adoption_attaches_live_fleet_and_finishes_clean(tmp_path):
+    """The core adoption path without any pending incident: a journal
+    names two live pids; the adopting coordinator attaches (no launch),
+    the reaper's rc files tell it the exits were clean, rc 0."""
+    ft_dir = tmp_path / "ft"
+    (ft_dir / "journal").mkdir(parents=True)
+    procs = [subprocess.Popen([sys.executable, "-c",
+                               "import time; time.sleep(0.6)"])
+             for _ in range(2)]
+    with JournalWriter(journal_path(ft_dir)) as j:
+        j.append("run_start", argv=["x"], hosts=2, policy="gang",
+                 max_restarts=2)
+        j.append("gang_launched", first=True,
+                 pids={str(i): p.pid for i, p in enumerate(procs)})
+    # we ARE the parent of these fakes: reap them and land rc files the
+    # way the --supervise reaper would
+    import threading
+
+    def reap():
+        for p in procs:
+            write_rc(ft_dir, p.pid, p.wait())
+
+    t = threading.Thread(target=reap, daemon=True)
+    t.start()
+    registry = MetricRegistry()
+    coord = GangCoordinator(
+        _launcher(tmp_path, n=2), [sys.executable, "-c", "pass"],
+        policy=GangRestart(RestartBudget(2)), registry=registry,
+        ft_dir=ft_dir, poll_interval=0.01, term_grace_s=0.5)
+    launches = []
+    coord.launcher.launch = lambda *a, **k: launches.append(1) or []
+    assert coord.run() == 0
+    t.join(timeout=5)
+    assert coord._adopted and launches == []  # attached, never spawned
+    adopted = next(e for e in _events(ft_dir)
+                   if e["kind"] == "coordinator_adopted")
+    assert adopted["hosts"] == [0, 1] and adopted["dead"] == []
+    assert registry.varz()["metrics"]["coordinator_adoptions_total"] == 1
+
+
+def test_adoption_raises_failure_for_host_dead_while_down(tmp_path):
+    """A journaled pid that is GONE at adoption (no rc file) is exactly
+    one CRASH failure through the normal detect→decide path — the
+    restart budget pays for it like any other crash."""
+    ft_dir = tmp_path / "ft"
+    (ft_dir / "journal").mkdir(parents=True)
+    live = subprocess.Popen([sys.executable, "-c",
+                             "import time; time.sleep(0.8)"])
+    dead = subprocess.Popen([sys.executable, "-c", "pass"])
+    dead.wait()
+    write_rc(ft_dir, dead.pid, 7)  # the reaper saw it crash with rc 7
+    with JournalWriter(journal_path(ft_dir)) as j:
+        j.append("run_start", argv=["x"], hosts=2, policy="solo",
+                 max_restarts=2)
+        j.append("gang_launched", first=True,
+                 pids={"0": live.pid, "1": dead.pid})
+    import threading
+
+    threading.Thread(
+        target=lambda: write_rc(ft_dir, live.pid, live.wait()),
+        daemon=True).start()
+    registry = MetricRegistry()
+    coord = GangCoordinator(
+        _launcher(tmp_path, n=2),
+        [sys.executable, "-c", "pass"],  # the solo relaunch exits clean
+        policy=SoloRestart(RestartBudget(2)), registry=registry,
+        ft_dir=ft_dir, poll_interval=0.01, term_grace_s=0.5)
+    assert coord.run() == 0
+    detect = next(e for e in _events(ft_dir) if e["kind"] == "detect")
+    assert detect["failures"][0]["host"] == 1
+    assert detect["failures"][0]["kind"] == "crash"
+    assert detect["failures"][0]["rc"] == 7
+    assert "coordinator was down" in detect["failures"][0]["detail"]
+    v = registry.varz()["metrics"]
+    assert v["ft_solo_restarts_total"] == 1
+    assert coord.policy.budget.used == 1
+
+
+def test_journal_status_feeds_snapshot_and_health(tmp_path):
+    ft_dir = tmp_path / "ft"
+    coord = GangCoordinator(
+        _launcher(tmp_path, n=1), [sys.executable, "-c", "pass"],
+        ft_dir=ft_dir, poll_interval=0.01)
+    assert coord.run() == 0
+    snap = json.loads((ft_dir / "supervisor.json").read_text())
+    assert snap["adopted"] is False
+    assert snap["journal"]["records"] >= 3  # run_start, launch, ..., done
+    assert snap["journal"]["pending_intent"] is False
+    healthy, detail = coord.health()
+    assert healthy and detail["adopted"] is False
+    assert detail["journal"]["records"] == snap["journal"]["records"]
+
+
+# -- review-pass pins -------------------------------------------------------
+
+def test_repair_torn_tail_truncates_only_the_final_record(tmp_path):
+    """Appending to an adopted journal must not glue the next record
+    onto a torn partial line — that garbled line would no longer be
+    final, and the NEXT replay would refuse the whole journal as
+    corrupt.  repair_torn_tail drops exactly the crash boundary."""
+    from tpucfn.ft.journal import repair_torn_tail
+
+    jp = tmp_path / "journal" / "journal.jsonl"
+    with JournalWriter(jp) as j:
+        j.append("run_start", argv=["x"], hosts=1, policy="gang",
+                 max_restarts=1)
+        j.append("gang_launched", first=True, pids={"0": 4242})
+    clean = jp.read_bytes()
+    assert repair_torn_tail(jp) is False  # no-op on a clean journal
+    assert jp.read_bytes() == clean
+    torn = encode_record({"seq": 3, "ts": 0.0, "kind": "incident_open"})
+    jp.write_bytes(clean + torn[: len(torn) // 2].encode())
+    assert repair_torn_tail(jp) is True
+    assert jp.read_bytes() == clean
+    with JournalWriter(jp, start_seq=2) as j:
+        j.append("incident_open", incident=1, failures=[])
+    st, _, torn_flag = replay_journal(jp)
+    assert st.seq == 3 and not torn_flag
+    # a torn final line WITH a trailing newline is still the tolerated
+    # crash boundary, exactly as replay treats it
+    jp.write_bytes(clean + torn[: len(torn) // 2].encode() + b"\n")
+    assert repair_torn_tail(jp) is True
+    assert jp.read_bytes() == clean
+
+
+def test_repair_torn_tail_refuses_corrupt_middle(tmp_path):
+    from tpucfn.ft.journal import repair_torn_tail
+
+    jp = tmp_path / "journal" / "journal.jsonl"
+    with JournalWriter(jp) as j:
+        j.append("run_start", argv=["x"], hosts=1, policy="gang",
+                 max_restarts=1)
+        j.append("gang_launched", first=True, pids={"0": 4242})
+        j.append("incident_open", incident=1, failures=[])
+    lines = jp.read_text().splitlines()
+    lines[1] = lines[1][:-4] + "zzzz"
+    jp.write_text("\n".join(lines) + "\n")
+    with pytest.raises(JournalError):
+        repair_torn_tail(jp)
+
+
+def test_adoption_over_torn_tail_keeps_the_journal_replayable(tmp_path):
+    """End to end: adopt over a journal whose final record is torn (the
+    SIGKILL-mid-append crash boundary) — the adopting run must repair
+    the tail before appending, so a SECOND replay (the next adoption,
+    or the supervise loop's post-exit check) still accepts it."""
+    ft_dir = tmp_path / "ft"
+    (ft_dir / "journal").mkdir(parents=True)
+    proc = subprocess.Popen([sys.executable, "-c",
+                             "import time; time.sleep(0.6)"])
+    with JournalWriter(journal_path(ft_dir)) as j:
+        j.append("run_start", argv=["x"], hosts=1, policy="gang",
+                 max_restarts=1)
+        j.append("gang_launched", first=True, pids={"0": proc.pid})
+    jp = journal_path(ft_dir)
+    with open(jp, "ab") as f:  # SIGKILL mid-append: a partial line
+        f.write(b'deadbeef {"seq":3,"ts":0.0,"kind":"incid')
+    import threading
+
+    threading.Thread(
+        target=lambda: write_rc(ft_dir, proc.pid, proc.wait()),
+        daemon=True).start()
+    coord = GangCoordinator(
+        _launcher(tmp_path, n=1), [sys.executable, "-c", "pass"],
+        policy=GangRestart(RestartBudget(1)),
+        ft_dir=ft_dir, poll_interval=0.01, term_grace_s=0.5)
+    assert coord.run() == 0
+    assert coord._adopted
+    st, _, torn_flag = replay_journal(jp)  # must NOT raise JournalError
+    assert not torn_flag and st.done_rc == 0
+
+
+def test_replay_gang_launch_completes_a_solo_intent(tmp_path):
+    """The elastic-shrink path can upgrade a SOLO intent to a gang
+    relaunch (the lost host left the contract): the gang_launched act
+    must mark the intent launched, or adoption would redo it solo —
+    double-restarting fresh ranks at host_ids the re-converged
+    contract no longer has."""
+    jp = tmp_path / "journal" / "journal.jsonl"
+    with JournalWriter(jp) as j:
+        j.append("run_start", argv=["x"], hosts=2, policy="solo",
+                 max_restarts=2)
+        j.append("gang_launched", first=True, pids={"0": 11, "1": 12})
+        j.append("incident_open", incident=1, failures=[])
+        j.append("restart_intent", incident=1, action="solo_restart",
+                 hosts=[1], budget_used=1, planned=False)
+        j.append("shrink", lost=[1], to_hosts=[0])
+        j.append("gang_launched", first=False, pids={"0": 21})
+    st, _, _ = replay_journal(jp)
+    assert st.pending is not None
+    assert st.pending.launched is True  # only the commit is owed
+
+
+def test_partial_solo_intent_relaunches_only_the_missing_hosts(tmp_path):
+    """A multi-host SOLO intent whose first solo_launched landed before
+    the crash: adoption must relaunch ONLY the hosts still missing —
+    redoing the already-relaunched host would be the double the
+    intent/commit pair exists to prevent."""
+    ft_dir = tmp_path / "ft"
+    (ft_dir / "journal").mkdir(parents=True)
+    relaunched0 = subprocess.Popen([sys.executable, "-c",
+                                    "import time; time.sleep(0.8)"])
+    dead1 = subprocess.Popen([sys.executable, "-c", "pass"])
+    dead1.wait()
+    write_rc(ft_dir, dead1.pid, 9)  # the reaper saw host 1 crash
+    with JournalWriter(journal_path(ft_dir)) as j:
+        j.append("run_start", argv=["x"], hosts=2, policy="solo",
+                 max_restarts=4)
+        j.append("gang_launched", first=True,
+                 pids={"0": 77777, "1": dead1.pid})
+        j.append("incident_open", incident=1, failures=[])
+        j.append("restart_intent", incident=1, action="solo_restart",
+                 hosts=[0, 1], budget_used=2, planned=False)
+        j.append("solo_launched", host=0, pid=relaunched0.pid)
+    import threading
+
+    threading.Thread(
+        target=lambda: write_rc(ft_dir, relaunched0.pid,
+                                relaunched0.wait()),
+        daemon=True).start()
+    registry = MetricRegistry()
+    coord = GangCoordinator(
+        _launcher(tmp_path, n=2), [sys.executable, "-c", "pass"],
+        policy=SoloRestart(RestartBudget(4)), registry=registry,
+        ft_dir=ft_dir, poll_interval=0.01, term_grace_s=0.5)
+    assert coord.run() == 0
+    _, records, _ = replay_journal(journal_path(ft_dir))
+    solo = [r["host"] for r in records if r["kind"] == "solo_launched"]
+    assert solo == [0, 1]  # pre-crash 0, adoption's 1 — never 0 again
+    assert relaunched0.poll() == 0  # the pre-crash relaunch was left alone
+    assert registry.varz()["metrics"]["ft_solo_restarts_total"] == 1
+
+
+def test_adoption_gives_the_reaper_grace_to_land_a_clean_rc(tmp_path):
+    """A rank that finished rc 0 while the coordinator was down, whose
+    rc file the supervise reaper lands a beat AFTER adoption starts
+    (the reaper re-enters waitpid only after spawning the new
+    coordinator): adoption must wait out the race instead of misreading
+    the clean exit as a CRASH and burning a budget slot relaunching a
+    host that was already done."""
+    ft_dir = tmp_path / "ft"
+    (ft_dir / "journal").mkdir(parents=True)
+    done = subprocess.Popen([sys.executable, "-c", "pass"])
+    done.wait()
+    with JournalWriter(journal_path(ft_dir)) as j:
+        j.append("run_start", argv=["x"], hosts=1, policy="solo",
+                 max_restarts=1)
+        j.append("gang_launched", first=True, pids={"0": done.pid})
+    import threading
+
+    def late_rc():
+        time.sleep(0.3)
+        write_rc(ft_dir, done.pid, 0)
+
+    threading.Thread(target=late_rc, daemon=True).start()
+    coord = GangCoordinator(
+        _launcher(tmp_path, n=1), [sys.executable, "-c", "pass"],
+        policy=SoloRestart(RestartBudget(1)),
+        ft_dir=ft_dir, poll_interval=0.01, term_grace_s=0.5)
+    assert coord.run() == 0
+    assert coord.policy.budget.used == 0  # no budget burned
+    assert all(e["kind"] != "detect" for e in _events(ft_dir))
+
+
+def test_writer_terminates_a_newlineless_valid_final_record(tmp_path):
+    """A crash can truncate the journal at EXACTLY the final record's
+    newline: the record is VALID (repair_torn_tail rightly keeps it),
+    but appending straight after it would glue the next record onto
+    the same line — silently losing one of the two on the next replay.
+    The writer terminates the line before its first append."""
+    from tpucfn.ft.journal import repair_torn_tail
+
+    jp = tmp_path / "journal" / "journal.jsonl"
+    with JournalWriter(jp) as j:
+        j.append("run_start", argv=["x"], hosts=1, policy="gang",
+                 max_restarts=1)
+        j.append("gang_launched", first=True, pids={"0": 4242})
+    data = jp.read_bytes()
+    assert data.endswith(b"\n")
+    jp.write_bytes(data[:-1])  # the crash ate exactly the newline
+    assert repair_torn_tail(jp) is False  # the record IS valid: kept
+    with JournalWriter(jp, start_seq=2) as j:
+        j.append("adopted", hosts=[0], dead=[], pending=None)
+    st, recs, torn = replay_journal(jp)
+    assert not torn and st.seq == 3 and len(recs) == 3
+    assert st.adoptions == 1  # nothing glued, nothing lost

@@ -1,0 +1,208 @@
+"""The --supervise re-exec loop (ISSUE 12): bounded coordinator
+restarts, done-journal propagation, subreaper rc-file reaping, and the
+child-argv builder."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+from tpucfn.launch.supervise import run_supervised, supervised_cli_argv
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _events(ft_dir) -> list[dict]:
+    p = Path(ft_dir) / "events.jsonl"
+    if not p.is_file():
+        return []
+    return [json.loads(s) for s in p.read_text().splitlines() if s.strip()]
+
+
+def _child(body: str) -> list[str]:
+    """A supervise child with the repo importable and FT from env."""
+    return [sys.executable, "-c",
+            "import os, sys\n"
+            f"sys.path.insert(0, {str(REPO)!r})\n"
+            "from pathlib import Path\n"
+            "from tpucfn.ft.journal import (JournalWriter, journal_path,\n"
+            "                               replay_journal)\n"
+            "ft = Path(os.environ['FT'])\n"
+            "jp = journal_path(ft)\n"
+            "jp.parent.mkdir(parents=True, exist_ok=True)\n"
+            + body]
+
+
+def test_supervised_cli_argv_strips_supervise_flags():
+    argv = ["--state-dir", "/s", "launch", "--name", "x", "--ft",
+            "--supervise", "--supervise-restarts", "5", "--", "python",
+            "job.py"]
+    out = supervised_cli_argv(argv)
+    assert out[:3] == [sys.executable, "-m", "tpucfn.cli"]
+    rest = out[3:]
+    assert "--supervise" not in rest
+    assert "--supervise-restarts" not in rest and "5" not in rest
+    assert rest == ["--state-dir", "/s", "launch", "--name", "x", "--ft",
+                    "--", "python", "job.py"]
+    # the = form too
+    assert "--supervise-restarts=5" not in supervised_cli_argv(
+        ["launch", "--supervise-restarts=5", "--ft"])[3:]
+
+
+def test_crashed_coordinator_is_relaunched_then_done(tmp_path):
+    """First incarnation journals run_start and SIGKILLs itself; the
+    relaunch writes done rc 0.  The loop restarts exactly once and the
+    restart is audited."""
+    body = (
+        "marker = ft / 'ran_once'\n"
+        "if not marker.exists():\n"
+        "    marker.write_text('x')\n"
+        "    with JournalWriter(jp) as j:\n"
+        "        j.append('run_start', argv=[], hosts=1, policy='gang',\n"
+        "                 max_restarts=1)\n"
+        "    os.kill(os.getpid(), 9)\n"
+        "st = replay_journal(jp)[0]\n"
+        "with JournalWriter(jp, start_seq=st.seq) as j:\n"
+        "    j.append('done', rc=0)\n"
+        "sys.exit(0)\n")
+    env = {**os.environ, "FT": str(tmp_path)}
+    rc = run_supervised(_child(body), ft_dir=tmp_path, max_restarts=3,
+                        backoff_s=0.05, env=env)
+    assert rc == 0
+    restarts = [e for e in _events(tmp_path)
+                if e["kind"] == "coordinator_restarted"]
+    assert len(restarts) == 1 and restarts[0]["rc"] == -signal.SIGKILL
+    assert not any(e["kind"] == "coordinator_give_up"
+                   for e in _events(tmp_path))
+
+
+def test_done_journal_is_never_restarted(tmp_path):
+    """A coordinator that journaled done (give_up rc 7) and exited with
+    that rc must propagate — restarting a finished run would retrain."""
+    body = (
+        "with JournalWriter(jp) as j:\n"
+        "    j.append('run_start', argv=[], hosts=1, policy='gang',\n"
+        "             max_restarts=0)\n"
+        "    j.append('done', rc=7)\n"
+        "sys.exit(7)\n")
+    env = {**os.environ, "FT": str(tmp_path)}
+    rc = run_supervised(_child(body), ft_dir=tmp_path, max_restarts=3,
+                        backoff_s=0.05, env=env)
+    assert rc == 7
+    assert not any(e["kind"] == "coordinator_restarted"
+                   for e in _events(tmp_path))
+
+
+def test_restart_budget_exhausts_to_give_up(tmp_path):
+    body = (
+        "if not jp.exists():\n"
+        "    with JournalWriter(jp) as j:\n"
+        "        j.append('run_start', argv=[], hosts=1, policy='gang',\n"
+        "                 max_restarts=1)\n"
+        "os.kill(os.getpid(), 9)\n")
+    env = {**os.environ, "FT": str(tmp_path)}
+    t0 = time.monotonic()
+    rc = run_supervised(_child(body), ft_dir=tmp_path, max_restarts=2,
+                        backoff_s=0.05, env=env)
+    assert rc == -signal.SIGKILL
+    assert time.monotonic() - t0 < 30
+    events = _events(tmp_path)
+    assert sum(1 for e in events
+               if e["kind"] == "coordinator_restarted") == 2
+    give_up = [e for e in events if e["kind"] == "coordinator_give_up"]
+    assert len(give_up) == 1 and give_up[0]["restarts"] == 2
+
+
+def test_orphaned_grandchild_rc_is_reaped_into_rc_file(tmp_path):
+    """The adoption contract's reaper half: a rank that outlives its
+    coordinator reparents to the supervise loop (subreaper), which
+    lands its REAL exit code in <ft>/rc/ — how a later adoption tells
+    a clean rank exit from a crash."""
+    body = (
+        "import subprocess, time\n"
+        "marker = ft / 'ran_once'\n"
+        "if not marker.exists():\n"
+        "    marker.write_text('x')\n"
+        "    with JournalWriter(jp) as j:\n"
+        "        j.append('run_start', argv=[], hosts=1, policy='gang',\n"
+        "                 max_restarts=1)\n"
+        "    gc = subprocess.Popen([sys.executable, '-c',\n"
+        "                           'import time,sys; time.sleep(0.4);'\n"
+        "                           'sys.exit(5)'])\n"
+        "    (ft / 'gc_pid').write_text(str(gc.pid))\n"
+        "    os.kill(os.getpid(), 9)\n"  # die, orphaning the grandchild
+        "time.sleep(1.0)\n"  # give the reaper time to collect it
+        "st = replay_journal(jp)[0]\n"
+        "with JournalWriter(jp, start_seq=st.seq) as j:\n"
+        "    j.append('done', rc=0)\n"
+        "sys.exit(0)\n")
+    env = {**os.environ, "FT": str(tmp_path)}
+    rc = run_supervised(_child(body), ft_dir=tmp_path, max_restarts=2,
+                        backoff_s=0.05, env=env)
+    assert rc == 0
+    gc_pid = int((tmp_path / "gc_pid").read_text())
+    rc_file = tmp_path / "rc" / f"rc-{gc_pid}.json"
+    assert rc_file.is_file(), "grandchild rc never reaped"
+    assert json.loads(rc_file.read_text())["rc"] == 5
+
+
+def test_corrupt_journal_stops_the_loop(tmp_path):
+    """A corrupt journal makes adoption refuse loudly; the supervise
+    loop must not crash-loop into it — it propagates the child's rc."""
+    body = (
+        "with JournalWriter(jp) as j:\n"
+        "    j.append('run_start', argv=[], hosts=1, policy='gang',\n"
+        "             max_restarts=1)\n"
+        "    j.append('incident_open', incident=1, failures=[])\n"
+        "    j.append('incident_open', incident=2, failures=[])\n"
+        "lines = jp.read_text().splitlines()\n"
+        "lines[1] = lines[1][:-4] + 'zzzz'\n"
+        "jp.write_text('\\n'.join(lines) + '\\n')\n"
+        "os.kill(os.getpid(), 9)\n")
+    env = {**os.environ, "FT": str(tmp_path)}
+    rc = run_supervised(_child(body), ft_dir=tmp_path, max_restarts=5,
+                        backoff_s=0.05, env=env)
+    assert rc == -signal.SIGKILL
+    assert not any(e["kind"] == "coordinator_restarted"
+                   for e in _events(tmp_path))
+
+
+def test_stale_done_journal_never_masks_a_crash_on_arrival(tmp_path):
+    """An ft dir holding a FINISHED run's journal, and a coordinator
+    that crashes before it can rotate it: the loop must rotate the old
+    journal itself and report the crash — not dress the dead-on-arrival
+    coordinator up as a completed run with the previous run's rc."""
+    import sys as _sys
+
+    _sys.path.insert(0, str(REPO))
+    from tpucfn.ft.journal import JournalWriter, journal_path
+
+    ft = tmp_path / "ft"
+    jp = journal_path(ft)
+    jp.parent.mkdir(parents=True)
+    with JournalWriter(jp) as j:
+        j.append("run_start", argv=["x"], hosts=1, policy="gang",
+                 max_restarts=1)
+        j.append("done", rc=0)
+    rc = run_supervised(
+        [sys.executable, "-c", "import sys; sys.exit(7)"],
+        ft_dir=ft, max_restarts=1, backoff_s=0.01)
+    assert rc == 7  # the crash, never the stale journal's rc 0
+    assert (jp.parent / "journal-prev.jsonl").is_file()
+    kinds = [e["kind"] for e in _events(ft)]
+    assert "coordinator_restarted" in kinds  # it DID try a relaunch
+    assert "coordinator_give_up" in kinds
+
+
+def test_supervised_cli_argv_never_strips_the_user_jobs_argv():
+    """Flag stripping must stop at the first bare '--': everything
+    after it is the USER JOB's command line, and a job that itself
+    takes a --supervise-restarts flag must receive it untouched."""
+    out = supervised_cli_argv(
+        ["launch", "--ft", "--supervise", "--", "python", "myjob.py",
+         "--supervise", "--supervise-restarts", "5"])
+    assert out[3:] == ["launch", "--ft", "--", "python", "myjob.py",
+                       "--supervise", "--supervise-restarts", "5"]

@@ -1,0 +1,214 @@
+"""End-to-end crash-safety drill (ISSUE 12 acceptance): under the real
+launch fan-out, the coordinator is killed while an incident is
+mid-flight; the supervised restart adopts the running fleet (zero
+spurious restart of healthy hosts), completes the pending incident
+exactly once, the restart budget continues from its pre-crash value
+(journal-verified, not reset), and the full training trajectory is
+bit-identical to an uninterrupted reference run.
+
+Plus the kill-the-watchman op drill: chaos ``kill_coordinator`` with NO
+incident in flight — adoption must leave the fleet completely
+untouched (every rank keeps its pid, zero restarts, zero budget).
+
+Multi-second by construction (real subprocess fleets + supervise
+restarts), so the module is ``slow``-marked and excluded from tier-1.
+"""
+
+import ctypes
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tpucfn.ft import replay_journal
+from tpucfn.ft.journal import journal_path
+from tpucfn.launch.supervise import run_supervised
+
+pytestmark = pytest.mark.slow
+
+REPO = Path(__file__).resolve().parent.parent
+COORD = str(REPO / "tests" / "crashsafe_e2e_coordinator.py")
+
+TOTAL_STEPS = 40
+CKPT_EVERY = 10
+KILL_AT_STEP = 18
+
+
+def _env(run_dir, *, chaos="", crash_at=None) -> dict:
+    env = {**os.environ,
+           "CRASHSAFE_RUN_DIR": str(run_dir),
+           "CRASHSAFE_HOSTS": "2",
+           "CRASHSAFE_TOTAL_STEPS": str(TOTAL_STEPS),
+           "CRASHSAFE_CKPT_EVERY": str(CKPT_EVERY),
+           "CRASHSAFE_STEP_SLEEP": "0.05",
+           "CRASHSAFE_KILL_STEP": str(KILL_AT_STEP),
+           "CRASHSAFE_KILL_AT_S": "0.8",
+           "CRASHSAFE_CHAOS": chaos}
+    env.pop("TPUCFN_CRASH_AT", None)
+    if crash_at:
+        env["TPUCFN_CRASH_AT"] = crash_at
+    return env
+
+
+def _events(run_dir) -> list[dict]:
+    p = run_dir / "ft" / "events.jsonl"
+    return [json.loads(s) for s in p.read_text().splitlines() if s.strip()]
+
+
+def _losses(run_dir, host) -> list[dict]:
+    p = run_dir / f"losses-host{host:03d}.jsonl"
+    return [json.loads(s) for s in p.read_text().splitlines() if s.strip()]
+
+
+def _reference(tmp_path) -> dict:
+    """Uninterrupted run → {host: {step: w}} (no supervisor needed)."""
+    run_dir = tmp_path / "reference"
+    run_dir.mkdir()
+    r = subprocess.run([sys.executable, COORD], env=_env(run_dir),
+                       timeout=120)
+    assert r.returncode == 0
+    ref = {}
+    for host in (0, 1):
+        rows = _losses(run_dir, host)
+        assert rows[-1]["step"] == TOTAL_STEPS
+        assert len({row["pid"] for row in rows}) == 1  # no restarts
+        ref[host] = {row["step"]: row["w"] for row in rows}
+    return ref
+
+
+def _unset_subreaper():
+    try:
+        ctypes.CDLL(None, use_errno=True).prctl(36, 0, 0, 0, 0)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def test_kill_coordinator_mid_incident_adopts_and_finishes(tmp_path):
+    """The headline drill: chaos SIGKILLs host 0 at fleet step 18; the
+    SoloRestart decision's intent is journaled and the coordinator is
+    crash-pointed to death right there (between intent and act).  The
+    supervised relaunch must adopt host 1 untouched, execute the solo
+    restart of host 0 exactly once on the continued budget, and end
+    with a trajectory bit-identical to the uninterrupted reference."""
+    ref = _reference(tmp_path)
+    run_dir = tmp_path / "drill"
+    run_dir.mkdir()
+    try:
+        rc = run_supervised(
+            [sys.executable, COORD], ft_dir=run_dir / "ft",
+            max_restarts=2, backoff_s=0.2,
+            env=_env(run_dir, chaos="kill_step", crash_at="after_intent"))
+    finally:
+        _unset_subreaper()
+    assert rc == 0
+
+    events = _events(run_dir)
+    kinds = [e["kind"] for e in events]
+    # the coordinator died once and was relaunched once
+    assert kinds.count("coordinator_restarted") == 1
+    adopted = [e for e in events if e["kind"] == "coordinator_adopted"]
+    assert len(adopted) == 1
+    assert 1 in adopted[0]["hosts"]  # the healthy host was ATTACHED
+    assert adopted[0]["pending_incident"] == 1
+    assert adopted[0]["budget_used"] == 1  # continued, not reset
+
+    # the pending incident completed exactly once
+    assert kinds.count("detect") == 1
+    recovered = [e for e in events if e["kind"] == "recovered"]
+    assert len(recovered) == 1
+    assert recovered[0]["incident"] == 1
+    assert recovered[0]["action"] == "solo_restart"
+    assert recovered[0]["adopted"] is True
+    assert kinds[-1] == "done" and events[-1]["rc"] == 0
+
+    # journal-verified: one intent, one commit, one solo launch, one
+    # gang launch (the original) — nothing doubled, nothing dropped
+    st, records, _ = replay_journal(journal_path(run_dir / "ft"))
+    assert st.done_rc == 0 and st.budget_used == 1 and st.adoptions == 1
+    per_kind = {}
+    for r in records:
+        per_kind[r["kind"]] = per_kind.get(r["kind"], 0) + 1
+    assert per_kind["restart_intent"] == 1
+    assert per_kind["restart_commit"] == 1
+    assert per_kind["solo_launched"] == 1
+    assert per_kind["gang_launched"] == 1
+    solo = next(r for r in records if r["kind"] == "solo_launched")
+    assert solo["host"] == 0
+
+    # budget continuity in the operator surface too
+    snap = json.loads((run_dir / "ft" / "supervisor.json").read_text())
+    assert snap["budget"]["used"] == 1
+    assert snap["adopted"] is True
+
+    # zero spurious restart of the healthy host: ONE pid end to end
+    h1 = _losses(run_dir, 1)
+    assert len({row["pid"] for row in h1}) == 1
+    assert h1[-1]["step"] == TOTAL_STEPS
+
+    # host 0 was restarted exactly once and resumed from a checkpoint
+    h0 = _losses(run_dir, 0)
+    pids = list(dict.fromkeys(row["pid"] for row in h0))
+    assert len(pids) == 2
+    resumed = [row for row in h0 if row["pid"] == pids[1]]
+    assert resumed[0]["step"] > 1  # resumed, not retrained
+    assert (resumed[0]["step"] - 1) % CKPT_EVERY == 0
+    assert resumed[-1]["step"] == TOTAL_STEPS
+
+    # the FULL trajectory is bit-identical to the uninterrupted run
+    for host in (0, 1):
+        for row in _losses(run_dir, host):
+            assert row["w"] == ref[host][row["step"]], (host, row["step"])
+
+
+def test_kill_coordinator_op_leaves_fleet_untouched(tmp_path):
+    """kill-the-watchman with NO incident in flight: the chaos op
+    SIGKILLs the coordinator at t=0.8s; the supervised relaunch adopts
+    BOTH ranks (same pids — the journaled chaos firing must not
+    re-fire), the run finishes with zero restarts and zero budget, and
+    the trajectory matches the reference."""
+    ref = _reference(tmp_path)
+    run_dir = tmp_path / "watchman"
+    run_dir.mkdir()
+    try:
+        rc = run_supervised(
+            [sys.executable, COORD], ft_dir=run_dir / "ft",
+            max_restarts=2, backoff_s=0.2,
+            env=_env(run_dir, chaos="kill_coordinator"))
+    finally:
+        _unset_subreaper()
+    assert rc == 0
+
+    events = _events(run_dir)
+    kinds = [e["kind"] for e in events]
+    assert "coordinator_killed" in kinds
+    assert kinds.count("coordinator_restarted") == 1
+    adopted = [e for e in events if e["kind"] == "coordinator_adopted"]
+    assert len(adopted) == 1
+    assert adopted[0]["hosts"] == [0, 1]  # the WHOLE fleet, attached
+    assert adopted[0]["dead"] == []
+    assert adopted[0]["budget_used"] == 0
+    # never a second kill, never an incident, never a restart
+    assert kinds.count("coordinator_killed") == 1
+    assert "detect" not in kinds and "recovered" not in kinds
+    assert kinds[-1] == "done" and events[-1]["rc"] == 0
+
+    st, records, _ = replay_journal(journal_path(run_dir / "ft"))
+    assert st.done_rc == 0 and st.budget_used == 0
+    assert sum(1 for r in records if r["kind"] == "chaos_fired") == 1
+    assert sum(1 for r in records if r["kind"] == "gang_launched") == 1
+    assert not any(r["kind"] in ("solo_launched", "restart_intent")
+                   for r in records)
+    launched = next(r for r in records if r["kind"] == "gang_launched")
+
+    # every rank kept its ORIGINAL pid through the coordinator's death:
+    # the losses stream shows one pid per host, the one launched first
+    for host in (0, 1):
+        rows = _losses(run_dir, host)
+        pids = {row["pid"] for row in rows}
+        assert pids == {launched["pids"][str(host)]}
+        assert rows[-1]["step"] == TOTAL_STEPS
+        for row in rows:
+            assert row["w"] == ref[host][row["step"]], (host, row["step"])

@@ -409,3 +409,49 @@ print("SURVIVED", flush=True)
     header, samples, _ = read_flight_file(
         flight_path(tmp_path / "flight", 7))
     assert header["host"] == 7 and len(samples) == 1
+
+
+# -- HBM watermark (ISSUE 12 satellite) -------------------------------------
+
+def test_hbm_watermark_levels():
+    from tpucfn.obs.flight import hbm_watermark
+
+    def hbm(t, used, limit=100):
+        return {"kind": "hbm", "t": t, "used": used, "peak": used,
+                "limit": limit}
+
+    # no samples / no hbm samples → no_data
+    assert hbm_watermark([])["level"] == "no_data"
+    assert hbm_watermark([{"kind": "step", "t": 0}])["level"] == "no_data"
+    # below threshold → ok with the live ratio
+    wm = hbm_watermark([hbm(0, 50), hbm(1, 60)])
+    assert wm["level"] == "ok" and wm["ratio"] == 0.6
+    assert wm["peak_ratio"] == 0.6 and wm["sustained_s"] == 0.0
+    # over threshold but not sustained → ok (a spike is not an alert)
+    wm = hbm_watermark([hbm(0, 50), hbm(10, 95)], sustain_s=30)
+    assert wm["level"] == "ok" and wm["sustained_s"] == 0.0
+    # sustained over threshold → alert, sustained span measured
+    samples = [hbm(float(t), 95) for t in range(0, 40, 2)]
+    wm = hbm_watermark(samples, sustain_s=30)
+    assert wm["level"] == "alert" and wm["sustained_s"] >= 30.0
+    # a dip below threshold RESETS the sustain window
+    samples = [hbm(0, 95), hbm(20, 80), hbm(21, 95), hbm(40, 95)]
+    wm = hbm_watermark(samples, sustain_s=30)
+    assert wm["level"] == "ok" and wm["sustained_s"] == 19.0
+    # `now` extends the tail (the last sample is still the live level)
+    wm = hbm_watermark([hbm(0, 95)], sustain_s=30, now=45.0)
+    assert wm["level"] == "alert" and wm["sustained_s"] == 45.0
+    # limit<=0 or malformed samples are skipped, not crashed on
+    wm = hbm_watermark([hbm(0, 95, limit=0), {"kind": "hbm", "t": 1},
+                        hbm(2, 10)])
+    assert wm["ratio"] == 0.1
+
+
+def test_hbm_watermark_threshold_is_configurable():
+    from tpucfn.obs.flight import hbm_watermark
+
+    samples = [{"kind": "hbm", "t": float(t), "used": 80, "peak": 80,
+                "limit": 100} for t in range(0, 40, 5)]
+    assert hbm_watermark(samples, threshold=0.9)["level"] == "ok"
+    wm = hbm_watermark(samples, threshold=0.75, sustain_s=30)
+    assert wm["level"] == "alert"

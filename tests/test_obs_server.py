@@ -122,3 +122,50 @@ def test_start_obs_server_env_gating(monkeypatch):
         assert status == 200
     finally:
         srv.close()
+
+
+def test_healthz_carries_hbm_watermark_from_flight_ring():
+    """ISSUE 12 satellite: a role with a flight ring gets an OOM
+    prediction in /healthz detail — sustained used/limit over the
+    threshold alerts; detail only, the HTTP status never flips."""
+    from tpucfn.obs import FlightRecorder
+
+    flight = FlightRecorder(capacity=64, host_id=0, role="test",
+                            clock=lambda: 0.0)
+
+    class Clock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clock = Clock()
+    flight.clock = clock
+    for i in range(40):
+        clock.t = float(i)
+        flight.record("hbm", used=95, peak=96, limit=100)
+    srv = ObsServer(MetricRegistry(), port=0, host="127.0.0.1",
+                    role="test", flight=flight)
+    try:
+        status, _, body = _get(srv.url("/healthz"))
+        assert status == 200  # an alert is a prediction, not a 503
+        wm = json.loads(body)["hbm_watermark"]
+        assert wm["level"] == "alert"
+        assert wm["ratio"] == 0.95
+        assert wm["sustained_s"] >= 30.0
+    finally:
+        srv.close()
+
+
+def test_healthz_watermark_absent_without_hbm_samples():
+    from tpucfn.obs import FlightRecorder
+
+    flight = FlightRecorder(capacity=8, host_id=0)
+    flight.record("step", step=1, dur_s=0.1)  # no hbm samples on CPU
+    srv = ObsServer(MetricRegistry(), port=0, host="127.0.0.1",
+                    flight=flight)
+    try:
+        _, _, body = _get(srv.url("/healthz"))
+        assert "hbm_watermark" not in json.loads(body)
+    finally:
+        srv.close()

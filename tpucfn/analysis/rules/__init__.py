@@ -18,6 +18,7 @@ from tpucfn.analysis.rules import (
     locks,
     metrics_hygiene,
     signal_safety,
+    totality,
     vocab,
 )
 
@@ -68,6 +69,13 @@ ALL_RULES: dict[str, Rule] = {r.id: r for r in (
          "PR 4 resume crasher: donated restore buffers freed through "
          "the wrong allocator",
          jax_hazards.check),
+    Rule("decision-totality",
+         "every FailureKind-style enum member has a decision-table row, "
+         "and every decided action has an actor somewhere in the package",
+         "ISSUE 12 adds coordinator-side failure handling — exactly the "
+         "change that could ship a new FailureKind half-wired through "
+         "ft/policy.py's table",
+         totality.check),
     Rule("vocab-drift",
          "event kinds / ledger kinds / request statuses stay on their "
          "canonical tuples",

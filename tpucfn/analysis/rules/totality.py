@@ -1,0 +1,162 @@
+"""decision-totality: every failure class has a decision, and every
+decided action has an actor.
+
+The ft plane's control flow is one dict: ``ft/policy.py`` maps
+:class:`FailureKind` → :class:`Action`, and the coordinator branches on
+the decided action.  Both halves can silently rot (ROADMAP correctness
+follow-on, landed with ISSUE 12 — which itself adds coordinator-side
+failure handling and is exactly the kind of change that could ship a
+FailureKind half-wired):
+
+* a **new enum member without a table row** falls through
+  ``table.get(kind, Action.NONE)`` — the failure class exists, is
+  detected, and is silently never acted on;
+* a **table row whose action nothing references** is decided and then
+  dropped on the floor — the decision layer promises an act the acting
+  layer never learned.
+
+The rule is generic over the package: any module-level enum class (a
+``ClassDef`` deriving from ``Enum``/``enum.Enum``) used as the key set
+of a module-level ``*TABLE*``-named dict literal is checked for
+totality (every member has a row, every key is a member), and every
+action member appearing as a row value must be referenced somewhere in
+the package *outside* table literals (a branch, a comparison, a
+constructor — anything that acts on it).  Partial enum-keyed dicts
+under other names stay out of scope: partial maps are often
+intentional; a *decision table* claims totality by its name.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpucfn.analysis.core import Analysis, Finding
+
+RULE_ID = "decision-totality"
+
+
+def _enum_classes(analysis: Analysis) -> dict[str, set[str]]:
+    """Enum class name → member names, package-wide.  Same-name enums
+    in different modules merge their members (conservative: a member
+    valid in either definition is accepted)."""
+    out: dict[str, set[str]] = {}
+    for mod in analysis.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            is_enum = any(
+                (isinstance(b, ast.Name) and b.id == "Enum")
+                or (isinstance(b, ast.Attribute) and b.attr == "Enum")
+                for b in node.bases)
+            if not is_enum:
+                continue
+            members = {t.id
+                       for stmt in node.body
+                       if isinstance(stmt, ast.Assign)
+                       for t in stmt.targets
+                       if isinstance(t, ast.Name) and not t.id.startswith("_")}
+            if members:
+                out.setdefault(node.name, set()).update(members)
+    return out
+
+
+def _tables(analysis: Analysis, enums: dict[str, set[str]]):
+    """``(module, table_name, dict_node)`` for every module-level
+    ``*TABLE*``-named dict literal keyed by enum attributes."""
+    for mod in analysis.modules:
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.AnnAssign):
+                targets, value = [stmt.target], stmt.value
+            elif isinstance(stmt, ast.Assign):
+                targets, value = stmt.targets, stmt.value
+            else:
+                continue
+            if not isinstance(value, ast.Dict) or not value.keys:
+                continue
+            name = next((t.id for t in targets
+                         if isinstance(t, ast.Name)), None)
+            if name is None or "TABLE" not in name.upper():
+                continue
+            if all(isinstance(k, ast.Attribute)
+                   and isinstance(k.value, ast.Name)
+                   and k.value.id in enums
+                   for k in value.keys):
+                yield mod, name, value
+
+
+def _attr_refs(analysis: Analysis, enum_name: str, member: str,
+               exclude: set[int]) -> int:
+    """How many times ``EnumName.member`` is referenced package-wide,
+    excluding the attribute nodes listed in ``exclude`` (the table
+    literals themselves — a value that appears only there has no
+    actor)."""
+    n = 0
+    for mod in analysis.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Attribute) and node.attr == member \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == enum_name \
+                    and id(node) not in exclude:
+                n += 1
+    return n
+
+
+def check(analysis: Analysis):
+    findings: list[Finding] = []
+    enums = _enum_classes(analysis)
+    if not enums:
+        return findings
+    tables = list(_tables(analysis, enums))
+    in_tables: set[int] = set()
+    for _mod, _name, d in tables:
+        for node in d.keys + d.values:
+            for sub in ast.walk(node):
+                in_tables.add(id(sub))
+    for mod, name, d in tables:
+        key_enums = {k.value.id for k in d.keys}
+        if len(key_enums) != 1:
+            continue  # mixed-enum keys: not a decision table we can judge
+        key_enum = key_enums.pop()
+        rows = {k.attr for k in d.keys}
+        for member in sorted(enums[key_enum] - rows):
+            findings.append(Finding(
+                RULE_ID, mod.rel, d.lineno,
+                f"decision table {name} has no row for "
+                f"{key_enum}.{member} — the failure class exists but "
+                "falls through to the default action without anyone "
+                "deciding that; add an explicit row",
+                key=f"missing:{name}:{key_enum}.{member}"))
+        for k in d.keys:
+            if k.attr not in enums[key_enum]:
+                findings.append(Finding(
+                    RULE_ID, mod.rel, k.lineno,
+                    f"decision table {name} keys a member "
+                    f"{key_enum}.{k.attr} that {key_enum} does not "
+                    "define — the row can never match",
+                    key=f"unknown-key:{name}:{key_enum}.{k.attr}"))
+        seen_values: set[tuple[str, str]] = set()
+        for v in d.values:
+            if not (isinstance(v, ast.Attribute)
+                    and isinstance(v.value, ast.Name)
+                    and v.value.id in enums):
+                continue
+            venum, vmember = v.value.id, v.attr
+            if vmember not in enums[venum]:
+                findings.append(Finding(
+                    RULE_ID, mod.rel, v.lineno,
+                    f"decision table {name} maps to {venum}.{vmember}, "
+                    f"which {venum} does not define",
+                    key=f"unknown-value:{name}:{venum}.{vmember}"))
+                continue
+            if (venum, vmember) in seen_values:
+                continue
+            seen_values.add((venum, vmember))
+            if _attr_refs(analysis, venum, vmember, in_tables) == 0:
+                findings.append(Finding(
+                    RULE_ID, mod.rel, v.lineno,
+                    f"decision table {name} decides {venum}.{vmember} "
+                    "but nothing in the package references it outside "
+                    "table literals — the decision has no actor and is "
+                    "silently dropped",
+                    key=f"unreachable:{name}:{venum}.{vmember}"))
+    return findings

@@ -132,6 +132,28 @@ def cmd_launch(args) -> int:
     contract = converge(rec, _run_dir(args, args.name))
     transport = SSHTransport() if args.transport == "ssh" else LocalTransport()
     ft_dir = _run_dir(args, args.name) / "ft" if args.ft else None
+    if args.supervise:
+        # Self-supervision (ISSUE 12): re-exec this same invocation
+        # (minus the supervise flags) under the jax-free supervise
+        # loop.  A crashed coordinator is relaunched and ADOPTS the
+        # running fleet through the write-ahead journal; a finished
+        # run's rc propagates.
+        if not args.ft:
+            print("error: --supervise needs --ft (the write-ahead journal "
+                  "and fleet adoption live under the ft dir)",
+                  file=sys.stderr)
+            return 2
+        from tpucfn.launch.supervise import (run_supervised,
+                                             supervised_cli_argv)
+
+        child = supervised_cli_argv(sys.argv[1:])
+        print(f"supervising coordinator (up to {args.supervise_restarts} "
+              f"restart(s); journal under {ft_dir}/journal)",
+              file=sys.stderr)
+        rc = run_supervised(child, ft_dir=ft_dir,
+                            max_restarts=args.supervise_restarts)
+        print(f"launch finished rc={rc}")
+        return rc
     if args.input_hosts and args.input_hosts >= contract.workers_count:
         print(f"error: --input-hosts {args.input_hosts} leaves no trainer "
               f"in a {contract.workers_count}-host cluster", file=sys.stderr)
@@ -206,6 +228,20 @@ def cmd_launch(args) -> int:
             config=MonitorConfig(
                 interval_s=args.ft_heartbeat_interval,
                 startup_grace_s=args.ft_startup_grace))
+    # /healthz late-binds to the coordinator once it exists so the
+    # probe carries journal/adoption state (ISSUE 12) on top of the
+    # monitor's fleet view; before that (and without --ft) it falls
+    # back to the monitor or plain liveness.
+    coord_ref: dict = {}
+
+    def _health_fn():
+        c = coord_ref.get("coord")
+        if c is not None:
+            return c.health()
+        if monitor is not None:
+            return monitor.health()
+        return True, {}
+
     if args.obs_port:
         # The supervisor is a fleet role too: it owns the base port, the
         # per-host ranks get base+1+host_id (launcher.host_env).  With
@@ -216,7 +252,7 @@ def cmd_launch(args) -> int:
         registry = MetricRegistry(labels={"role": "supervisor"})
         obs_srv = start_obs_server(
             registry, port=args.obs_port, role="supervisor",
-            health_fn=monitor.health if monitor is not None else None)
+            health_fn=_health_fn if args.ft else None)
         print(f"supervisor obs endpoint: {obs_srv.url()} "
               f"(hosts at ports {args.obs_port + 1}..."
               f"{args.obs_port + n_launched})", file=sys.stderr)
@@ -266,7 +302,10 @@ def cmd_launch(args) -> int:
                 straggler_guard=StragglerGuard(
                     hysteresis_s=args.ft_straggler_hysteresis,
                     flap_budget=args.ft_straggler_flap_budget),
-                restart_input_hosts=args.ft_restart_input_hosts)
+                restart_input_hosts=args.ft_restart_input_hosts,
+                adopt=(True if args.adopt
+                       else False if args.no_adopt else "auto"))
+            coord_ref["coord"] = coordinator
             rc = coordinator.run()
         else:
             rc = run_with_restarts(launcher, argv, max_restarts=args.restarts,
@@ -1405,6 +1444,26 @@ def build_parser() -> argparse.ArgumentParser:
                    help="solo-relaunch a dead input host (bounded, budget "
                         "untouched); default: trainers just degrade to "
                         "local loading")
+    adopt_group = l.add_mutually_exclusive_group()
+    adopt_group.add_argument(
+        "--adopt", action="store_true",
+        help="crash-safety: replay the write-ahead journal and "
+             "adopt the running fleet instead of launching a "
+             "new one (the default whenever an unfinished "
+             "journal exists under the ft dir)")
+    adopt_group.add_argument(
+        "--no-adopt", action="store_true",
+        help="always launch fresh, even over an unfinished "
+             "journal (the previous run's journal is rotated "
+             "aside, its fleet is NOT stopped)")
+    l.add_argument("--supervise", action="store_true",
+                   help="wrap the coordinator in a jax-free re-exec loop: "
+                        "a crashed coordinator is relaunched and adopts "
+                        "the running fleet via the journal; orphaned rank "
+                        "exit codes are reaped into <ft>/rc/ (needs --ft)")
+    l.add_argument("--supervise-restarts", type=int, default=3, metavar="N",
+                   help="coordinator relaunches allowed before the "
+                        "supervise loop gives up and propagates the rc")
     l.add_argument("cmd", nargs=argparse.REMAINDER)
     l.set_defaults(fn=cmd_launch)
 

@@ -18,6 +18,16 @@ from tpucfn.ft.chaos import (  # noqa: F401
     corrupt_latest_checkpoint,
 )
 from tpucfn.ft.coordinator import GangCoordinator  # noqa: F401
+from tpucfn.ft.journal import (  # noqa: F401
+    JOURNAL_KINDS,
+    AdoptedProcess,
+    CoordinatorState,
+    JournalError,
+    JournalWriter,
+    crash_point,
+    journal_path,
+    replay_journal,
+)
 from tpucfn.ft.heartbeat import (  # noqa: F401
     FleetView,
     HeartbeatMonitor,

@@ -32,7 +32,16 @@ ACTIONS = ("kill", "hang", "delay_heartbeats", "corrupt_ckpt",
            "preempt_notice", "lose_host",
            # serve-tier ops (ISSUE 9): fired against a ReplicaRouter —
            # `host` addresses the replica index on serve targets
-           "kill_replica", "freeze_replica", "slow_replica")
+           "kill_replica", "freeze_replica", "slow_replica",
+           # crash-safety op (ISSUE 12): SIGKILL the supervisor itself —
+           # the fleet must survive its watchman dying (`host` unused)
+           "kill_coordinator")
+
+# Actions that do not target a fleet member: an unpinned `host` must
+# NOT draw a victim from the seeded RNG for them, or the spec's other
+# events would resolve different victims depending on whether one of
+# these precedes them.
+_HOSTLESS_ACTIONS = ("corrupt_ckpt", "kill_coordinator")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -153,6 +162,15 @@ class ChaosTarget:
         class, the hedge path's reason to exist."""
         raise NotImplementedError
 
+    # -- crash-safety op (ISSUE 12) -----------------------------------------
+
+    def kill_coordinator(self) -> None:
+        """SIGKILL the supervisor process itself, mid-supervision —
+        the chaos op behind the kill-the-watchman drills: the fleet
+        must keep training, and a ``--supervise`` relaunch must adopt
+        it rather than restart it."""
+        raise NotImplementedError
+
 
 class ControlPlaneChaosTarget(ChaosTarget):
     """Replays kill events against the provisioning fake — the chaos
@@ -194,13 +212,31 @@ class ChaosEngine:
     """
 
     def __init__(self, spec: ChaosSpec, target: ChaosTarget, *,
-                 rng: random.Random | None = None):
+                 rng: random.Random | None = None,
+                 on_fire=None):
         self.spec = spec
         self.target = target
         self.rng = rng if rng is not None else random.Random(spec.seed)
         self._pending = list(spec.events)
+        # spec index by identity (events may compare equal): the stable
+        # name a durable journal can record a firing under, so a
+        # restarted supervisor replays the spec minus what already fired
+        # (ISSUE 12 — without this, an adopted run re-fires every event,
+        # and a kill_coordinator spec would kill every incarnation).
+        self._index = {id(e): i for i, e in enumerate(spec.events)}
+        # on_fire(index, event, host) runs BEFORE the action is applied
+        # — the write-ahead hook (a kill_coordinator must be journaled
+        # before it kills the journaler).
+        self.on_fire = on_fire
         self._resumes: list[tuple[float, int]] = []  # (due_elapsed_s, host)
         self.fired: list[FiredEvent] = []
+
+    def skip_fired(self, indices) -> None:
+        """Drop the pending events at these spec indices — they fired
+        in a previous coordinator incarnation (journal-replayed)."""
+        drop = set(indices)
+        self._pending = [e for e in self._pending
+                         if self._index[id(e)] not in drop]
 
     def done(self) -> bool:
         return not self._pending and not self._resumes
@@ -220,9 +256,11 @@ class ChaosEngine:
                 still.append(ev)
                 continue
             host = ev.host
-            if host is None and ev.action != "corrupt_ckpt":
+            if host is None and ev.action not in _HOSTLESS_ACTIONS:
                 host = self.rng.randrange(self.target.num_hosts())
             rec = FiredEvent(ev, host, elapsed_s, fleet_step)
+            if self.on_fire is not None:
+                self.on_fire(self._index[id(ev)], ev, host)
             if ev.action == "kill":
                 self.target.kill_host(host)
             elif ev.action == "hang":
@@ -241,6 +279,8 @@ class ChaosEngine:
                 self.target.freeze_replica(host, ev.duration_s)
             elif ev.action == "slow_replica":
                 self.target.slow_replica(host, ev.delay_s, ev.duration_s)
+            elif ev.action == "kill_coordinator":
+                self.target.kill_coordinator()
             elif ev.action == "corrupt_ckpt":
                 self.target.corrupt_latest_checkpoint(self.rng, step=ev.step)
             self.fired.append(rec)

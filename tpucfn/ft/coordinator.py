@@ -48,6 +48,18 @@ Graceful degradation (ISSUE 7) — four paths beyond restart-at-same-size:
   per-host flap budget, re-armed on return to LIVE) before the
   STRAGGLER→SOLO_RESTART row — on by default since ISSUE 7 — may evict.
 
+Crash-safety (ISSUE 12): every state transition is appended to a
+checksummed, fsync'd write-ahead journal under ``<ft_dir>/journal/``
+*before* its action runs (:mod:`tpucfn.ft.journal`), restart decisions
+carry an intent/commit pair, and a restarted coordinator (``adopt`` —
+the default whenever an unfinished journal exists) replays the
+journal, re-attaches to the running fleet (journal pids + heartbeat
+liveness), finishes any mid-flight incident exactly once, and
+continues the *same* restart budget.  ``tpucfn launch --supervise``
+wraps the whole loop in a jax-free re-exec supervisor
+(:mod:`tpucfn.launch.supervise`); the ``kill_coordinator`` chaos op is
+the drill that proves the watchman itself is expendable.
+
 The coordinator is also a :class:`~tpucfn.ft.chaos.ChaosTarget`: a
 :class:`~tpucfn.ft.chaos.ChaosSpec` passed in is replayed against the
 real subprocess table (SIGKILL / SIGSTOP / heartbeat delay / preemption
@@ -67,7 +79,24 @@ from typing import Callable, Sequence
 from tpucfn.bootstrap import shrink_contract
 from tpucfn.ft.chaos import ChaosEngine, ChaosSpec, ChaosTarget, \
     corrupt_latest_checkpoint
-from tpucfn.ft.heartbeat import HeartbeatMonitor, HostState
+from tpucfn.ft.heartbeat import (
+    HeartbeatMonitor,
+    HostState,
+    read_heartbeats,
+)
+from tpucfn.ft.journal import (
+    AdoptedProcess,
+    JournalWriter,
+    PendingIntent,
+    clear_rc_dir,
+    crash_point,
+    journal_path,
+    pid_alive,
+    read_rc,
+    repair_torn_tail,
+    replay_journal,
+    rotate_journal,
+)
 from tpucfn.ft.policy import (
     CKPT_BLACKLIST_ENV,
     RESTORE_FAILED_RC,
@@ -87,6 +116,12 @@ from tpucfn.ft.preempt import (
     consume_notice,
     request_drain,
 )
+
+
+# How long an adopting coordinator waits for the supervise reaper to
+# land a dead rank's rc file before treating the death as unexplained
+# (matches AdoptedProcess.poll's default rc_grace_s).
+ADOPT_RC_GRACE_S = 2.0
 
 
 class GangCoordinator(ChaosTarget):
@@ -117,6 +152,7 @@ class GangCoordinator(ChaosTarget):
         straggler_guard: StragglerGuard | None = None,
         restart_input_hosts: bool = False,
         max_input_restarts: int = 1,
+        adopt: bool | str = "auto",
     ):
         """Graceful-degradation knobs (ISSUE 7): ``drain_grace_s`` caps
         how long a preemption drain waits for clean exits when the
@@ -164,6 +200,16 @@ class GangCoordinator(ChaosTarget):
         self.restart_input_hosts = restart_input_hosts
         self.max_input_restarts = max_input_restarts
         self._input_restarts: dict[int, int] = {}
+        # Crash-safety (ISSUE 12): a write-ahead journal under
+        # <ft_dir>/journal/ records every state transition BEFORE the
+        # action runs; a restarted coordinator replays it and ADOPTS
+        # the running fleet instead of spawning a second one.  `adopt`
+        # is "auto" (adopt iff an unfinished journal exists), True
+        # (require it when a journal exists), or False (always fresh).
+        self.adopt = adopt
+        self._journal: JournalWriter | None = None
+        self._adopted = False
+        self._adopt_failures: list[Failure] = []
 
         if registry is None:
             # Throwaway registry: identical flow, nothing exported —
@@ -232,6 +278,16 @@ class GangCoordinator(ChaosTarget):
         self.ft_input_restarts_c = r.counter(
             "ft_input_restarts_total",
             "input hosts solo-relaunched (budget untouched)")
+        # Crash-safety surface (ISSUE 12)
+        self.coord_adoptions_c = r.counter(
+            "coordinator_adoptions_total",
+            "restarted coordinators that adopted a running fleet")
+        self.coord_journal_c = r.counter(
+            "coordinator_journal_records_total",
+            "write-ahead journal records appended")
+        self.coord_pending_g = r.gauge(
+            "coordinator_pending_intent",
+            "1 while a journaled restart intent awaits its commit")
 
         hosts = self.launcher.contract.hosts()[
             : self.launcher.contract.workers_count]
@@ -257,6 +313,12 @@ class GangCoordinator(ChaosTarget):
         if isinstance(chaos, ChaosSpec):
             chaos = ChaosEngine(chaos, self)
         self.chaos = chaos
+        if self.chaos is not None and self.chaos.on_fire is None:
+            # Write-ahead: every firing is journaled BEFORE the action
+            # runs (a kill_coordinator must be journaled before it kills
+            # the journaler), so an adopting restart replays the spec
+            # minus what already fired.
+            self.chaos.on_fire = self._on_chaos_fire
         if (self.chaos is not None and self.monitor is None
                 and any(e.at_step is not None and e.at_s is None
                         for e in self.chaos.spec.events)):
@@ -316,6 +378,17 @@ class GangCoordinator(ChaosTarget):
         victim = corrupt_latest_checkpoint(self.ckpt_dir, rng, step=step)
         self._event("chaos_ckpt_corrupted",
                     path=None if victim is None else str(victim))
+
+    def kill_coordinator(self) -> None:
+        """Chaos op (ISSUE 12): SIGKILL ourselves mid-supervision.  The
+        event row is best-effort bookkeeping; the journal's chaos_fired
+        record (written by _on_chaos_fire BEFORE dispatch) is what keeps
+        a supervised relaunch from re-firing the same kill forever."""
+        self._event("coordinator_killed", pid=os.getpid())
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    def _on_chaos_fire(self, index: int, ev, host) -> None:
+        self._j("chaos_fired", index=index, action=ev.action, host=host)
 
     # -- flight capture (ISSUE 6) -----------------------------------------
 
@@ -393,15 +466,24 @@ class GangCoordinator(ChaosTarget):
     # -- event / snapshot plumbing ---------------------------------------
 
     def _event(self, kind: str, **fields) -> None:
-        from tpucfn.ft.events import validate_event_kind
+        from tpucfn.ft.events import append_event
 
         if self.ft_dir is None:
             return
-        rec = {"ts": time.time(), "kind": validate_event_kind(kind),
-               **fields}
-        with open(self.ft_dir / "events.jsonl", "a") as f:
-            f.write(json.dumps(rec) + "\n")
+        # append_event flushes AND fsyncs (ISSUE 12 satellite): the
+        # detect/decide record of the very incident that kills the
+        # coordinator must survive the coordinator.
+        append_event(self.ft_dir, kind, **fields)
         self._write_snapshot()
+
+    def _j(self, kind: str, **fields) -> None:
+        """Append one write-ahead journal record (no-op without a
+        journal — ft_dir unset, or a ctor-only coordinator that never
+        entered run())."""
+        if self._journal is None:
+            return
+        self._journal.append(kind, **fields)
+        self.coord_journal_c.add()
 
     def _write_snapshot(self) -> None:
         if self.ft_dir is None:
@@ -418,11 +500,35 @@ class GangCoordinator(ChaosTarget):
             "budget": {"max_restarts": self.policy.budget.max_restarts,
                        "used": self.policy.budget.used},
             "heartbeat_interval_s": hb,
+            **self.journal_status(),
             **self.registry.varz(),
         }
         tmp = self.ft_dir / "supervisor.json.tmp"
         tmp.write_text(json.dumps(snap, indent=2))
         tmp.replace(self.ft_dir / "supervisor.json")
+
+    def journal_status(self) -> dict:
+        """Crash-safety state for supervisor.json and /healthz detail:
+        is this incarnation adopted, how deep is the journal, and is a
+        restart intent currently awaiting its commit."""
+        j = self._journal
+        return {
+            "adopted": self._adopted,
+            "journal": None if j is None else {
+                "path": str(j.path),
+                "records": j.seq,
+                "pending_intent": bool(self.coord_pending_g.value),
+            },
+        }
+
+    def health(self) -> tuple[bool, dict]:
+        """``obs.server`` HealthFn: the heartbeat monitor's fleet view
+        (when attached) plus the journal/adoption state — the probe
+        surface that lets an operator see 'this supervisor is a
+        restarted incarnation that adopted N hosts'."""
+        healthy, detail = (self.monitor.health() if self.monitor is not None
+                           else (True, {}))
+        return healthy, {**detail, **self.journal_status()}
 
     # -- supervision loop -------------------------------------------------
 
@@ -430,6 +536,8 @@ class GangCoordinator(ChaosTarget):
         inject = self.kill_host_after if first else None
         procs = self.launcher.launch(self.argv, kill_host_after=inject)
         self._procs = dict(zip(self.host_ids, procs))
+        self._j("gang_launched", first=first,
+                pids={str(h): p.pid for h, p in self._procs.items()})
         self._finished.clear()
         self.straggler_guard.reset_all()
         self._suppressed_hangs.clear()
@@ -449,6 +557,8 @@ class GangCoordinator(ChaosTarget):
         # Same host_env as the rank it replaces (host_id, obs port,
         # heartbeat file) — the gang must not notice the substitution.
         self._procs[host_id] = self.launcher.launch_host(self.argv, host_id)
+        self._j("solo_launched", host=host_id,
+                pid=self._procs[host_id].pid)
         self._finished.pop(host_id, None)
         self._suppressed_hangs.discard(host_id)
         self.straggler_guard.reset(host_id)
@@ -468,6 +578,12 @@ class GangCoordinator(ChaosTarget):
 
     def _detect(self, now: float) -> list[Failure]:
         failures: list[Failure] = []
+        if self._adopt_failures:
+            # Hosts that died while no coordinator was watching
+            # (adoption found their pid gone): raised exactly once,
+            # through the normal detect→decide path.
+            failures.extend(self._adopt_failures)
+            self._adopt_failures = []
         # Preemption notices (ISSUE 7): chaos-delivered plus the external
         # sentinel file an out-of-band notice daemon writes.  Consumed
         # here so one notice raises exactly one PREEMPT failure; a
@@ -488,6 +604,7 @@ class GangCoordinator(ChaosTarget):
             if rc is None:
                 continue
             if rc == 0:
+                self._j("host_exit", host=host_id, rc=0)
                 del self._procs[host_id]
                 self._finished[host_id] = 0
                 if self.monitor is not None:
@@ -535,6 +652,7 @@ class GangCoordinator(ChaosTarget):
                             and self.straggler_guard.observe(
                                 v.host_id,
                                 v.state is HostState.STRAGGLER, now=now)):
+                        self._j("straggler_probation", host=v.host_id)
                         failures.append(
                             Failure(v.host_id, FailureKind.STRAGGLER,
                                     step=v.step, detail=v.reason))
@@ -556,17 +674,31 @@ class GangCoordinator(ChaosTarget):
     def run(self) -> int:
         """Supervise until the gang finishes cleanly (0), a failure
         exhausts the policy budget (the failing rc), or the policy
-        declines to act on a fatal class."""
+        declines to act on a fatal class.  With a journal on disk from
+        a previous incarnation (and ``adopt`` not False), the running
+        fleet is adopted instead of relaunched — see
+        :meth:`_adopt_fleet`."""
         try:
-            if self.ft_dir is not None:
-                # A previous incarnation aborted mid-drain (supervisor
-                # SIGKILLed inside the wait loop) leaves drain.json /
-                # preempt.json behind; the fresh gang would self-drain
-                # at its first boundary and "finish" rc 0 having
-                # trained nothing.  Stale protocol files die here.
-                clear_drain(self.ft_dir)
-                consume_notice(self.ft_dir)
-            self._launch_gang(first=True)
+            if not self._startup_adopt():
+                if self.ft_dir is not None:
+                    # A previous incarnation aborted mid-drain
+                    # (supervisor SIGKILLed inside the wait loop) leaves
+                    # drain.json / preempt.json behind; the fresh gang
+                    # would self-drain at its first boundary and
+                    # "finish" rc 0 having trained nothing.  Stale
+                    # protocol files die here — along with stale rc
+                    # files and the previous run's journal.
+                    clear_drain(self.ft_dir)
+                    consume_notice(self.ft_dir)
+                    clear_rc_dir(self.ft_dir)
+                    rotate_journal(journal_path(self.ft_dir))
+                    self._journal = JournalWriter(
+                        journal_path(self.ft_dir))
+                    self._j("run_start", argv=self.argv,
+                            hosts=len(self.host_ids),
+                            policy=self.policy.name,
+                            max_restarts=self.policy.budget.max_restarts)
+                self._launch_gang(first=True)
             start = self.clock()
             while True:
                 self.sleep(self.poll_interval)
@@ -585,11 +717,13 @@ class GangCoordinator(ChaosTarget):
                         rc = next((r for r in self._finished.values() if r),
                                   0)
                         self.rc_g.set(rc)
+                        self._j("done", rc=rc)
                         self._event("done", rc=rc)
                         return rc
                     continue
                 rc = self._handle_incident(failures)
                 if rc is not None:
+                    self._j("done", rc=rc)
                     return rc
         finally:
             if self._procs:
@@ -598,6 +732,205 @@ class GangCoordinator(ChaosTarget):
                                        poll_interval=self.poll_interval)
                 self._procs.clear()
             self._write_snapshot()
+            if self._journal is not None:
+                self._journal.close()
+
+    # -- crash-safety: fleet adoption (ISSUE 12) --------------------------
+
+    def _startup_adopt(self) -> bool:
+        """Fresh launch vs adoption.  True when a previous incarnation's
+        unfinished journal was found and the running fleet was adopted
+        (the caller must then skip the first launch).  A journal whose
+        run already ended (done record) is history, not a fleet — the
+        caller rotates it and starts fresh.  A corrupt journal raises
+        :class:`~tpucfn.ft.journal.JournalError` loudly: reconstructing
+        a plausible-but-wrong fleet state would be worse."""
+        if self.ft_dir is None or self.adopt is False:
+            return False
+        jp = journal_path(self.ft_dir)
+        if not jp.exists():
+            return False
+        st, _records, torn = replay_journal(jp)
+        if not st.started or st.done_rc is not None:
+            return False
+        self._adopt_fleet(st, torn)
+        return True
+
+    def _adopt_fleet(self, st, torn: bool) -> None:
+        """Attach to the fleet a dead coordinator left running: restore
+        the durable state (budget, incident counter, shrinks, ckpt
+        blacklist, input restarts), re-attach to live children by pid
+        (journal incarnations first, heartbeat pids as the fallback for
+        a crash that landed between spawn and journal append), raise
+        exactly one CRASH failure per child that died unwatched, and
+        finish any mid-flight restart intent exactly once."""
+        t0 = self.clock()
+        self._adopted = True
+        if torn:
+            # The torn final record is the tolerated crash boundary —
+            # but JournalWriter appends, and appending after a partial
+            # line would glue the next record onto the torn bytes: one
+            # garbled line that is no longer final, which the NEXT
+            # replay would refuse as corruption.  Drop the tail first.
+            repair_torn_tail(journal_path(self.ft_dir))
+        self._journal = JournalWriter(journal_path(self.ft_dir),
+                                      start_seq=st.seq)
+        self._incident = st.incident
+        self.policy.budget.used = max(self.policy.budget.used,
+                                      st.budget_used)
+        for lost in st.shrinks:
+            # Re-apply recorded shrinks in order: the launcher was
+            # rebuilt from the original contract, but the fleet on disk
+            # is already the shrunk one.
+            self.launcher.contract = shrink_contract(
+                self.launcher.contract, sorted(lost))
+            self.host_ids = list(
+                range(self.launcher.contract.workers_count))
+            if self.monitor is not None:
+                self.monitor.set_expected_hosts(len(self.host_ids))
+        self._input_restarts = dict(st.input_restarts)
+        self._ckpt_blacklist = set(st.ckpt_blacklist)
+        self._ckpt_retries = st.ckpt_retries
+        if self._ckpt_blacklist:
+            self.launcher.extra_env[CKPT_BLACKLIST_ENV] = \
+                format_ckpt_blacklist(self._ckpt_blacklist)
+        self._finished = dict(st.finished)
+        if self.chaos is not None and st.chaos_fired:
+            # Scripted events that already fired must not re-fire in
+            # this incarnation — a kill_coordinator spec would
+            # otherwise kill every adoption forever.
+            self.chaos.skip_fired(st.chaos_fired)
+        beats = read_heartbeats(self.ft_dir)
+        pending_failures: list[Failure] = []
+        adopted_hosts: list[int] = []
+        dead: list[tuple[int, list[int]]] = []
+        for host in self.host_ids:
+            if host in self._finished:
+                if self.monitor is not None:
+                    self.monitor.retire_host(host)
+                continue
+            cands = []
+            if host in st.procs:
+                cands.append(st.procs[host])
+            hb_pid = (beats.get(host) or {}).get("pid")
+            if isinstance(hb_pid, int) and hb_pid not in cands:
+                cands.append(hb_pid)
+            live = next((p for p in cands if pid_alive(p)), None)
+            if live is not None:
+                self._procs[host] = AdoptedProcess(
+                    live, host_id=host, ft_dir=self.ft_dir)
+                adopted_hosts.append(host)
+                if self.monitor is not None:
+                    self.monitor.activate_host(host)
+            else:
+                dead.append((host, cands))
+        # Resolve the unwatched deaths.  The supervise reaper may still
+        # be racing us to land their rc files (it reaps our
+        # predecessor's orphans only when it re-enters waitpid after
+        # spawning us), so give it the same grace AdoptedProcess.poll
+        # gives it — without it, a rank that finished rc 0 during the
+        # downtime reads as a CRASH and burns a budget slot relaunching
+        # a host that was already done.
+        rcs: dict[int, int | None] = {}
+        for host, cands in dead:
+            rcs[host] = next((r for r in (read_rc(self.ft_dir, p)
+                                          for p in cands)
+                              if r is not None), None)
+        waiting = [h for h, c in dead if c and rcs[h] is None]
+        if waiting:
+            deadline = self.clock() + ADOPT_RC_GRACE_S
+            while waiting and self.clock() < deadline:
+                self.sleep(0.05)
+                for host, cands in dead:
+                    if rcs[host] is None:
+                        rcs[host] = next(
+                            (r for r in (read_rc(self.ft_dir, p)
+                                         for p in cands)
+                             if r is not None), None)
+                waiting = [h for h, c in dead if c and rcs[h] is None]
+        for host, cands in dead:
+            rc = rcs[host]
+            if rc == 0:
+                self._j("host_exit", host=host, rc=0)
+                self._finished[host] = 0
+                if self.monitor is not None:
+                    self.monitor.retire_host(host)
+            else:
+                pending_failures.append(Failure(
+                    host, FailureKind.CRASH, rc=rc,
+                    detail="died while the coordinator was down"
+                           if cands else "no incarnation on record"))
+        self.hosts_g.set(len(self._procs))
+        self.coord_adoptions_c.add()
+        self._j("adopted", hosts=adopted_hosts,
+                dead=[f.host_id for f in pending_failures],
+                pending=None if st.pending is None else st.pending.incident)
+        self._event("coordinator_adopted", hosts=adopted_hosts,
+                    dead=[f.host_id for f in pending_failures],
+                    budget_used=self.policy.budget.used,
+                    incident=self._incident,
+                    pending_incident=(None if st.pending is None
+                                      else st.pending.incident),
+                    torn=bool(torn))
+        if st.pending is None \
+                or st.pending.action != Action.DRAIN_RESTART.value:
+            # No drain is in flight: drain/notice files (and a notice
+            # consumed into memory pre-crash) are stale protocol state.
+            clear_drain(self.ft_dir)
+            consume_notice(self.ft_dir)
+        if st.pending is not None:
+            completed = self._complete_pending(st.pending, t0)
+            pending_failures = [f for f in pending_failures
+                                if f.host_id not in completed]
+        self._adopt_failures = pending_failures
+
+    def _complete_pending(self, p: PendingIntent, t0: float) -> set[int]:
+        """Finish a restart intent whose commit never landed — exactly
+        once: when the launch half already ran (launch records after
+        the intent), only the commit is written; otherwise the act runs
+        now.  Either way the budget draw journaled with the intent is
+        never re-drawn.  Returns the hosts the completion relaunched
+        (their unwatched deaths are moot)."""
+        action = p.action
+        self.coord_pending_g.set(1)
+        if not p.launched:
+            if action == Action.SOLO_RESTART.value:
+                # Hosts whose solo_launched already landed pre-crash got
+                # their restart — redoing them would be the double the
+                # intent/commit pair exists to prevent.
+                todo = [h for h in p.hosts if h not in p._solo_done]
+                for h in todo:
+                    if h in self._procs:
+                        self._stop_hosts([h])
+                    self._launch_solo(h)
+                self.ft_solo_restarts_c.add(len(todo))
+                self.ft_restarts_c.add(len(todo))
+                self.restarts_c.add(len(todo))
+                completed = set(todo)
+            else:  # gang-shaped: gang_restart / drain_restart / ckpt_retry
+                self._stop_hosts(list(self._procs))
+                if self.ft_dir is not None:
+                    clear_drain(self.ft_dir)
+                self._launch_gang(first=False)
+                if action == Action.DRAIN_RESTART.value:
+                    self.ft_preempt_drains_c.add()
+                    self.ft_planned_restarts_c.add()
+                else:
+                    self.ft_gang_restarts_c.add()
+                    self.ft_restarts_c.add()
+                    self.restarts_c.add()
+                completed = set(self.host_ids)
+        else:
+            completed = set()  # acted pre-crash; only the commit is owed
+        crash_point("adopt_before_commit", self.ft_dir)
+        self._j("restart_commit", incident=p.incident, action=action)
+        self.coord_pending_g.set(0)
+        mttr = self.clock() - t0
+        planned = p.planned or action == Action.DRAIN_RESTART.value
+        (self.ft_planned_mttr_s if planned else self.ft_mttr_s).observe(mttr)
+        self._event("recovered", incident=p.incident, action=action,
+                    planned=planned, mttr_s=round(mttr, 4), adopted=True)
+        return completed
 
     def _handle_input_failures(self, failures: list[Failure]
                                ) -> list[Failure]:
@@ -615,6 +948,7 @@ class GangCoordinator(ChaosTarget):
         if not inputs:
             return failures
         for f in inputs:
+            self._j("input_degraded", host=f.host_id)
             if f.host_id in self._procs:
                 # a hung service still holds its socket: stop it so
                 # trainer recv calls fail fast instead of timing out
@@ -629,6 +963,8 @@ class GangCoordinator(ChaosTarget):
             used = self._input_restarts.get(f.host_id, 0)
             if self.restart_input_hosts and used < self.max_input_restarts:
                 self._input_restarts[f.host_id] = used + 1
+                self._j("input_restarted", host=f.host_id,
+                        restarts=used + 1)
                 self._launch_solo(f.host_id)
                 self.ft_input_restarts_c.add()
                 self._event("input_recovered", host=f.host_id,
@@ -646,6 +982,7 @@ class GangCoordinator(ChaosTarget):
         ids = sorted(self._procs)
         self._stop_hosts(ids)
         for h in ids:
+            self._j("host_exit", host=h, rc=0)
             self._finished.setdefault(h, 0)
             if self.monitor is not None:
                 self.monitor.retire_host(h)
@@ -670,7 +1007,11 @@ class GangCoordinator(ChaosTarget):
                       "step": f.step, "detail": f.detail,
                       **({"lead_s": f.lead_s} if f.lead_s is not None
                          else {})} for f in failures]
+        self._j("incident_open", incident=incident, failures=[
+            {"host": f.host_id, "kind": f.kind.value, "rc": f.rc}
+            for f in failures])
         self._event("detect", incident=incident, failures=fail_json)
+        crash_point("after_detect", self.ft_dir)
         if self.tracer is not None:
             self.tracer.event("ft_detect", trace_id=incident,
                               failures=fail_json)
@@ -718,13 +1059,17 @@ class GangCoordinator(ChaosTarget):
             # suppress further HANG verdicts until the host beats again.
             for f in failures:
                 if f.kind is FailureKind.CRASH and f.host_id in self._procs:
+                    self._j("host_exit", host=f.host_id,
+                            rc=f.rc if f.rc else 1)
                     del self._procs[f.host_id]
                     self._finished[f.host_id] = f.rc if f.rc else 1
                 elif f.kind is FailureKind.HANG:
                     self._suppressed_hangs.add(f.host_id)
+            self._j("incident_closed", incident=incident, action="none")
             return None
         if decision.action is Action.GIVE_UP:
             rc = self._failure_rc(failures)
+            self._j("give_up", incident=incident, rc=rc)
             self.ft_give_ups_c.add()
             self._stop_hosts(list(self._procs))
             self.rc_g.set(rc)
@@ -735,6 +1080,18 @@ class GangCoordinator(ChaosTarget):
                                    end=self.clock(), trace_id=incident,
                                    rc=rc)
             return rc
+
+        # Write-ahead intent (ISSUE 12): the decision — including the
+        # budget slot it drew — is durable BEFORE any process is
+        # touched.  A coordinator crash anywhere between here and the
+        # matching restart_commit leaves a pending intent the adopting
+        # incarnation completes exactly once.
+        self._j("restart_intent", incident=incident,
+                action=decision.action.value, hosts=list(decision.hosts),
+                budget_used=self.policy.budget.used,
+                planned=decision.planned)
+        self.coord_pending_g.set(1)
+        crash_point("after_intent", self.ft_dir)
 
         if decision.action is Action.DRAIN_RESTART:
             return self._drain_restart(incident, decision, failures,
@@ -766,6 +1123,8 @@ class GangCoordinator(ChaosTarget):
         if lost and self.allow_shrink:
             if len(self.host_ids) - len(lost) < 1:
                 rc = self._failure_rc(failures)
+                self._j("give_up", incident=incident, rc=rc)
+                self.coord_pending_g.set(0)
                 self.ft_give_ups_c.add()
                 self._stop_hosts(list(self._procs))
                 self.rc_g.set(rc)
@@ -801,6 +1160,10 @@ class GangCoordinator(ChaosTarget):
             self.ft_gang_restarts_c.add()
             self.ft_restarts_c.add()
             self.restarts_c.add()
+        crash_point("before_commit", self.ft_dir)
+        self._j("restart_commit", incident=incident,
+                action=decision.action.value)
+        self.coord_pending_g.set(0)
         mttr = self.clock() - t_detect
         self.ft_mttr_s.observe(mttr)
         self._event("recovered", incident=incident,
@@ -894,6 +1257,7 @@ class GangCoordinator(ChaosTarget):
         drain_file = None
         if self.ft_dir is not None:
             drain_file = request_drain(self.ft_dir, step=target)
+            self._j("drain_armed", incident=incident, step=target)
         self._event("drain", incident=incident, hosts=list(decision.hosts),
                     step=target, grace_s=round(grace, 3),
                     file=None if drain_file is None else str(drain_file))
@@ -927,6 +1291,10 @@ class GangCoordinator(ChaosTarget):
                 and len(self.host_ids) - len(lost) >= 1):
             extra["shrink"] = self._do_shrink(incident, lost)
         self._launch_gang(first=False)
+        crash_point("before_commit", self.ft_dir)
+        self._j("restart_commit", incident=incident,
+                action=decision.action.value)
+        self.coord_pending_g.set(0)
         self.ft_preempt_drains_c.add()
         self.ft_planned_restarts_c.add()
         mttr = self.clock() - t_detect
@@ -969,6 +1337,7 @@ class GangCoordinator(ChaosTarget):
         info = {"from_hosts": old_n, "to_hosts": new_n,
                 "lost": sorted(lost),
                 "generation": new_contract.generation}
+        self._j("shrink", incident=incident, **info)
         self._event("shrink", incident=incident, **info)
         return info
 
@@ -982,6 +1351,12 @@ class GangCoordinator(ChaosTarget):
         rename failed)."""
         self._ckpt_retries += 1
         self._ckpt_blacklist.add(bad_step)
+        self._j("ckpt_retry", incident=incident, bad_step=bad_step,
+                blacklist=sorted(self._ckpt_blacklist))
+        self._j("restart_intent", incident=incident, action="ckpt_retry",
+                hosts=[], budget_used=self.policy.budget.used)
+        self.coord_pending_g.set(1)
+        crash_point("after_intent", self.ft_dir)
         self.ft_ckpt_retries_c.add()
         quarantine = None
         src = self.ckpt_dir / str(bad_step)
@@ -1003,6 +1378,9 @@ class GangCoordinator(ChaosTarget):
                     quarantine=quarantine, **ckpt_info)
         self._stop_hosts(list(self._procs))
         self._launch_gang(first=False)
+        crash_point("before_commit", self.ft_dir)
+        self._j("restart_commit", incident=incident, action="ckpt_retry")
+        self.coord_pending_g.set(0)
         self.ft_gang_restarts_c.add()
         self.ft_restarts_c.add()
         self.restarts_c.add()

@@ -43,6 +43,12 @@ EVENT_KINDS = (
     # the restart budget
     "input_degraded",    # an input host died/hung; trainers load locally
     "input_recovered",   # the input host was solo-relaunched
+    # coordinator crash-safety (ISSUE 12): the supervisor itself is
+    # journaled, restartable, and adoptable
+    "coordinator_adopted",    # a restarted coordinator attached to the fleet
+    "coordinator_restarted",  # the --supervise loop relaunched a dead one
+    "coordinator_give_up",    # the supervise restart budget ran out
+    "coordinator_killed",     # chaos kill_coordinator fired (bookkeeping)
     # chaos bookkeeping (ISSUE 4/7 harness)
     "chaos_preempt_notice",
     "chaos_ckpt_corrupted",
@@ -58,3 +64,22 @@ def validate_event_kind(kind: str) -> str:
             f"event kind {kind!r} is not in ft.events.EVENT_KINDS — add it "
             "to the canonical tuple (and its consumers) or fix the typo")
     return kind
+
+
+def append_event(ft_dir, kind: str, **fields) -> dict:
+    """Append one validated event row, flushed AND fsync'd before
+    returning (ISSUE 12 satellite): the detect/decide record of the
+    very incident that kills the writer must survive the writer —
+    a buffered append was exactly the durability hole the coordinator
+    shipped with.  Shared by the coordinator and the supervise loop."""
+    import json
+    import os
+    import time
+    from pathlib import Path
+
+    rec = {"ts": time.time(), "kind": validate_event_kind(kind), **fields}
+    with open(Path(ft_dir) / "events.jsonl", "a") as f:
+        f.write(json.dumps(rec) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    return rec

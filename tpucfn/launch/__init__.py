@@ -5,3 +5,7 @@ from tpucfn.launch.launcher import (  # noqa: F401
     initialize_runtime,
     run_with_restarts,
 )
+from tpucfn.launch.supervise import (  # noqa: F401
+    run_supervised,
+    supervised_cli_argv,
+)

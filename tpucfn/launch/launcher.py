@@ -221,7 +221,12 @@ class Launcher:
         is still alive after ``grace_s`` (a rank wedged in a collective,
         or SIGSTOP'd by the chaos harness, ignores SIGTERM forever).
         All processes are reaped before returning.  Returns how many
-        needed the SIGKILL escalation."""
+        needed the SIGKILL escalation.
+
+        ``procs`` is the ``poll/terminate/kill/wait`` duck-type, not
+        necessarily ``Popen``: an adopting coordinator (ISSUE 12) hands
+        this :class:`~tpucfn.ft.journal.AdoptedProcess` handles for
+        ranks it re-attached to but did not spawn."""
         import time
 
         live = [p for p in procs if p.poll() is None]

@@ -9,6 +9,7 @@ from tpucfn.obs.metrics import (  # noqa: F401
 )
 from tpucfn.obs.flight import (  # noqa: F401
     FlightRecorder,
+    hbm_watermark,
     read_flight_dir,
     read_flight_file,
 )

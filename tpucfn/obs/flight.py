@@ -208,6 +208,61 @@ class FlightRecorder:
             pass  # a full/vanished disk must not mask the real exit
 
 
+# HBM watermark defaults (ISSUE 12 satellite, ROADMAP forensics
+# follow-on): "used/limit sustained over a threshold" — the burn-rate
+# shape the serve SLO plane uses, applied to device memory so an OOM
+# becomes a /healthz prediction instead of a postmortem.
+HBM_WATERMARK_THRESHOLD = 0.92
+HBM_WATERMARK_SUSTAIN_S = 30.0
+
+
+def hbm_watermark(samples, *, threshold: float = HBM_WATERMARK_THRESHOLD,
+                  sustain_s: float = HBM_WATERMARK_SUSTAIN_S,
+                  now: float | None = None) -> dict:
+    """Burn-rate-style watermark over a ring's ``hbm`` samples.
+
+    Walks the contiguous tail of samples whose ``used/limit`` ratio is
+    at or above ``threshold``; the alert fires only when that tail has
+    *sustained* for ``sustain_s`` seconds — one transient allocation
+    spike (a compile's scratch, a fused temp) must not page anyone.
+
+    Returns ``{"level": "ok"|"alert"|"no_data", "ratio", "peak_ratio",
+    "sustained_s", "threshold", "sustain_s"}`` — merged into /healthz
+    detail by the obs server whenever a flight recorder is attached.
+    ``level`` never flips the probe's HTTP status: a watermark is a
+    prediction for operators and autoscalers, not a liveness verdict.
+    """
+    pts: list[tuple[float, float]] = []
+    for s in samples:
+        # tpucfn: allow[vocab-drift] ring SAMPLE kinds are open (module doc)
+        if s.get("kind") != "hbm":
+            continue
+        used, limit = s.get("used"), s.get("limit")
+        if not isinstance(used, (int, float)) \
+                or not isinstance(limit, (int, float)) or limit <= 0:
+            continue
+        pts.append((float(s.get("t", 0.0)), used / limit))
+    base = {"threshold": threshold, "sustain_s": sustain_s}
+    if not pts:
+        return {"level": "no_data", "ratio": None, "peak_ratio": None,
+                "sustained_s": 0.0, **base}
+    ratio = pts[-1][1]
+    peak = max(r for _, r in pts)
+    over_since = None
+    for t, r in reversed(pts):
+        if r < threshold:
+            break
+        over_since = t
+    sustained = 0.0
+    if over_since is not None and ratio >= threshold:
+        end = pts[-1][0] if now is None else now
+        sustained = max(0.0, end - over_since)
+    level = "alert" if sustained >= sustain_s else "ok"
+    return {"level": level, "ratio": round(ratio, 4),
+            "peak_ratio": round(peak, 4),
+            "sustained_s": round(sustained, 3), **base}
+
+
 def write_flight_dump(path: str | Path, snapshot: dict) -> Path:
     """One dump file from a :meth:`FlightRecorder.snapshot`-shaped dict:
     header line (``samples`` becomes a count) then one line per sample.

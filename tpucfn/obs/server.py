@@ -150,6 +150,22 @@ class ObsServer:
                 healthy, detail = self.health_fn()
             except Exception as e:  # a crashing probe IS unhealthy
                 healthy, detail = False, {"probe_error": repr(e)}
+        detail = dict(detail)
+        if self.flight is not None:
+            # HBM watermark (ISSUE 12 satellite): when this role carries
+            # a flight ring with device-memory samples, /healthz detail
+            # predicts OOMs (sustained used/limit over the threshold)
+            # instead of leaving them to the postmortem.  Detail only —
+            # a prediction must not flap a load balancer.
+            try:
+                from tpucfn.obs.flight import hbm_watermark
+
+                wm = hbm_watermark(
+                    self.flight.snapshot().get("samples") or [])
+                if wm["level"] != "no_data":
+                    detail.setdefault("hbm_watermark", wm)
+            except Exception:  # noqa: BLE001 — best-effort enrichment
+                pass
         payload = {
             "status": "ok" if healthy else "unhealthy",
             "role": self.role,
