@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""Fleet warm-start bench (ISSUE 13 acceptance): the artifact cache's
+two claims, measured and rc-gated, ONE JSON line out in the standard
+BENCH row schema.
+
+* **warm-start** — a cold child process compiles a grad program and
+  publishes its serialized executable into a fresh artifact store; a
+  second process on the same machine starts the same program through
+  the store and must reach its first step in ``--warm-ratio`` (default
+  0.35) of the cold time.  Time-to-first-step is measured from
+  jax-imported to first-result-ready inside each child, so the number
+  isolates what the cache changes (compile vs deserialize), not
+  interpreter boot.
+* **fan-out** — a simulated 2-host cold fleet: an in-process
+  :class:`~tpucfn.compilecache.service.ArtifactServer` plus two child
+  processes racing the same cold key must produce exactly 1 compile and
+  1 fetch (the single-flight guard, pinned).
+
+Children are this same file (``TPUCFN_COMPILE_BENCH_CHILD=1``), so the
+bench exercises the real cross-process path — separate interpreters,
+separate jax runtimes, artifacts only through the store/server.
+
+Usage: python benches/compile_bench.py [--layers 48 --width 128 ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+# -- the measured program ---------------------------------------------------
+#
+# A residual-MLP grad: enough distinct fused ops that XLA:CPU pays a
+# real compile (seconds at the default depth), while the serialized
+# executable deserializes in tens of milliseconds.
+
+def child() -> int:
+    layers = int(os.environ["TPUCFN_COMPILE_BENCH_LAYERS"])
+    width = int(os.environ["TPUCFN_COMPILE_BENCH_WIDTH"])
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpucfn.compilecache import configure_from_env
+    from tpucfn.compilecache.jit import maybe_warm
+
+    client = configure_from_env()
+
+    def loss(params, x):
+        h = x
+        for w, b in params:
+            h = jnp.tanh(h @ w + b) + 0.1 * h
+        return (h ** 2).mean()
+
+    rs = np.random.RandomState(0)
+    params = [(rs.randn(width, width).astype(np.float32) * 0.1,
+               np.zeros(width, np.float32)) for _ in range(layers)]
+    x = rs.randn(8, width).astype(np.float32)
+
+    t0 = time.perf_counter()  # jax imported, program built: the clock
+    step = maybe_warm(jax.jit(jax.grad(loss)), label="compile_bench")
+    out = step(params, x)
+    jax.block_until_ready(out)
+    ttfs = time.perf_counter() - t0
+    digest = float(sum(float(jnp.sum(w)) for w, _ in out))
+    print(json.dumps({
+        "ttfs_s": round(ttfs, 4),
+        "outcome": client.last_outcome if client is not None else None,
+        "digest": digest,
+    }))
+    return 0
+
+
+# -- the orchestrator -------------------------------------------------------
+
+def _run_child(args, *, store_dir: str | None, addrs: str | None,
+               env_extra: dict | None = None) -> dict:
+    env = {**os.environ,
+           "TPUCFN_COMPILE_BENCH_CHILD": "1",
+           "TPUCFN_COMPILE_BENCH_LAYERS": str(args.layers),
+           "TPUCFN_COMPILE_BENCH_WIDTH": str(args.width),
+           "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu")}
+    env.pop("TPUCFN_COMPILE_CACHE_DIR", None)
+    env.pop("TPUCFN_COMPILE_CACHE_ADDRS", None)
+    if store_dir is not None:
+        env["TPUCFN_COMPILE_CACHE_DIR"] = store_dir
+    if addrs is not None:
+        env["TPUCFN_COMPILE_CACHE_ADDRS"] = addrs
+    env.update(env_extra or {})
+    proc = subprocess.run([sys.executable, __file__], env=env,
+                          capture_output=True, text=True,
+                          timeout=args.timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"bench child failed rc={proc.returncode}:\n{proc.stderr[-2000:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def _spawn_child(args, *, store_dir: str, addrs: str) -> subprocess.Popen:
+    env = {**os.environ,
+           "TPUCFN_COMPILE_BENCH_CHILD": "1",
+           "TPUCFN_COMPILE_BENCH_LAYERS": str(args.layers),
+           "TPUCFN_COMPILE_BENCH_WIDTH": str(args.width),
+           "TPUCFN_COMPILE_CACHE_DIR": store_dir,
+           "TPUCFN_COMPILE_CACHE_ADDRS": addrs,
+           "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu")}
+    return subprocess.Popen([sys.executable, __file__], env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True)
+
+
+def main() -> int:
+    if os.environ.get("TPUCFN_COMPILE_BENCH_CHILD") == "1":
+        return child()
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--layers", type=int, default=48,
+                   help="program depth — sizes the cold compile")
+    p.add_argument("--width", type=int, default=128)
+    p.add_argument("--warm-ratio", type=float, default=0.35,
+                   help="acceptance gate: warm ttfs must be <= this "
+                        "fraction of cold ttfs")
+    p.add_argument("--timeout", type=float, default=300.0)
+    p.add_argument("--skip-fanout", action="store_true")
+    args = p.parse_args()
+
+    tmp = Path(tempfile.mkdtemp(prefix="tpucfn-compile-bench-"))
+    try:
+        # -- phase 1: same-machine warm start via the artifact store --
+        store = str(tmp / "store")
+        cold = _run_child(args, store_dir=store, addrs=None)
+        warm = _run_child(args, store_dir=store, addrs=None)
+        ratio = warm["ttfs_s"] / cold["ttfs_s"] if cold["ttfs_s"] else 1.0
+        warm_ok = (cold["outcome"] == "compile"
+                   and warm["outcome"] == "store"
+                   and warm["digest"] == cold["digest"]
+                   and ratio <= args.warm_ratio)
+
+        # -- phase 2: 2-host cold-fleet fan-out: 1 compile + 1 fetch --
+        fanout: dict = {"skipped": True}
+        fan_ok = True
+        if not args.skip_fanout:
+            from tpucfn.compilecache.service import ArtifactServer
+
+            srv = ArtifactServer(tmp / "server-store",
+                                 host="127.0.0.1").start()
+            try:
+                procs = [
+                    _spawn_child(args, store_dir=str(tmp / f"host{i}"),
+                                 addrs=srv.address)
+                    for i in range(2)]
+                outs = []
+                for proc in procs:
+                    stdout, stderr = proc.communicate(timeout=args.timeout)
+                    if proc.returncode != 0:
+                        raise RuntimeError(
+                            f"fan-out child rc={proc.returncode}:"
+                            f"\n{stderr[-2000:]}")
+                    outs.append(json.loads(
+                        stdout.strip().splitlines()[-1]))
+            finally:
+                srv.close()
+            outcomes = sorted(o["outcome"] for o in outs)
+            fan_ok = (outcomes == ["compile", "fetch"]
+                      and outs[0]["digest"] == outs[1]["digest"])
+            fanout = {"outcomes": outcomes,
+                      "ttfs_s": [o["ttfs_s"] for o in outs],
+                      "digests_equal": outs[0]["digest"] == outs[1]["digest"],
+                      "ok": fan_ok}
+
+        ok = warm_ok and fan_ok
+        print(f"# compile_bench cold={cold['ttfs_s']}s "
+              f"warm={warm['ttfs_s']}s ratio={ratio:.3f} "
+              f"(gate {args.warm_ratio}) fanout={fanout} ok={ok}",
+              file=sys.stderr)
+        row = {
+            "metric": "compile_warm_start_ratio",
+            "value": round(ratio, 4),
+            "unit": "warm/cold time-to-first-step",
+            "vs_baseline": 0.0,
+            "detail": {
+                "baseline_note": "no fleet artifact plane existed "
+                                 "before ISSUE 13; the cold number is "
+                                 "the baseline",
+                "ok": ok,
+                "cold_time_to_first_step_s": cold["ttfs_s"],
+                "warm_time_to_first_step_s": warm["ttfs_s"],
+                "cold_outcome": cold["outcome"],
+                "warm_outcome": warm["outcome"],
+                "digest_bit_identical": warm["digest"] == cold["digest"],
+                "gate_ratio": args.warm_ratio,
+                "layers": args.layers,
+                "width": args.width,
+                "fanout": fanout,
+            },
+        }
+        print(json.dumps(row))
+        return 0 if ok else 1
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

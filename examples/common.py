@@ -240,6 +240,17 @@ def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=(),
             registry,
             jit_sources=(lambda: trainer._jit_step,
                          lambda: trainer._jit_eval))
+        # Fleet warm start (ISSUE 13): when the launcher fanned out
+        # artifact-server addresses (TPUCFN_COMPILE_CACHE_ADDRS) — or a
+        # local store dir is pinned — the trainer's jitted programs go
+        # lower → key → fetch-or-compile, the probe learns the verdict
+        # (compile / compile_cached / compile_fetched in the ledger),
+        # and fetches land a compile_fetch trace span.  Env unset ⇒
+        # None installed, the jit path is byte-identical.
+        from tpucfn.compilecache import configure_from_env
+
+        configure_from_env(tracer=tracer, registry=registry,
+                           probe=compile_probe)
         obs = TrainerObs(registry, tracer, ledger=ledger, flight=flight,
                          compile_probe=compile_probe)
         obs_srv = start_obs_server(

@@ -1194,3 +1194,113 @@ def act(decision):
 def test_totality_silent_without_enums(tmp_path):
     assert check(tmp_path, {"m.py": "X_TABLE = {1: 2}\n"},
                  rules=["decision-totality"]) == []
+
+
+# -- span-balance -----------------------------------------------------------
+
+# The ISSUE 13 hazard, reduced: a span family whose record() observes a
+# start but never an end (every percentile over it reads 0), and a span
+# emitted that no reader ever matches on (write-only trace lines).
+
+SPAN_UNBALANCED = '''
+import time
+
+
+class Obs:
+    def __init__(self, tracer):
+        self.tracer = tracer
+
+    def fetch(self):
+        t0 = time.monotonic()
+        self.tracer.record("compile_fetch", start=t0)
+'''
+
+SPAN_BALANCED_AND_CONSUMED = '''
+import time
+
+
+class Obs:
+    def __init__(self, tracer):
+        self.tracer = tracer
+
+    def fetch(self):
+        t0 = time.monotonic()
+        self.tracer.record("compile_fetch", start=t0,
+                           dur_s=time.monotonic() - t0)
+
+
+def view(events):
+    return [e for e in events if e.get("name") == "compile_fetch"]
+'''
+
+
+def test_span_unbalanced_record_fires(tmp_path):
+    fs = check(tmp_path, {"obs.py": SPAN_UNBALANCED},
+               rules=["span-balance"])
+    keys = {f.key for f in fs}
+    assert "unbalanced:compile_fetch" in keys
+    assert "unconsumed:compile_fetch" in keys  # no reader either
+
+
+def test_span_balanced_and_consumed_is_silent(tmp_path):
+    assert check(tmp_path, {"obs.py": SPAN_BALANCED_AND_CONSUMED},
+                 rules=["span-balance"]) == []
+
+
+def test_span_consumed_via_module_tuple_is_silent(tmp_path):
+    src = SPAN_BALANCED_AND_CONSUMED.replace(
+        '''def view(events):
+    return [e for e in events if e.get("name") == "compile_fetch"]''',
+        '''CONTROL_SPANS = ("compile_fetch",)
+
+
+def view(events):
+    return [e for e in events if e.get("name") in CONTROL_SPANS]''')
+    assert check(tmp_path, {"obs.py": src}, rules=["span-balance"]) == []
+
+
+def test_span_consumed_via_bound_name_var_is_silent(tmp_path):
+    """request_breakdown's shape: name bound from e.get("name") then
+    compared — must count as consumption."""
+    src = SPAN_BALANCED_AND_CONSUMED.replace(
+        '''def view(events):
+    return [e for e in events if e.get("name") == "compile_fetch"]''',
+        '''def view(events):
+    out = []
+    for e in events:
+        name = e.get("name")
+        if name == "compile_fetch":
+            out.append(e)
+    return out''')
+    assert check(tmp_path, {"obs.py": src}, rules=["span-balance"]) == []
+
+
+def test_span_event_kind_point_marker_is_exempt(tmp_path):
+    src = '''
+import time
+
+
+def emit(tracer):
+    tracer.record("preempted", start=time.monotonic(), kind="event")
+'''
+    assert check(tmp_path, {"obs.py": src}, rules=["span-balance"]) == []
+
+
+def test_span_write_only_fires_once_per_name(tmp_path):
+    src = SPAN_BALANCED_AND_CONSUMED.replace(
+        '"compile_fetch"', '"ghost_span"')  # emitter and consumer renamed
+    # break ONLY the consumer: the emitted name no longer matches it
+    src = src.replace('e.get("name") == "ghost_span"',
+                      'e.get("name") == "other_span"')
+    fs = check(tmp_path, {"obs.py": src}, rules=["span-balance"])
+    assert [f.key for f in fs] == ["unconsumed:ghost_span"]
+
+
+def test_span_flight_ring_record_without_start_is_ignored(tmp_path):
+    """The flight ring's same-named method takes no start= — not a
+    trace span, never flagged."""
+    src = '''
+def emit(flight):
+    flight.record("step", step=3, dur_s=0.1)
+'''
+    assert check(tmp_path, {"obs.py": src}, rules=["span-balance"]) == []

@@ -1,0 +1,509 @@
+"""Fleet warm-start plane, jax-free half (ISSUE 13): the
+content-addressed store, the artifact server/client, the single-flight
+cold-fleet stampede, and every degrade path — compile/serialize are
+injected callables, so none of this imports jax."""
+
+import json
+import socket
+import struct
+import threading
+
+import pytest
+
+from tpucfn.compilecache.service import (
+    CC_ERROR,
+    CC_HELLO,
+    CC_HIT,
+    CC_MAGIC,
+    CC_OK,
+    ArtifactClient,
+    ArtifactServer,
+    CompileCacheClient,
+    cache_addrs_from_env,
+)
+from tpucfn.compilecache.store import (
+    ArtifactStore,
+    CacheCorrupt,
+    CacheMismatch,
+    cache_key,
+    valid_key,
+)
+from tpucfn.data.service import ServiceError, recv_frame, send_frame
+
+
+def _bin_of(store_dir, key):
+    """The payload file a key's committed meta points at (bins are
+    hash-named since the concurrent-publish hardening)."""
+    meta = json.loads((store_dir / f"{key}.meta.json").read_text())
+    return store_dir / meta["bin"]
+
+
+# -- store ------------------------------------------------------------------
+
+def test_cache_key_stable_and_sensitive():
+    k1 = cache_key({"hlo": "abc", "device": "cpu"})
+    assert k1 == cache_key({"device": "cpu", "hlo": "abc"})  # order-free
+    assert k1 != cache_key({"hlo": "abd", "device": "cpu"})
+    assert valid_key(k1)
+    assert not valid_key("../../etc/passwd")
+    assert not valid_key("ABC")  # uppercase is not hex-digest form
+
+
+def test_store_roundtrip_and_idempotent_put(tmp_path):
+    st = ArtifactStore(tmp_path, device_kind="cpu", jax_version="1")
+    k = cache_key({"p": 1})
+    assert st.get(k) is None
+    st.put(k, b"exe", {"label": "train_step"})
+    payload, meta = st.get(k)
+    assert payload == b"exe" and meta["label"] == "train_step"
+    st.put(k, b"exe", {"label": "train_step"})  # no-op re-publish
+    assert st.keys() == [k]
+
+
+def test_store_corruption_quarantines_loudly(tmp_path):
+    st = ArtifactStore(tmp_path)
+    k = cache_key({"p": 2})
+    st.put(k, b"exe", {})
+    _bin_of(tmp_path, k).write_bytes(b"flipped")
+    with pytest.raises(CacheCorrupt):
+        st.get(k)
+    # quarantined: the key slot is free (a plain miss), the bytes kept
+    assert st.get(k) is None
+    assert list((tmp_path / "corrupt").iterdir())
+
+
+def test_store_version_mismatch_refused(tmp_path):
+    ArtifactStore(tmp_path, device_kind="TPU v5e",
+                  jax_version="0.4.0/x").put(cache_key({"p": 3}), b"e", {})
+    st = ArtifactStore(tmp_path, device_kind="cpu", jax_version="0.4.37/y")
+    with pytest.raises(CacheMismatch):
+        st.get(cache_key({"p": 3}))
+
+
+def test_store_claim_single_flight(tmp_path):
+    st = ArtifactStore(tmp_path)
+    k = cache_key({"p": 4})
+    assert st.claim(k)
+    assert not st.claim(k)  # held
+    st.release(k)
+    assert st.claim(k)
+
+
+# -- server/client ----------------------------------------------------------
+
+def test_server_fetch_roundtrip_and_stats(tmp_path):
+    with ArtifactServer(tmp_path / "srv", host="127.0.0.1") as srv:
+        c = ArtifactClient(srv.address, device_kind="cpu", jax_version="1")
+        k = cache_key({"p": 5})
+        assert c.get(k) is None
+        assert c.claim(k) == "granted"
+        c.put(k, b"exe-bytes", {"label": "x"})
+        payload, meta = c.get(k)
+        assert payload == b"exe-bytes" and meta["label"] == "x"
+        assert c.claim(k) == "hit"  # published while dialing
+        s = c.stats()
+        assert s["entries"] == 1 and s["device_kind"] == "cpu"
+
+
+def test_server_handshake_refuses_mismatched_fleet(tmp_path):
+    with ArtifactServer(tmp_path / "srv", host="127.0.0.1") as srv:
+        ArtifactClient(srv.address, device_kind="cpu",
+                       jax_version="1").get(cache_key({"p": 6}))
+        other = ArtifactClient(srv.address, device_kind="TPU v5e",
+                               jax_version="1")
+        with pytest.raises(ServiceError, match="device_kind"):
+            other.get(cache_key({"p": 6}))
+        wrong_jax = ArtifactClient(srv.address, device_kind="cpu",
+                                   jax_version="2")
+        with pytest.raises(ServiceError, match="jax version"):
+            wrong_jax.get(cache_key({"p": 6}))
+
+
+def test_server_corrupt_entry_served_as_miss(tmp_path):
+    with ArtifactServer(tmp_path / "srv", host="127.0.0.1") as srv:
+        c = ArtifactClient(srv.address)
+        k = cache_key({"p": 7})
+        c.put(k, b"good", {})
+        _bin_of(tmp_path / "srv", k).write_bytes(b"bad")
+        assert c.get(k) is None  # quarantined server-side, never served
+
+
+def _fleet_client(tmp_path, i, addr, **kw):
+    return CompileCacheClient(
+        ArtifactStore(tmp_path / f"host{i}", device_kind="cpu",
+                      jax_version="1"),
+        [addr], device_kind="cpu", jax_version="1",
+        wait_s=kw.pop("wait_s", 10.0), poll_s=0.02, **kw)
+
+
+def test_cold_fleet_stampede_exactly_one_compile(tmp_path):
+    """The ISSUE 13 acceptance pin: N clients racing a cold cache on
+    one key → exactly 1 compile + N-1 fetches, all bit-identical."""
+    compiles = []
+    lock = threading.Lock()
+    results = {}
+    with ArtifactServer(tmp_path / "srv", host="127.0.0.1") as srv:
+        def run(i):
+            def compile_fn():
+                with lock:
+                    compiles.append(i)
+                import time
+
+                time.sleep(0.25)  # a real compile takes a while
+                return b"EXE"
+
+            c = _fleet_client(tmp_path, i, srv.address)
+            results[i] = c.get_or_compile(
+                cache_key({"prog": "stampede"}), compile_fn,
+                serialize_fn=lambda r: r,
+                deserialize_fn=lambda p, m: p)
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert len(compiles) == 1
+    assert all(r == b"EXE" for r, _ in results.values())
+    assert sorted(o for _, o in results.values()) == \
+        ["compile", "fetch", "fetch", "fetch"]
+
+
+def test_fetch_failure_mid_transfer_degrades_to_local_compile(tmp_path):
+    """A server that dies mid-HIT-frame: the client's recv tears, and
+    the run degrades to a local compile of the exact same program —
+    trajectory bit-identical, failure counted."""
+    k = cache_key({"prog": "torn"})
+    entry_meta = {"key": k, "sha256": "0" * 64, "size": 1 << 20}
+
+    held = threading.Event()
+    srv_sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv_sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv_sock.bind(("127.0.0.1", 0))
+    srv_sock.listen(4)
+    port = srv_sock.getsockname()[1]
+
+    def evil_server():
+        held.set()
+        while True:
+            try:
+                conn, _ = srv_sock.accept()
+            except OSError:
+                return
+            try:
+                kind, _ = recv_frame(conn, magic=CC_MAGIC)
+                assert kind == CC_HELLO
+                send_frame(conn, CC_OK, json.dumps({"v": 1}).encode(),
+                           magic=CC_MAGIC)
+                recv_frame(conn, magic=CC_MAGIC)  # the GET
+                # claim a 1 MiB HIT payload, ship only the first bytes
+                head = json.dumps(entry_meta).encode()
+                blob = struct.pack("<I", len(head)) + head + b"x" * 64
+                conn.sendall(struct.pack("<4scI", CC_MAGIC, CC_HIT,
+                                         len(blob) + (1 << 20)))
+                conn.sendall(blob)
+                conn.close()  # mid-transfer death
+            except (OSError, ServiceError, AssertionError):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    t = threading.Thread(target=evil_server, daemon=True)
+    t.start()
+    held.wait()
+    try:
+        c = _fleet_client(tmp_path, 0, f"127.0.0.1:{port}", wait_s=0.5)
+        result, outcome = c.get_or_compile(
+            k, lambda: b"LOCAL-EXE", serialize_fn=lambda r: r,
+            deserialize_fn=lambda p, m: p)
+    finally:
+        srv_sock.close()
+    assert (result, outcome) == (b"LOCAL-EXE", "compile")
+    assert c.fetch_failures_c.value >= 1
+
+
+def test_fetched_payload_failing_deserialize_degrades(tmp_path):
+    """A well-transferred artifact that will not deserialize is
+    corruption by another name: quarantined, counted, compiled over."""
+    with ArtifactServer(tmp_path / "srv", host="127.0.0.1") as srv:
+        ArtifactClient(srv.address).put(cache_key({"p": 8}), b"garbage", {})
+        c = _fleet_client(tmp_path, 0, srv.address)
+
+        def boom(payload, meta):
+            raise ValueError("not an executable")
+
+        result, outcome = c.get_or_compile(
+            cache_key({"p": 8}), lambda: "COMPILED",
+            serialize_fn=lambda r: None, deserialize_fn=boom)
+    assert (result, outcome) == ("COMPILED", "compile")
+    assert c.corrupt_c.value >= 1
+
+
+def test_busy_wait_times_out_into_local_compile(tmp_path):
+    """The peer that claimed the key died mid-compile: a waiter's
+    budget expires and it compiles locally instead of hanging."""
+    with ArtifactServer(tmp_path / "srv", host="127.0.0.1",
+                        claim_ttl_s=300.0) as srv:
+        assert ArtifactClient(srv.address).claim(
+            cache_key({"p": 9})) == "granted"
+        c = _fleet_client(tmp_path, 0, srv.address, wait_s=0.3)
+        result, outcome = c.get_or_compile(
+            cache_key({"p": 9}), lambda: "MINE",
+            serialize_fn=lambda r: None, deserialize_fn=lambda p, m: p)
+    assert (result, outcome) == ("MINE", "compile")
+
+
+def test_dead_server_degrades_to_local_compile(tmp_path):
+    c = _fleet_client(tmp_path, 0, "127.0.0.1:1", wait_s=0.2)
+    result, outcome = c.get_or_compile(
+        cache_key({"p": 10}), lambda: "LOCAL",
+        serialize_fn=lambda r: None, deserialize_fn=lambda p, m: p)
+    assert (result, outcome) == ("LOCAL", "compile")
+    assert c.fetch_failures_c.value >= 1
+
+
+# -- launcher fan-out -------------------------------------------------------
+
+def _launcher(tmp_path, **kw):
+    from tpucfn.bootstrap import EnvContract
+    from tpucfn.launch import Launcher, LocalTransport
+
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("127.0.0.1:0\n127.0.0.1:0\n")
+    contract = EnvContract(
+        workers_path=str(hostfile), workers_count=2, worker_chip_count=1,
+        coordinator="127.0.0.1:1234", host_id=0, storage=str(tmp_path),
+        generation=1)
+    return Launcher(contract, LocalTransport(), **kw)
+
+
+def test_launcher_fans_out_compile_cache_addrs(tmp_path):
+    lch = _launcher(tmp_path,
+                    compile_cache_addrs=["10.0.0.1:7741", "10.0.0.2:7741"])
+    for h in (0, 1):
+        env = lch.host_env(h)
+        assert env["TPUCFN_COMPILE_CACHE_ADDRS"] == \
+            "10.0.0.1:7741,10.0.0.2:7741"
+    assert cache_addrs_from_env(lch.host_env(0)) == \
+        ["10.0.0.1:7741", "10.0.0.2:7741"]
+
+
+def test_launcher_env_byte_identical_without_compile_cache(tmp_path):
+    """The pinned default: no compile_cache_addrs ⇒ the host env has no
+    new keys at all — launched jobs cannot tell this PR happened."""
+    env = _launcher(tmp_path).host_env(0)
+    assert "TPUCFN_COMPILE_CACHE_ADDRS" not in env
+    assert cache_addrs_from_env(env) == []
+
+
+def test_cli_compilecache_serve_and_stats(tmp_path, capsys):
+    """The standalone server command serves, answers stats, and exits
+    on --serve-for with a stats JSON line (the input-host role shape)."""
+    import threading as th
+
+    from tpucfn.cli.main import main as cli_main
+
+    rcs = {}
+
+    def run():
+        rcs["serve"] = cli_main([
+            "compilecache", "serve", "--dir", str(tmp_path / "store"),
+            "--host", "127.0.0.1", "--port", "0", "--serve-for", "1.5"])
+
+    t = th.Thread(target=run)
+    t.start()
+    try:
+        import time
+
+        deadline = time.monotonic() + 5.0
+        addr = None
+        while time.monotonic() < deadline and addr is None:
+            time.sleep(0.05)
+            err = capsys.readouterr().err
+            for line in err.splitlines():
+                if "listening on" in line:
+                    addr = line.split("listening on ")[1].split()[0]
+        assert addr is not None, "server never printed its address"
+        ArtifactClient(addr).put(cache_key({"p": 11}), b"exe", {})
+        rc = cli_main(["compilecache", "stats", "--addr", addr])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert json.loads(out.strip().splitlines()[-1])["entries"] == 1
+    finally:
+        t.join(timeout=10)
+    assert rcs.get("serve") == 0
+
+
+# -- review-pass pins -------------------------------------------------------
+
+def test_local_claim_race_loser_deserializes_winners_artifact(tmp_path):
+    """Two local ranks, one shared store dir, no fleet: the rank that
+    loses the claim race must get the winner's artifact THROUGH the
+    caller's deserialize_fn — not the raw payload bytes (which would
+    memoize as the 'executable' and crash every subsequent step)."""
+    store_dir = tmp_path / "shared"
+    lock = threading.Lock()
+    compiles = []
+    results = {}
+
+    def client():
+        return CompileCacheClient(
+            ArtifactStore(store_dir, device_kind="cpu", jax_version="1"),
+            [], device_kind="cpu", jax_version="1",
+            wait_s=10.0, poll_s=0.02)
+
+    def compile_fn():
+        with lock:
+            compiles.append(1)
+        import time
+
+        time.sleep(0.3)
+        return ("LOADED", b"EXE")
+
+    def run(i):
+        results[i] = client().get_or_compile(
+            cache_key({"prog": "local-race"}), compile_fn,
+            serialize_fn=lambda r: r[1],
+            deserialize_fn=lambda p, m: ("LOADED", bytes(p)))
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(compiles) == 1
+    # every rank — winner AND losers — holds the deserialized form
+    assert all(r == ("LOADED", b"EXE") for r, _ in results.values())
+    assert sorted(o for _, o in results.values()) == \
+        ["compile", "store", "store"]
+
+
+def test_failed_compile_releases_fleet_claim(tmp_path):
+    """A granted claimer whose compile raises must RELEASE the fleet
+    claim — the next claim is granted immediately instead of every
+    peer stalling until claim_ttl_s."""
+    with ArtifactServer(tmp_path / "srv", host="127.0.0.1",
+                        claim_ttl_s=300.0) as srv:
+        c = _fleet_client(tmp_path, 0, srv.address)
+        k = cache_key({"prog": "fails"})
+
+        def boom():
+            raise RuntimeError("XLA OOM")
+
+        with pytest.raises(RuntimeError, match="XLA OOM"):
+            c.get_or_compile(k, boom, serialize_fn=lambda r: r,
+                             deserialize_fn=lambda p, m: p)
+        # the claim is free NOW (claim_ttl_s is 300 s — a TTL-expiry
+        # pass would not be)
+        assert ArtifactClient(srv.address).claim(k) == "granted"
+
+
+def test_busy_waiter_reclaims_after_owner_failure(tmp_path):
+    """A waiter polling a busy key re-claims each round: when the
+    owner's compile fails (release) the first waiter becomes the
+    fleet's compiler well inside its wait budget."""
+    with ArtifactServer(tmp_path / "srv", host="127.0.0.1",
+                        claim_ttl_s=300.0) as srv:
+        k = cache_key({"prog": "owner-dies"})
+        started = threading.Event()
+        owner_done = threading.Event()
+
+        def owner():
+            c = _fleet_client(tmp_path, 0, srv.address)
+
+            def slow_boom():
+                started.set()
+                import time
+
+                time.sleep(0.2)
+                raise RuntimeError("owner died mid-compile")
+
+            try:
+                c.get_or_compile(k, slow_boom, serialize_fn=lambda r: r,
+                                 deserialize_fn=lambda p, m: p)
+            except RuntimeError:
+                pass
+            owner_done.set()
+
+        t = threading.Thread(target=owner)
+        t.start()
+        started.wait(timeout=5)
+        waiter = _fleet_client(tmp_path, 1, srv.address, wait_s=10.0)
+        result, outcome = waiter.get_or_compile(
+            k, lambda: b"WAITER-EXE", serialize_fn=lambda r: r,
+            deserialize_fn=lambda p, m: bytes(p))
+        t.join(timeout=5)
+    assert (result, outcome) == (b"WAITER-EXE", "compile")
+    assert owner_done.is_set()
+
+
+def test_store_put_ignores_lying_integrity_meta(tmp_path):
+    """Second-review pin: a publisher's meta carrying a wrong sha256 /
+    size must NOT poison the key slot — integrity fields are computed
+    from the stored payload, never caller-supplied."""
+    st = ArtifactStore(tmp_path, device_kind="cpu", jax_version="1")
+    k = cache_key({"prog": "liar"})
+    st.put(k, b"real-payload", {"sha256": "f" * 64, "size": 999,
+                                "label": "kept"})
+    payload, meta = st.get(k)  # a lying sha256 would raise CacheCorrupt
+    assert payload == b"real-payload"
+    assert meta["size"] == len(b"real-payload")
+    assert meta["label"] == "kept"  # non-integrity meta survives
+
+
+def test_store_inflight_publish_reads_as_miss_not_corrupt(tmp_path):
+    """Third-review pin: put() renames the bin in first and the meta
+    (commit marker) LAST — a reader landing between the two must see a
+    plain miss, not quarantine the healthy publish mid-commit (the
+    claim-wait loop polls get() during exactly that window)."""
+    import hashlib
+
+    st = ArtifactStore(tmp_path, device_kind="cpu", jax_version="1")
+    k = cache_key({"prog": "inflight"})
+    sha = hashlib.sha256(b"payload-no-meta-yet").hexdigest()
+    (tmp_path / f"{k}.{sha[:16]}.bin").write_bytes(b"payload-no-meta-yet")
+    assert st.get(k) is None                       # miss, not CacheCorrupt
+    assert not (tmp_path / "corrupt").exists()     # nothing destroyed
+    st.put(k, b"payload-no-meta-yet", {"label": "x"})  # the commit lands
+    payload, meta = st.get(k)
+    assert payload == b"payload-no-meta-yet" and meta["label"] == "x"
+
+
+def test_claim_on_corrupt_entry_is_granted_not_miss(tmp_path):
+    """Fourth-review pin: CLAIM on a key whose stored entry is corrupt
+    must quarantine and GRANT (the key is cold) — the old answer-as-GET
+    path sent CC_MISS, which claim() cannot interpret, so cold fleets
+    stampede-compiled exactly the key the claim protocol protects."""
+    with ArtifactServer(tmp_path / "srv", host="127.0.0.1") as srv:
+        c = ArtifactClient(srv.address)
+        k = cache_key({"prog": "corrupt-claim"})
+        c.put(k, b"good", {})
+        _bin_of(tmp_path / "srv", k).write_bytes(b"scribbled")
+        assert c.claim(k) == "granted"
+
+
+def test_racing_publishers_cannot_cross_poison(tmp_path):
+    """Fifth-review pin: two publishers racing one key with
+    byte-DIFFERENT payloads (jax serialization is not deterministic
+    across processes) write hash-named bins, so any meta/bin interleave
+    pairs a meta only with ITS OWN payload — never CacheCorrupt."""
+    import hashlib
+
+    st = ArtifactStore(tmp_path, device_kind="cpu", jax_version="1")
+    k = cache_key({"prog": "pub-race"})
+    st.put(k, b"payload-A", {})
+    # publisher B's bin lands AFTER A's full publish (the old layout
+    # overwrote <key>.bin here, poisoning A's committed meta)
+    sha_b = hashlib.sha256(b"payload-B").hexdigest()
+    (tmp_path / f"{k}.{sha_b[:16]}.bin").write_bytes(b"payload-B")
+    payload, _ = st.get(k)
+    assert payload == b"payload-A"  # A's meta still pairs A's payload
+    # ...and when B's meta rename lands last, B's pairing wins whole
+    meta_b = json.loads((tmp_path / f"{k}.meta.json").read_text())
+    meta_b.update(sha256=sha_b, size=len(b"payload-B"),
+                  bin=f"{k}.{sha_b[:16]}.bin")
+    (tmp_path / f"{k}.meta.json").write_text(json.dumps(meta_b))
+    payload, _ = st.get(k)
+    assert payload == b"payload-B"

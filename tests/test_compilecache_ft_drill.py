@@ -1,0 +1,61 @@
+"""ISSUE 13 acceptance drill: a gang-restarted rank's relaunch charges
+``compile_fetched`` (not ``compile``) in the goodput ledger, and the
+trajectory stays bit-identical with the cache enabled.
+
+Real processes end to end: a GangCoordinator supervises one rank whose
+first incarnation compiles, publishes its executable to a live
+ArtifactServer, and crashes; the relaunched incarnation (fresh local
+store, so only the FLEET can serve it) fetches instead of recompiling.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+from tpucfn.bootstrap import EnvContract
+from tpucfn.compilecache.service import ArtifactServer
+from tpucfn.ft import GangCoordinator, GangRestart, RestartBudget
+from tpucfn.launch import Launcher, LocalTransport
+from tpucfn.obs.goodput import host_goodput, read_goodput_dir
+
+WORKER = str(Path(__file__).with_name("compilecache_ft_worker.py"))
+
+
+def test_gang_restart_relaunch_fetches_instead_of_recompiling(tmp_path):
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("127.0.0.1:0\n")
+    contract = EnvContract(
+        workers_path=str(hostfile), workers_count=1, worker_chip_count=1,
+        coordinator="127.0.0.1:1234", host_id=0, storage=str(tmp_path),
+        generation=1)
+    with ArtifactServer(tmp_path / "server-store",
+                        host="127.0.0.1") as srv:
+        launcher = Launcher(
+            contract, LocalTransport(), ft_dir=str(tmp_path / "ft"),
+            compile_cache_addrs=[srv.address],
+            extra_env={"CC_DRILL_DIR": str(tmp_path),
+                       "JAX_PLATFORMS": "cpu"})
+        coord = GangCoordinator(
+            launcher, [sys.executable, WORKER],
+            policy=GangRestart(RestartBudget(1, backoff_s=0.0)),
+            ft_dir=tmp_path / "ft", poll_interval=0.05, term_grace_s=2.0)
+        rc = coord.run()
+    assert rc == 0
+
+    results = [json.loads(s) for s in
+               (tmp_path / "results-host0.jsonl").read_text().splitlines()]
+    assert len(results) == 2
+    first, second = results
+    assert first["outcome"] == "compile"
+    assert second["outcome"] == "fetch"
+    # bit-identical trajectory across compile vs fetched executable
+    assert first["value"] == second["value"]
+
+    by_host, _ = read_goodput_dir(tmp_path / "goodput")
+    rep = host_goodput(by_host[0])
+    assert rep["windows"] == 2
+    buckets = rep["buckets"]
+    # incarnation 1 compiled; incarnation 2 charged the fetch bucket
+    # and NOT a second real compile
+    assert buckets["compile"] > 0
+    assert buckets["compile_fetched"] > 0

@@ -785,3 +785,149 @@ def test_writer_terminates_a_newlineless_valid_final_record(tmp_path):
     st, recs, torn = replay_journal(jp)
     assert not torn and st.seq == 3 and len(recs) == 3
     assert st.adoptions == 1  # nothing glued, nothing lost
+
+
+# -- spawn-window hazard (ISSUE 13 satellite) --------------------------------
+
+def test_launching_record_replays_and_clears(tmp_path):
+    """`launching` marks hosts whose spawn was imminent; the pid-bearing
+    launch records (and host_exit) clear them."""
+    p = tmp_path / "j.jsonl"
+    with JournalWriter(p) as j:
+        j.append("run_start", argv=["x"], hosts=2, max_restarts=1)
+        j.append("launching", hosts=[0, 1], first=True)
+    st, _, _ = replay_journal(p)
+    assert st.launching == {0, 1}
+    with JournalWriter(p, start_seq=st.seq) as j:
+        j.append("gang_launched", first=True, pids={"0": 11, "1": 12})
+    st, _, _ = replay_journal(p)
+    assert st.launching == set()
+    with JournalWriter(p, start_seq=st.seq) as j:
+        j.append("launching", hosts=[1])
+        j.append("solo_launched", host=1, pid=13)
+    st, _, _ = replay_journal(p)
+    assert st.launching == set()
+
+
+def _spawn_window_journal(tmp_path, ft_dir):
+    """A predecessor that died INSIDE the spawn window: run_start +
+    launching recorded, no pid record for host 0."""
+    ft_dir.mkdir(parents=True, exist_ok=True)
+    with JournalWriter(journal_path(ft_dir)) as j:
+        j.append("run_start", argv=["w"], hosts=1, policy="gang",
+                 max_restarts=3)
+        j.append("launching", hosts=[0], first=True)
+
+
+def _write_heartbeat(ft_dir, host, pid):
+    with open(Path(ft_dir) / f"hb-host{host:03d}.jsonl", "a") as f:
+        f.write(json.dumps({"host_id": host, "pid": pid,
+                            "t": time.time(), "seq": 1, "step": 0}) + "\n")
+
+
+def test_adoption_waits_spawn_grace_for_unjournaled_rank(tmp_path):
+    """The hazard closed: a rank spawned-but-never-journaled is adopted
+    through its first heartbeat instead of being relaunched over."""
+    import threading
+
+    ft_dir = tmp_path / "ft"
+    _spawn_window_journal(tmp_path, ft_dir)
+    orphan = subprocess.Popen([sys.executable, "-c",
+                               "import time; time.sleep(30)"])
+    try:
+        t = threading.Timer(
+            0.5, lambda: _write_heartbeat(ft_dir, 0, orphan.pid))
+        t.start()
+        coord = GangCoordinator(
+            _launcher(tmp_path, n=1), [sys.executable, "-c", "pass"],
+            policy=GangRestart(RestartBudget(1)), ft_dir=ft_dir,
+            poll_interval=0.01, adopt_spawn_grace_s=5.0)
+        assert coord._startup_adopt() is True
+        assert coord._adopted
+        # the spawned-but-unjournaled rank was found, not condemned
+        assert coord._procs[0].pid == orphan.pid
+        assert coord._adopt_failures == []
+    finally:
+        orphan.kill()
+        orphan.wait()
+
+
+def test_adoption_condemns_silent_spawn_window_after_grace(tmp_path):
+    """No heartbeat ever arrives: after the bounded grace, the host is
+    raised as exactly one CRASH through the normal detect path (it may
+    simply never have spawned)."""
+    ft_dir = tmp_path / "ft"
+    _spawn_window_journal(tmp_path, ft_dir)
+    t0 = time.monotonic()
+    coord = GangCoordinator(
+        _launcher(tmp_path, n=1), [sys.executable, "-c", "pass"],
+        policy=GangRestart(RestartBudget(1)), ft_dir=ft_dir,
+        poll_interval=0.01, adopt_spawn_grace_s=0.4)
+    assert coord._startup_adopt() is True
+    waited = time.monotonic() - t0
+    assert waited >= 0.4  # the grace was actually applied
+    assert [f.host_id for f in coord._adopt_failures] == [0]
+
+
+def test_adoption_event_carries_journal_replay_ms(tmp_path):
+    """ISSUE 13 satellite: the adopter measures its replay time and
+    attributes it through the adoption event (and, for a completed
+    pending intent, the recovered/goodput_incident rows)."""
+    ft_dir = tmp_path / "ft"
+    ft_dir.mkdir(parents=True)
+    live = subprocess.Popen([sys.executable, "-c",
+                             "import time; time.sleep(30)"])
+    try:
+        with JournalWriter(journal_path(ft_dir)) as j:
+            j.append("run_start", argv=["w"], hosts=1, policy="gang",
+                     max_restarts=3)
+            j.append("gang_launched", first=True,
+                     pids={"0": live.pid})
+        coord = GangCoordinator(
+            _launcher(tmp_path, n=1), [sys.executable, "-c", "pass"],
+            policy=GangRestart(RestartBudget(1)), ft_dir=ft_dir,
+            poll_interval=0.01)
+        assert coord._startup_adopt() is True
+        assert coord._journal_replay_ms is not None
+        adopted = next(e for e in _events(ft_dir)
+                       if e["kind"] == "coordinator_adopted")
+        assert adopted["journal_replay_ms"] == coord._journal_replay_ms
+    finally:
+        live.kill()
+        live.wait()
+
+
+def test_adoption_spawn_grace_applies_to_relaunch_window(tmp_path):
+    """Third-review pin: a RELAUNCH spawn window (crashed rank, intent
+    drawn, `launching` journaled, killed before the pid record) leaves
+    st.procs and the heartbeat file carrying the DEAD predecessor's
+    pid — the grace must wait for a beat naming a DIFFERENT pid and
+    adopt the spawned rank, not condemn it against the stale pid."""
+    import threading
+
+    ft_dir = tmp_path / "ft"
+    ft_dir.mkdir(parents=True)
+    stale = subprocess.Popen([sys.executable, "-c", "pass"])
+    stale.wait()  # a real, dead pid — the crashed incarnation
+    with JournalWriter(journal_path(ft_dir)) as j:
+        j.append("run_start", argv=["w"], hosts=1, policy="gang",
+                 max_restarts=3)
+        j.append("gang_launched", first=True, pids={"0": stale.pid})
+        j.append("launching", hosts=[0])  # the relaunch, mid-spawn
+    _write_heartbeat(ft_dir, 0, stale.pid)  # old incarnation's last beat
+    orphan = subprocess.Popen([sys.executable, "-c",
+                               "import time; time.sleep(30)"])
+    try:
+        t = threading.Timer(
+            0.5, lambda: _write_heartbeat(ft_dir, 0, orphan.pid))
+        t.start()
+        coord = GangCoordinator(
+            _launcher(tmp_path, n=1), [sys.executable, "-c", "pass"],
+            policy=GangRestart(RestartBudget(1)), ft_dir=ft_dir,
+            poll_interval=0.01, adopt_spawn_grace_s=5.0)
+        assert coord._startup_adopt() is True
+        assert coord._procs[0].pid == orphan.pid
+        assert coord._adopt_failures == []
+    finally:
+        orphan.kill()
+        orphan.wait()

@@ -147,7 +147,8 @@ def test_incidents_merge_from_ft_events(interrupted_ledger, tmp_path):
     assert inc == {"incident": 1, "action": "gang", "ts": 1.5,
                    "downtime_s": 0.5, "detection_s": 0.05,
                    "fleet_step": 5, "lost_steps": 1,
-                   "planned": False, "shrink": None, "ckpt": None}
+                   "planned": False, "shrink": None, "ckpt": None,
+                   "journal_replay_ms": None}
     assert rep["incident_downtime_s"] == pytest.approx(0.5)
     # older event files without the enriched record fall back to mttr_s
     rep2 = merge_goodput(by_host, events[:2])
@@ -489,3 +490,52 @@ def test_compile_cache_probe_decides_the_bucket(tmp_path):
     assert run_first_step(probe5, tmp_path / "unk") == ["compile"]
     # no probe at all keeps the historical charge
     assert run_first_step(None, tmp_path / "noprobe") == ["compile"]
+
+
+# -- fleet warm start (ISSUE 13) ---------------------------------------------
+
+def test_compile_fetched_bucket_merges_and_sums_to_wall():
+    """The fetch-hit first step gets its own column; the sums-to-wall
+    invariant holds with it."""
+    recs = [
+        {"kind": "window", "host": 0, "t": 100.0},
+        {"kind": "phase", "bucket": "compile_fetched", "dur_s": 2.0,
+         "step": 1, "t": 103.0, "host": 0},
+        {"kind": "phase", "bucket": "step", "dur_s": 0.5, "step": 2,
+         "t": 104.0, "host": 0},
+        {"kind": "close", "host": 0, "t": 104.0},
+    ]
+    rep = host_goodput(recs)
+    assert rep["buckets"]["compile_fetched"] == 2.0
+    assert rep["buckets"]["compile"] == 0.0
+    assert abs(rep["unaccounted_s"]) < 1e-9
+    # a fetched first step still advances the re-run horizon
+    assert rep["productive_steps"] == 1
+
+
+def test_incident_rows_carry_journal_replay_ms():
+    """ISSUE 13 satellite: the adopted coordinator's replay time rides
+    the goodput_incident row into the merged report and its total."""
+    by_host = {0: [
+        {"kind": "window", "host": 0, "t": 10.0},
+        {"kind": "phase", "bucket": "step", "dur_s": 1.0, "step": 1,
+         "t": 12.0, "host": 0},
+        {"kind": "close", "host": 0, "t": 12.0},
+    ]}
+    events = [
+        {"kind": "goodput_incident", "incident": 1, "ts": 11.0,
+         "action": "gang_restart", "downtime_s": 3.0,
+         "detection_s": 0.05, "fleet_step": 1,
+         "journal_replay_ms": 12.5},
+    ]
+    rep = merge_goodput(by_host, events)
+    assert rep["incidents"][0]["journal_replay_ms"] == 12.5
+    assert rep["journal_replay_ms"] == 12.5
+
+
+def test_incident_without_replay_detail_stays_none():
+    rep = merge_goodput({}, [
+        {"kind": "goodput_incident", "incident": 2, "ts": 1.0,
+         "action": "solo_restart", "downtime_s": 1.0}])
+    assert rep["incidents"][0]["journal_replay_ms"] is None
+    assert rep["journal_replay_ms"] == 0.0

@@ -353,3 +353,31 @@ def test_window_events_filters_on_ts_adj():
     out = window_events(events, 10.0, 20.0)
     assert [e["name"] for e in out] == ["b", "c", "d"]
     assert window_events([], 0.0, 1.0) == []
+
+
+# -- control-plane timeline (ISSUE 13) ---------------------------------------
+
+def test_control_timeline_selects_and_orders_control_spans():
+    from tpucfn.obs.aggregate import CONTROL_SPAN_NAMES, control_timeline
+
+    assert "compile_fetch" in CONTROL_SPAN_NAMES
+    events = [
+        {"kind": "span", "name": "step", "ts": 1.0, "dur_s": 0.1,
+         "host": 0, "attrs": {}},
+        {"kind": "span", "name": "compile_fetch", "ts": 3.0, "dur_s": 0.4,
+         "host": 1, "role": "trainer",
+         "attrs": {"key": "ab12", "addr": "h0:7741", "bytes": 123}},
+        {"kind": "span", "name": "ft_recover", "ts": 2.0, "dur_s": 1.5,
+         "host": None, "role": "", "trace_id": 1,
+         "attrs": {"action": "gang_restart", "hosts": [1]}},
+        {"kind": "event", "name": "compile_fetch", "ts": 9.0,
+         "attrs": {}},  # not a span: excluded
+    ]
+    rows = control_timeline(events)
+    assert [r["span"] for r in rows] == ["ft_recover", "compile_fetch"]
+    assert "compile_fetch" in rows[1]["span"]
+    assert "h0:7741" in rows[1]["detail"]
+    # skew-corrected timestamps win when present
+    rows2 = control_timeline([{**events[1], "ts_adj": 0.5},
+                              {**events[2]}])
+    assert [r["span"] for r in rows2] == ["compile_fetch", "ft_recover"]

@@ -18,6 +18,7 @@ from tpucfn.analysis.rules import (
     locks,
     metrics_hygiene,
     signal_safety,
+    spans,
     totality,
     vocab,
 )
@@ -82,6 +83,13 @@ ALL_RULES: dict[str, Rule] = {r.id: r for r in (
          "the HB_GLOB lesson (PR 5): scattered literals drift; one typo "
          "and a consumer silently never matches",
          vocab.check),
+    Rule("span-balance",
+         "every emitted trace-span family is balanced (start AND "
+         "end/duration observed) and consumed by some reader",
+         "ISSUE 13 adds the compile_fetch span — exactly the change "
+         "that could ship a zero-duration or write-only span family "
+         "(the trace-plane analogue of the lost-Summary rule)",
+         spans.check),
 )}
 
 

@@ -1,0 +1,170 @@
+"""span-balance: every emitted trace-span family is balanced and read.
+
+The trace plane's analogue of the lost-Summary rule (ROADMAP
+correctness follow-on, landed with ISSUE 13 — which adds the
+``compile_fetch`` span and is exactly the kind of change that could
+ship a write-only span).  Two rots, both silent at runtime:
+
+* **unbalanced span** — a ``tracer.record(name, start=...)`` call that
+  passes neither ``end=`` nor ``dur_s=`` writes a zero-duration span:
+  the start was observed, the end never was, and every downstream
+  percentile over that family reads 0.  (``queue_wait``'s retroactive
+  record is the sanctioned *pattern* — start observed on another
+  thread — and it is balanced: it passes ``end=``.  Point events go
+  through ``.event()`` / ``kind="event"`` and are exempt: zero
+  duration is their contract.)
+* **write-only span** — a literal span name emitted somewhere but
+  consumed by no reader in the package (``obs.aggregate``'s views, the
+  postmortem, anything matching on the record's ``name``): the span
+  costs a JSONL line per occurrence and tells nobody anything.
+
+Emitters are ``X.record("lit", ..., start=...)`` and ``X.span("lit",
+...)`` call sites (the ``start=`` keyword is what distinguishes a
+trace-span record from the flight ring's same-named method).
+Consumers are string literals compared (``==``/``in``/...) against a
+``name`` field lookup — ``e.get("name")``, ``e["name"]``, a variable
+bound from one — including comparisons against a module-level string
+tuple (``CONTROL_SPAN_NAMES``), whose elements then all count as
+consumed.  A package emitting no literal spans gets no findings.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpucfn.analysis.core import Analysis, Finding
+from tpucfn.analysis.rules.vocab import (
+    _compared_literals,
+    _is_field_lookup,
+    _lookup_bound_names,
+    _scope_walk,
+)
+
+RULE_ID = "span-balance"
+
+
+def _literal_str(node: ast.expr) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _kw(call: ast.Call, name: str) -> ast.expr | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _span_emissions(analysis: Analysis):
+    """``(mod, call, name, balanced, is_event)`` for every literal-named
+    trace-span emission in the package."""
+    for mod in analysis.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute) \
+                    or not node.args:
+                continue
+            name = _literal_str(node.args[0])
+            if name is None:
+                continue
+            if node.func.attr == "record":
+                if _kw(node, "start") is None:
+                    continue  # flight-ring / SLO record, not a trace span
+                kind = _kw(node, "kind")
+                is_event = (_literal_str(kind) == "event"
+                            if kind is not None else False)
+                balanced = (_kw(node, "end") is not None
+                            or _kw(node, "dur_s") is not None)
+                yield mod, node, name, balanced, is_event
+            elif node.func.attr == "span":
+                # context-managed spans time their own end
+                yield mod, node, name, True, False
+
+
+def _module_str_tuples(analysis: Analysis) -> dict[str, list[str]]:
+    """Module-level ``NAME = ("a", "b", ...)`` string tuples,
+    package-wide — comparison sides naming one consume its elements."""
+    out: dict[str, list[str]] = {}
+    for mod in analysis.modules:
+        for stmt in mod.tree.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            if not isinstance(stmt.value, (ast.Tuple, ast.List)):
+                continue
+            vals = []
+            ok = True
+            for e in stmt.value.elts:
+                s = _literal_str(e)
+                if s is None:
+                    ok = False
+                    break
+                vals.append(s)
+            if not ok or not vals:
+                continue
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = vals
+    return out
+
+
+def _consumed_names(analysis: Analysis) -> set[str]:
+    """Every span name some reader in the package matches on."""
+    tuples = _module_str_tuples(analysis)
+    consumed: set[str] = set()
+    for mod in analysis.modules:
+        scopes = [mod.tree.body]
+        for _qual, info in analysis.functions(mod).items():
+            if not isinstance(info.node, ast.Lambda):
+                scopes.append(info.node.body)
+        for body in scopes:
+            name_vars = _lookup_bound_names(body, "name")
+
+            def is_name(e: ast.expr) -> bool:
+                if _is_field_lookup(e, "name"):
+                    return True
+                return isinstance(e, ast.Name) and e.id in name_vars
+
+            for node in _scope_walk(body):
+                if not isinstance(node, ast.Compare):
+                    continue
+                sides = [node.left, *node.comparators]
+                if not any(is_name(s) for s in sides):
+                    continue
+                consumed.update(_compared_literals(node, is_name))
+                for s in sides:
+                    if isinstance(s, ast.Name) and s.id in tuples:
+                        consumed.update(tuples[s.id])
+    return consumed
+
+
+def check(analysis: Analysis):
+    findings: list[Finding] = []
+    emissions = list(_span_emissions(analysis))
+    if not emissions:
+        return findings
+    consumed = _consumed_names(analysis)
+    flagged_unconsumed: set[str] = set()
+    for mod, call, name, balanced, is_event in emissions:
+        if not is_event and not balanced:
+            findings.append(Finding(
+                RULE_ID, mod.rel, call.lineno,
+                f"span {name!r} records a start but neither end= nor "
+                "dur_s= — the end path was never observed, so every "
+                "duration percentile over this family reads 0 (pass the "
+                "measured end/duration, or make it an explicit "
+                "kind=\"event\" point marker)",
+                key=f"unbalanced:{name}"))
+        if is_event:
+            continue  # point events are an open vocabulary by contract
+        if name not in consumed and name not in flagged_unconsumed:
+            flagged_unconsumed.add(name)
+            findings.append(Finding(
+                RULE_ID, mod.rel, call.lineno,
+                f"span {name!r} is emitted here but no reader in the "
+                "package ever matches on it — a write-only span costs a "
+                "JSONL line per occurrence and tells nobody anything "
+                "(consume it in an obs.aggregate view, or stop emitting "
+                "it)",
+                key=f"unconsumed:{name}"))
+    return findings

@@ -174,14 +174,9 @@ def cmd_launch(args) -> int:
         import shlex
 
         input_argv = shlex.split(args.input_cmd)
-    launcher = Launcher(contract, transport,
-                        obs_base_port=args.obs_port or None,
-                        ft_dir=str(ft_dir) if ft_dir else None,
-                        ft_heartbeat_s=(args.ft_heartbeat_interval
-                                        if args.ft else None),
-                        input_hosts=args.input_hosts,
-                        input_port=args.input_port or None,
-                        input_argv=input_argv)
+    # All usage validation happens BEFORE any server binds: an error
+    # early-return below must not leak a bound artifact-server port
+    # (its close() lives in the later try/finally).
     argv = list(args.cmd)
     if argv and argv[0] == "--":
         argv = argv[1:]
@@ -189,8 +184,6 @@ def cmd_launch(args) -> int:
         print("error: no command given (use: tpucfn launch --name X -- cmd…)",
               file=sys.stderr)
         return 2
-    from tpucfn.launch import run_with_restarts
-
     inject = None
     if args.kill_host_after:
         host_s, _, secs = args.kill_host_after.partition(":")
@@ -204,58 +197,116 @@ def cmd_launch(args) -> int:
             print(f"error: --kill-host-after host {inject[0]} out of range "
                   f"(cluster has {len(contract.hosts())} hosts)", file=sys.stderr)
             return 2
-    obs_srv, registry = None, None
+    # Fleet warm start (ISSUE 13): the coordinator process runs the
+    # jax-free artifact server and fans its address out to every host
+    # (TPUCFN_COMPILE_CACHE_ADDRS) — host 0 compiles once, peers fetch;
+    # every ft relaunch re-derives the same env, so restart MTTR stops
+    # repaying the compile.  Without the flag, nothing changes (pinned).
+    cc_server = None
+    cc_addrs = None
+    registry = None
+    if args.obs_port or args.compile_cache:
+        # One supervisor registry for everything this process hosts —
+        # created before the artifact server so its compilecache_*
+        # counters land on the same /metrics the obs endpoint serves.
+        from tpucfn.obs import MetricRegistry
+
+        registry = MetricRegistry(labels={"role": "supervisor"})
+    if args.compile_cache:
+        from tpucfn.compilecache.service import (ArtifactServer,
+                                                 DEFAULT_COMPILE_CACHE_PORT)
+
+        cc_dir = args.compile_cache_dir or str(
+            _run_dir(args, args.name) / "compilecache")
+        cc_server = ArtifactServer(
+            cc_dir, host="0.0.0.0",
+            port=args.compile_cache_port or DEFAULT_COMPILE_CACHE_PORT,
+            registry=registry)
+        cc_server.start()
+        # The server runs in THIS process: the advertised host must be
+        # an address of THIS machine as the fleet sees it.  The
+        # coordinator-host default matches the documented deployment
+        # (run `tpucfn launch` on host 0); anywhere else, say so.
+        advertise = (args.compile_cache_advertise
+                     or ("127.0.0.1" if args.transport == "local"
+                         else contract.coordinator.rsplit(":", 1)[0]))
+        cc_addrs = [f"{advertise}:{cc_server.port}"]
+        print(f"compile-artifact server: {cc_addrs[0]} (store {cc_dir})",
+              file=sys.stderr)
+    launcher = Launcher(contract, transport,
+                        obs_base_port=args.obs_port or None,
+                        ft_dir=str(ft_dir) if ft_dir else None,
+                        ft_heartbeat_s=(args.ft_heartbeat_interval
+                                        if args.ft else None),
+                        input_hosts=args.input_hosts,
+                        input_port=args.input_port or None,
+                        input_argv=input_argv,
+                        compile_cache_addrs=cc_addrs)
+    from tpucfn.launch import run_with_restarts
+
+    obs_srv = None
     monitor = None
     # The launched gang is hosts()[:workers_count] (Launcher.launch's
     # precedence rule) — what the monitor judges and whose ports serve.
     n_launched = len(contract.hosts()[:contract.workers_count])
-    if args.ft:
-        # The fault-tolerance plane (ISSUE 4): heartbeat monitor over the
-        # dir every rank writes into (Launcher fans out TPUCFN_FT_DIR).
-        import random
+    try:
+        # Anything that can raise between the artifact server binding
+        # and the main try/finally (monitor dirs, the obs port — an
+        # EADDRINUSE here is routine) must not leak the bound server
+        # and its accept thread.
+        if args.ft:
+            # The fault-tolerance plane (ISSUE 4): heartbeat monitor
+            # over the dir every rank writes into (Launcher fans out
+            # TPUCFN_FT_DIR).
+            import random
 
-        from tpucfn.ft import (GangCoordinator, HeartbeatMonitor,
-                               MonitorConfig, RestartBudget,
-                               policy_from_name)
+            from tpucfn.ft import (GangCoordinator, HeartbeatMonitor,
+                                   MonitorConfig, RestartBudget,
+                                   policy_from_name)
 
-        # Startup grace must cover runtime boot (jax import + data
-        # staging + first compile can be tens of seconds), not just a
-        # few heartbeat intervals — a booting gang that has not beaten
-        # yet is not hung, and phantom hang incidents burn the restart
-        # budget.  Crash detection (process exit) is unaffected by it.
-        monitor = HeartbeatMonitor(
-            ft_dir, expected_hosts=n_launched,
-            config=MonitorConfig(
-                interval_s=args.ft_heartbeat_interval,
-                startup_grace_s=args.ft_startup_grace))
-    # /healthz late-binds to the coordinator once it exists so the
-    # probe carries journal/adoption state (ISSUE 12) on top of the
-    # monitor's fleet view; before that (and without --ft) it falls
-    # back to the monitor or plain liveness.
-    coord_ref: dict = {}
+            # Startup grace must cover runtime boot (jax import + data
+            # staging + first compile can be tens of seconds), not just
+            # a few heartbeat intervals — a booting gang that has not
+            # beaten yet is not hung, and phantom hang incidents burn
+            # the restart budget.  Crash detection (process exit) is
+            # unaffected by it.
+            monitor = HeartbeatMonitor(
+                ft_dir, expected_hosts=n_launched,
+                config=MonitorConfig(
+                    interval_s=args.ft_heartbeat_interval,
+                    startup_grace_s=args.ft_startup_grace))
+        # /healthz late-binds to the coordinator once it exists so the
+        # probe carries journal/adoption state (ISSUE 12) on top of the
+        # monitor's fleet view; before that (and without --ft) it falls
+        # back to the monitor or plain liveness.
+        coord_ref: dict = {}
 
-    def _health_fn():
-        c = coord_ref.get("coord")
-        if c is not None:
-            return c.health()
-        if monitor is not None:
-            return monitor.health()
-        return True, {}
+        def _health_fn():
+            c = coord_ref.get("coord")
+            if c is not None:
+                return c.health()
+            if monitor is not None:
+                return monitor.health()
+            return True, {}
 
-    if args.obs_port:
-        # The supervisor is a fleet role too: it owns the base port, the
-        # per-host ranks get base+1+host_id (launcher.host_env).  With
-        # --ft its /healthz answers from the heartbeat monitor's fleet
-        # view — 503 the moment any host goes DEAD.
-        from tpucfn.obs import MetricRegistry, start_obs_server
+        if args.obs_port:
+            # The supervisor is a fleet role too: it owns the base
+            # port, the per-host ranks get base+1+host_id
+            # (launcher.host_env).  With --ft its /healthz answers from
+            # the heartbeat monitor's fleet view — 503 the moment any
+            # host goes DEAD.
+            from tpucfn.obs import start_obs_server
 
-        registry = MetricRegistry(labels={"role": "supervisor"})
-        obs_srv = start_obs_server(
-            registry, port=args.obs_port, role="supervisor",
-            health_fn=_health_fn if args.ft else None)
-        print(f"supervisor obs endpoint: {obs_srv.url()} "
-              f"(hosts at ports {args.obs_port + 1}..."
-              f"{args.obs_port + n_launched})", file=sys.stderr)
+            obs_srv = start_obs_server(
+                registry, port=args.obs_port, role="supervisor",
+                health_fn=_health_fn if args.ft else None)
+            print(f"supervisor obs endpoint: {obs_srv.url()} "
+                  f"(hosts at ports {args.obs_port + 1}..."
+                  f"{args.obs_port + n_launched})", file=sys.stderr)
+    except BaseException:
+        if cc_server is not None:
+            cc_server.close()
+        raise
     try:
         if args.ft:
             from tpucfn.ft import StragglerGuard
@@ -313,6 +364,8 @@ def cmd_launch(args) -> int:
     finally:
         if obs_srv is not None:
             obs_srv.close()
+        if cc_server is not None:
+            cc_server.close()
     print(f"launch finished rc={rc}")
     return rc
 
@@ -483,6 +536,86 @@ def cmd_data_serve(args) -> int:
     return 0
 
 
+def cmd_compilecache_serve(args) -> int:
+    """Run the fleet compiled-artifact server standalone (ISSUE 13):
+    the input-role-host / host-0 deployment shape, jax-free — the
+    ``tpucfn launch --compile-cache`` coordinator-hosted form is the
+    other.  Serves GET/CLAIM/PUT over the PR 11 framing; SIGTERM (or
+    ``--serve-for``) ends it, printing a stats JSON line."""
+    import json as _json
+    import signal as _signal
+    import time as _time
+
+    from tpucfn.compilecache.service import (ArtifactServer,
+                                             DEFAULT_COMPILE_CACHE_PORT)
+    from tpucfn.compilecache.store import default_store_dir
+
+    from tpucfn.obs import MetricRegistry
+
+    host_id = int(os.environ.get("TPUCFN_HOST_ID", "0") or 0)
+    registry = MetricRegistry(labels={"role": "compilecache",
+                                      "host": str(host_id)})
+    server = ArtifactServer(
+        args.dir or default_store_dir(), host=args.host,
+        port=args.port if args.port is not None
+        else DEFAULT_COMPILE_CACHE_PORT,
+        device_kind=args.device_kind or None,
+        jax_version=args.jax_version or None,
+        registry=registry)
+    stop = [False]
+
+    def _on_term(signum, frame):
+        # ONE plain GIL-atomic store (the PR 8 signal lesson — an
+        # Event.set() takes a lock); the main loop does the close.
+        stop[0] = True
+
+    try:
+        _signal.signal(_signal.SIGTERM, _on_term)
+    except ValueError:
+        pass  # not the main thread (embedded use)
+    t0 = _time.monotonic()
+    try:
+        server.start()
+        print(f"compile-artifact server listening on {server.address} "
+              f"(store {server.store.dir})", file=sys.stderr)
+        deadline = (t0 + args.serve_for) if args.serve_for > 0 else None
+        while not stop[0]:
+            if deadline is not None and _time.monotonic() >= deadline:
+                break
+            _time.sleep(0.2)
+    finally:
+        server.close()
+    m = registry.varz()["metrics"]
+    print(_json.dumps({
+        "served_s": round(_time.monotonic() - t0, 3),
+        "entries": len(server.store.keys()),
+        "gets": m.get("compilecache_gets_total", 0),
+        "hits": m.get("compilecache_hits_total", 0),
+        "publishes": m.get("compilecache_publishes_total", 0),
+        "claims_granted": m.get("compilecache_claims_granted_total", 0),
+        "handshake_refusals": m.get(
+            "compilecache_handshake_refusals_total", 0),
+    }))
+    return 0
+
+
+def cmd_compilecache_stats(args) -> int:
+    """Query a running artifact server's stats (entries, live claims,
+    fleet identity) — the operator's is-the-warm-start-plane-working
+    probe."""
+    import json as _json
+
+    from tpucfn.compilecache.service import ArtifactClient
+    from tpucfn.data.service import ServiceError
+
+    try:
+        print(_json.dumps(ArtifactClient(args.addr).stats()))
+    except ServiceError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def cmd_serve(args) -> int:
     """Continuous-batching inference over a workload of token-id
     prompts (``--prompts`` JSONL with {"tokens": [...]} rows, or
@@ -501,6 +634,22 @@ def cmd_serve(args) -> int:
 
     from tpucfn.serve import AdmissionError, Server
     from tpucfn.serve.engine import ServeEngine, demo_llama_engine
+
+    # Host identity: under `tpucfn launch` every rank carries
+    # TPUCFN_HOST_ID — without it a serve gang's trace files collide on
+    # one name and the hosts' /metrics label sets are indistinguishable.
+    host_id = int(os.environ.get("TPUCFN_HOST_ID", "0") or 0)
+    from tpucfn.obs import MetricRegistry as _MetricRegistry
+
+    registry = _MetricRegistry(labels={"role": "server",
+                                       "host": str(host_id)})
+    # Fleet warm start (ISSUE 13): installed BEFORE the first engine is
+    # built, so every replica's prefill/decode programs — including a
+    # probation relaunch's — fetch serialized executables instead of
+    # recompiling.  Env unset ⇒ None, engines build their plain jits.
+    from tpucfn.compilecache import configure_from_env as _cc_configure
+
+    cc_client = _cc_configure(registry=registry)
 
     cfg, engine = demo_llama_engine(args.preset, seed=args.seed,
                                     max_batch=args.max_batch,
@@ -526,16 +675,9 @@ def cmd_serve(args) -> int:
               file=sys.stderr)
         return 2
 
-    from tpucfn.obs import (FlightRecorder, MetricRegistry, ProfileCapture,
-                            Tracer, register_device_gauges,
-                            start_obs_server)
+    from tpucfn.obs import (FlightRecorder, ProfileCapture, Tracer,
+                            register_device_gauges, start_obs_server)
 
-    # Host identity: under `tpucfn launch` every rank carries
-    # TPUCFN_HOST_ID — without it a serve gang's trace files collide on
-    # one name and the hosts' /metrics label sets are indistinguishable.
-    host_id = int(os.environ.get("TPUCFN_HOST_ID", "0") or 0)
-    registry = MetricRegistry(labels={"role": "server",
-                                      "host": str(host_id)})
     # The forensics plane for serve hosts (ISSUE 6): the ring feeds
     # /flightrecorder (where the gang coordinator captures survivors at
     # detect time) regardless of any on-disk dirs; the exit dump and
@@ -557,6 +699,10 @@ def cmd_serve(args) -> int:
         # is actually going to happen).
         tracer = Tracer(args.trace_dir, host_id=host_id, role="server",
                         truncate=True) if args.trace_dir else Tracer(None)
+        if cc_client is not None:
+            # late-bind: the compile_fetch spans of replicas built
+            # below land in this run's trace file
+            cc_client.tracer = tracer
         if args.trace_dir:
             profiler = ProfileCapture(artifacts_root / "profile",
                                       tracer=tracer)
@@ -725,6 +871,7 @@ def cmd_obs(args) -> int:
     from tpucfn.obs.aggregate import (
         JsonlTailer,
         apply_clock_skew,
+        control_timeline,
         estimate_clock_skew,
         host_straggler_report,
         merge_step_timeline,
@@ -833,6 +980,9 @@ def cmd_obs(args) -> int:
                 span_hosts, keys=("step_time", "data_wait_time"))
         rows, agg = request_breakdown(events)
         report["requests"], report["request_aggregate"] = rows, agg
+        # Control-plane spans on the same corrected clock (ISSUE 13):
+        # recoveries, profiler captures, compile-artifact fetches.
+        report["control"] = control_timeline(events)
         cache["report"] = report
         return report
 
@@ -859,6 +1009,12 @@ def cmd_obs(args) -> int:
         if report.get("trace_stragglers"):
             print("\n== per-host stragglers (trace spans) ==")
             print(render_table(report["trace_stragglers"], straggler_cols))
+        if report.get("control"):
+            print("\n== control events (recoveries / captures / "
+                  "artifact fetches) ==")
+            print(render_table(report["control"],
+                               ["ts", "host", "role", "span", "dur_s",
+                                "detail"], float_fmt="{:.3f}"))
         if report["requests"]:
             print("\n== request latency breakdown ==")
             print(render_table(
@@ -1456,6 +1612,25 @@ def build_parser() -> argparse.ArgumentParser:
         help="always launch fresh, even over an unfinished "
              "journal (the previous run's journal is rotated "
              "aside, its fleet is NOT stopped)")
+    l.add_argument("--compile-cache", action="store_true",
+                   help="fleet warm start: run the jax-free compiled-"
+                        "artifact server in this process and fan its "
+                        "address out (TPUCFN_COMPILE_CACHE_ADDRS) — one "
+                        "host compiles each program, the rest fetch the "
+                        "serialized executable; relaunches skip the "
+                        "compile entirely")
+    l.add_argument("--compile-cache-dir", metavar="DIR",
+                   help="artifact store directory (default: the "
+                        "cluster's state dir compilecache/)")
+    l.add_argument("--compile-cache-port", type=int, default=0,
+                   metavar="PORT",
+                   help="artifact server bind port (default 7741)")
+    l.add_argument("--compile-cache-advertise", metavar="HOST",
+                   help="address the fleet dials for the artifact server "
+                        "(default: 127.0.0.1 for --transport local, else "
+                        "the coordinator host — correct when tpucfn "
+                        "launch runs ON host 0; set this when launching "
+                        "from elsewhere, the server runs in THIS process)")
     l.add_argument("--supervise", action="store_true",
                    help="wrap the coordinator in a jax-free re-exec loop: "
                         "a crashed coordinator is relaunched and adopts "
@@ -1574,6 +1749,37 @@ def build_parser() -> argparse.ArgumentParser:
                      help="serve /metrics /healthz /varz (default: "
                           "TPUCFN_OBS_PORT from the launch fan-out)")
     dsv.set_defaults(fn=cmd_data_serve)
+
+    cc = sub.add_parser(
+        "compilecache",
+        help="fleet warm-start plane (compiled-artifact store/server)")
+    ccsub = cc.add_subparsers(dest="compilecache_command", required=True)
+    ccs = ccsub.add_parser(
+        "serve",
+        help="run the jax-free compiled-artifact server standalone "
+             "(host 0 / input-role host); `tpucfn launch "
+             "--compile-cache` is the coordinator-hosted form")
+    ccs.add_argument("--dir", metavar="DIR",
+                     help="artifact store directory (default "
+                          "$TPUCFN_COMPILE_CACHE_DIR or the XLA cache's "
+                          "_artifacts sibling)")
+    ccs.add_argument("--host", default="0.0.0.0")
+    ccs.add_argument("--port", type=int, default=None,
+                     help="bind port (default 7741)")
+    ccs.add_argument("--device-kind", default="",
+                     help="pin the fleet device identity (default: the "
+                          "first client's handshake pins it)")
+    ccs.add_argument("--jax-version", default="",
+                     help="pin the fleet jax/jaxlib identity")
+    ccs.add_argument("--serve-for", type=float, default=0.0,
+                     metavar="SECONDS",
+                     help="exit cleanly after this long (0 = until "
+                          "SIGTERM)")
+    ccs.set_defaults(fn=cmd_compilecache_serve)
+    cct = ccsub.add_parser(
+        "stats", help="query a running artifact server's stats")
+    cct.add_argument("--addr", required=True, metavar="HOST:PORT")
+    cct.set_defaults(fn=cmd_compilecache_stats)
 
     sv = sub.add_parser(
         "serve",

@@ -1,0 +1,279 @@
+"""Content-addressed local store of serialized XLA executables.
+
+jax-free on purpose: the artifact server, the launch CLI, and the
+analyzer import it without dragging the runtime in.  The jax half
+(fingerprinting a lowered program, serializing its executable) lives in
+:mod:`tpucfn.compilecache.jit`.
+
+Layout — two files per entry under the store dir::
+
+    <key>.meta.json        {"key", "sha256", "size", "bin",
+                            "device_kind", "jax_version", "label", ...}
+    <key>.<sha16>.bin      the serialized executable payload
+
+Both are written tmp-then-rename so a reader never sees a torn entry;
+the meta is written LAST, so a payload without meta is in-flight, not
+corrupt.  The bin carries its payload hash IN ITS NAME and the meta
+points at it: two publishers racing the same key with byte-different
+payloads (jax serialization is not guaranteed deterministic across
+processes) write DIFFERENT bin files, and whichever meta rename lands
+last points at its own — no interleave can pair one publisher's meta
+with the other's payload.  The loser's bin is an inert orphan.  :meth:`ArtifactStore.get` re-hashes the payload against the
+meta's sha256 on every read — a flipped bit or truncated payload raises
+:class:`CacheCorrupt` and the entry is quarantined (renamed into
+``corrupt/``), never silently served or silently recompiled into the
+same key slot (the PR 7 ckpt-quarantine lesson: a loud refusal beats a
+plausible wrong artifact).  An entry whose device_kind/jax version
+disagree with the caller raises :class:`CacheMismatch` — the key digest
+already covers both, so a mismatch under a matching key means the store
+is lying.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from pathlib import Path
+
+
+def default_store_dir() -> str:
+    """$TPUCFN_COMPILE_CACHE_DIR, else a sibling of the persistent XLA
+    cache — one resolution rule shared by the client, the CLI server,
+    and the bench."""
+    d = os.environ.get("TPUCFN_COMPILE_CACHE_DIR", "").strip()
+    if d:
+        return d
+    from tpucfn.utils.env import xla_cache_dir
+
+    return xla_cache_dir() + "_artifacts"
+
+
+class CacheCorrupt(RuntimeError):
+    """An entry exists but fails its integrity check (payload hash,
+    torn meta).  The reader quarantines it and treats the key as a
+    miss — loudly, via this exception, so callers can count it."""
+
+
+class CacheMismatch(RuntimeError):
+    """An entry's recorded device_kind/jax version disagree with the
+    running process — refusing beats deserializing an executable built
+    for different hardware or a different compiler."""
+
+
+def cache_key(components: dict) -> str:
+    """Stable content digest of a program's identity, computed BEFORE
+    compiling (that is what lets a hit skip the compile entirely).
+    ``components`` is a flat JSON-able dict — the jit glue feeds
+    (StableHLO hash, avals, in/out shardings, mesh, device_kind,
+    jax/jaxlib versions, relevant config flags); anything that changes
+    the compiled artifact must be in here or two different programs
+    alias one key."""
+    blob = json.dumps(components, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def _payload_sha(payload: bytes) -> str:
+    return hashlib.sha256(payload).hexdigest()
+
+
+_KEY_OK = set("0123456789abcdef")
+
+
+def valid_key(key: str) -> bool:
+    """Keys are sha256 hex digests; anything else is refused at every
+    boundary (store paths, server frames) — a key IS a filename, and
+    this is the path-traversal guard."""
+    return 16 <= len(key) <= 64 and all(c in _KEY_OK for c in key)
+
+
+class ArtifactStore:
+    """One directory of content-addressed executable artifacts."""
+
+    def __init__(self, d: str | Path, *, device_kind: str = "",
+                 jax_version: str = ""):
+        self.dir = Path(d)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.device_kind = device_kind
+        self.jax_version = jax_version
+
+    # -- paths -------------------------------------------------------------
+
+    def _meta_path(self, key: str) -> Path:
+        return self.dir / f"{key}.meta.json"
+
+    def _bin_path(self, key: str, sha: str) -> Path:
+        return self.dir / f"{key}.{sha[:16]}.bin"
+
+    def _bin_from_meta(self, key: str, meta: dict) -> Path | None:
+        name = meta.get("bin")
+        # the bin name is derived, never trusted: it must be this key's
+        # hash-named pattern (the meta file is the only writable input)
+        if isinstance(name, str) and name.startswith(f"{key}.") \
+                and name.endswith(".bin") and "/" not in name:
+            return self.dir / name
+        sha = meta.get("sha256")
+        if isinstance(sha, str) and sha:
+            return self._bin_path(key, sha)
+        return None
+
+    # -- read side ---------------------------------------------------------
+
+    def has(self, key: str) -> bool:
+        if not valid_key(key):
+            return False
+        meta = self.meta(key)
+        if meta is None:
+            return False
+        p = self._bin_from_meta(key, meta)
+        return p is not None and p.is_file()
+
+    def meta(self, key: str) -> dict | None:
+        if not valid_key(key):
+            return None
+        try:
+            m = json.loads(self._meta_path(key).read_text())
+        except (OSError, json.JSONDecodeError):
+            return None
+        return m if isinstance(m, dict) else None
+
+    def get(self, key: str) -> tuple[bytes, dict] | None:
+        """``(payload, meta)`` for one verified entry, or None on a
+        plain miss.  Integrity failure quarantines AND raises
+        :class:`CacheCorrupt`; an entry recorded for different hardware
+        or jax raises :class:`CacheMismatch` (without quarantine — it
+        is a valid artifact, just not ours)."""
+        if not valid_key(key):
+            return None
+        meta = self.meta(key)
+        if meta is None:
+            # Payload without (readable) meta is the documented
+            # IN-FLIGHT window of put() — bin renamed in, meta (the
+            # commit marker) not yet — and the claim-wait loop polls
+            # get() during exactly that window, so it must read as a
+            # plain miss.  Quarantining here would destroy a healthy
+            # concurrent publish mid-commit; a genuinely torn publish
+            # just leaves an inert bin a later complete put overwrites.
+            return None
+        bin_path = self._bin_from_meta(key, meta)
+        try:
+            if bin_path is None:
+                raise OSError("meta names no payload")
+            payload = bin_path.read_bytes()
+        except OSError:
+            self.quarantine(key)
+            raise CacheCorrupt(
+                f"artifact {key} meta present but payload unreadable "
+                f"in {self.dir} — quarantined")
+        if _payload_sha(payload) != meta.get("sha256"):
+            self.quarantine(key)
+            raise CacheCorrupt(
+                f"artifact {key} payload fails its recorded sha256 in "
+                f"{self.dir} — quarantined, treating as a miss")
+        if self.device_kind and meta.get("device_kind") \
+                and meta["device_kind"] != self.device_kind:
+            raise CacheMismatch(
+                f"artifact {key} was compiled for device_kind "
+                f"{meta['device_kind']!r}, this process runs "
+                f"{self.device_kind!r}")
+        if self.jax_version and meta.get("jax_version") \
+                and meta["jax_version"] != self.jax_version:
+            raise CacheMismatch(
+                f"artifact {key} was serialized under jax "
+                f"{meta['jax_version']}, this process runs "
+                f"{self.jax_version}")
+        return payload, meta
+
+    # -- write side --------------------------------------------------------
+
+    def put(self, key: str, payload: bytes, meta: dict | None = None) -> dict:
+        """Atomic publish: payload first, meta (the commit marker)
+        last, both via tmp-then-rename.  Re-publishing an existing key
+        is a no-op (content-addressed: same key, same content)."""
+        if not valid_key(key):
+            raise ValueError(f"invalid artifact key {key!r}")
+        sha = _payload_sha(payload)
+        full = {
+            "device_kind": self.device_kind,
+            "jax_version": self.jax_version,
+            "created_ts": time.time(),
+            **(meta or {}),
+        }
+        # Integrity fields are NEVER caller-supplied: a publisher's meta
+        # carrying a wrong sha256 (bug or lie) would otherwise poison
+        # this key slot into permanent CacheCorrupt quarantine on every
+        # subsequent read.  What we hash is what we store, and the bin
+        # name carries the hash so a racing publisher of DIFFERENT
+        # bytes writes a different file (our meta can only ever point
+        # at our payload).
+        bin_path = self._bin_path(key, sha)
+        full["key"] = key
+        full["sha256"] = sha
+        full["size"] = len(payload)
+        full["bin"] = bin_path.name
+        if self.has(key):
+            existing = self.meta(key)
+            if existing is not None:
+                return existing
+        pid = os.getpid()
+        tmp_bin = self.dir / f".{key}.bin.{pid}.tmp"
+        tmp_bin.write_bytes(payload)
+        tmp_bin.replace(bin_path)
+        tmp_meta = self.dir / f".{key}.meta.{pid}.tmp"
+        tmp_meta.write_text(json.dumps(full))
+        tmp_meta.replace(self._meta_path(key))
+        return full
+
+    def quarantine(self, key: str) -> None:
+        """Move a bad entry aside (``corrupt/``) so the key slot frees
+        for a fresh publish and the bad bytes stay for forensics —
+        the checkpoint quarantine pattern, applied to executables."""
+        qdir = self.dir / "corrupt"
+        qdir.mkdir(exist_ok=True)
+        stamp = f"{int(time.time() * 1000):x}"
+        meta = self.meta(key)
+        targets = [self._meta_path(key)]
+        if meta is not None:
+            p = self._bin_from_meta(key, meta)
+            if p is not None:
+                targets.insert(0, p)
+        for p in targets:
+            if p.exists():
+                try:
+                    p.replace(qdir / f"{p.name}.{stamp}")
+                except OSError:
+                    pass
+
+    def keys(self) -> list[str]:
+        return sorted(p.name[: -len(".meta.json")]
+                      for p in self.dir.glob("*.meta.json")
+                      if valid_key(p.name[: -len(".meta.json")]))
+
+    # -- local single-flight ----------------------------------------------
+
+    def claim(self, key: str, *, stale_s: float = 600.0) -> bool:
+        """Best-effort cross-process single-flight on one machine
+        (O_EXCL lockfile): True = this process owns the compile for
+        ``key`` and must :meth:`release` (or publish) when done.  A
+        claim older than ``stale_s`` is presumed orphaned by a dead
+        compiler and is broken — compiles are long, but not eternal."""
+        lock = self.dir / f"{key}.claim"
+        try:
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            try:
+                if time.time() - lock.stat().st_mtime > stale_s:
+                    lock.unlink(missing_ok=True)
+                    return self.claim(key, stale_s=stale_s)
+            except OSError:
+                pass
+            return False
+        except OSError:
+            return False
+        with os.fdopen(fd, "w") as f:
+            f.write(str(os.getpid()))
+        return True
+
+    def release(self, key: str) -> None:
+        (self.dir / f"{key}.claim").unlink(missing_ok=True)

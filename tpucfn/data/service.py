@@ -143,8 +143,13 @@ def decode_batch(payload: bytes | bytearray) -> dict[str, np.ndarray]:
     return out
 
 
-def send_frame(sock: socket.socket, kind: bytes, payload: bytes) -> None:
-    sock.sendall(_HEADER.pack(MAGIC, kind, len(payload)))
+def send_frame(sock: socket.socket, kind: bytes, payload: bytes, *,
+               magic: bytes = MAGIC) -> None:
+    """Length-prefixed framing.  ``magic`` distinguishes the planes that
+    share this idiom (input batches here; compiled-artifact frames in
+    :mod:`tpucfn.compilecache.service`) so a client dialed at the wrong
+    port fails the handshake loudly instead of mis-parsing payloads."""
+    sock.sendall(_HEADER.pack(magic, kind, len(payload)))
     if payload:
         sock.sendall(payload)
 
@@ -161,11 +166,12 @@ def _recv_exact(sock: socket.socket, n: int) -> bytearray:
     return buf
 
 
-def recv_frame(sock: socket.socket) -> tuple[bytes, bytearray]:
+def recv_frame(sock: socket.socket, *,
+               magic: bytes = MAGIC) -> tuple[bytes, bytearray]:
     head = _recv_exact(sock, _HEADER.size)
-    magic, kind, length = _HEADER.unpack(bytes(head))
-    if magic != MAGIC:
-        raise ServiceError(f"bad frame magic {magic!r}")
+    got_magic, kind, length = _HEADER.unpack(bytes(head))
+    if got_magic != magic:
+        raise ServiceError(f"bad frame magic {got_magic!r}")
     if length > MAX_FRAME_BYTES:
         raise ServiceError(f"frame length {length} exceeds sanity bound")
     return kind, (_recv_exact(sock, length) if length else bytearray())

@@ -123,6 +123,14 @@ from tpucfn.ft.preempt import (
 # (matches AdoptedProcess.poll's default rc_grace_s).
 ADOPT_RC_GRACE_S = 2.0
 
+# Spawn-window hazard (ISSUE 13 satellite, closing the PR 12 gap): a
+# coordinator killed between the pre-spawn ``launching`` journal record
+# and the pid-bearing launch record leaves ranks that may be alive with
+# NO journal trace.  Adoption waits this long for such a rank's first
+# heartbeat to name a pid before declaring it dead and relaunching over
+# it — milliseconds-wide on LocalTransport, seconds on SSH fan-outs.
+ADOPT_SPAWN_GRACE_S = 10.0
+
 
 class GangCoordinator(ChaosTarget):
     def __init__(
@@ -153,6 +161,7 @@ class GangCoordinator(ChaosTarget):
         restart_input_hosts: bool = False,
         max_input_restarts: int = 1,
         adopt: bool | str = "auto",
+        adopt_spawn_grace_s: float = ADOPT_SPAWN_GRACE_S,
     ):
         """Graceful-degradation knobs (ISSUE 7): ``drain_grace_s`` caps
         how long a preemption drain waits for clean exits when the
@@ -207,9 +216,11 @@ class GangCoordinator(ChaosTarget):
         # is "auto" (adopt iff an unfinished journal exists), True
         # (require it when a journal exists), or False (always fresh).
         self.adopt = adopt
+        self.adopt_spawn_grace_s = adopt_spawn_grace_s
         self._journal: JournalWriter | None = None
         self._adopted = False
         self._adopt_failures: list[Failure] = []
+        self._journal_replay_ms: float | None = None
 
         if registry is None:
             # Throwaway registry: identical flow, nothing exported —
@@ -534,6 +545,13 @@ class GangCoordinator(ChaosTarget):
 
     def _launch_gang(self, *, first: bool) -> None:
         inject = self.kill_host_after if first else None
+        # Pre-spawn write-ahead (ISSUE 13 satellite): pids exist only
+        # after launch() returns, so a coordinator killed mid-spawn
+        # would otherwise leave ranks NO journal record and an adoption
+        # would relaunch over them.  The `launching` record makes the
+        # window visible; adoption gives those hosts a heartbeat grace.
+        self._j("launching", hosts=list(self.host_ids), first=first)
+        crash_point("during_spawn", self.ft_dir)
         procs = self.launcher.launch(self.argv, kill_host_after=inject)
         self._procs = dict(zip(self.host_ids, procs))
         self._j("gang_launched", first=first,
@@ -556,6 +574,7 @@ class GangCoordinator(ChaosTarget):
     def _launch_solo(self, host_id: int) -> None:
         # Same host_env as the rank it replaces (host_id, obs port,
         # heartbeat file) — the gang must not notice the substitution.
+        self._j("launching", hosts=[host_id])
         self._procs[host_id] = self.launcher.launch_host(self.argv, host_id)
         self._j("solo_launched", host=host_id,
                 pid=self._procs[host_id].pid)
@@ -750,7 +769,13 @@ class GangCoordinator(ChaosTarget):
         jp = journal_path(self.ft_dir)
         if not jp.exists():
             return False
+        t0 = self.clock()
         st, _records, torn = replay_journal(jp)
+        # Replay time is real restart downtime (ISSUE 13 satellite):
+        # measured here, attributed through the recovered /
+        # goodput_incident detail so `tpucfn obs goodput` can name the
+        # crash-safety plane's own MTTR cost.
+        self._journal_replay_ms = round((self.clock() - t0) * 1e3, 3)
         if not st.started or st.done_rc is not None:
             return False
         self._adopt_fleet(st, torn)
@@ -804,6 +829,31 @@ class GangCoordinator(ChaosTarget):
         pending_failures: list[Failure] = []
         adopted_hosts: list[int] = []
         dead: list[tuple[int, list[int]]] = []
+        # Spawn-window hosts (ISSUE 13 satellite): a `launching` record
+        # with no pid record means the predecessor died mid-spawn — the
+        # rank may be alive with no journal trace.  Wait a heartbeat
+        # grace for its beat to name a pid before condemning it; an
+        # immediate relaunch here is exactly the double-spawn the
+        # hazard describes.  A RELAUNCH window is the same hazard with
+        # a twist: st.procs (and the heartbeat file) still carry the
+        # dead predecessor incarnation's pid, so the grace must wait
+        # for a beat naming a DIFFERENT pid — the spawned rank's first
+        # beat — not just any pid.
+        stale = {h: st.procs[h] for h in st.launching if h in st.procs}
+        spawning = {h for h in st.launching
+                    if h in self.host_ids and h not in self._finished}
+        if spawning:
+            deadline = self.clock() + self.adopt_spawn_grace_s
+            while spawning and self.clock() < deadline:
+                missing = []
+                for h in spawning:
+                    pid = (beats.get(h) or {}).get("pid")
+                    if not isinstance(pid, int) or pid == stale.get(h):
+                        missing.append(h)
+                if not missing:
+                    break
+                self.sleep(0.1)
+                beats = read_heartbeats(self.ft_dir)
         for host in self.host_ids:
             if host in self._finished:
                 if self.monitor is not None:
@@ -811,9 +861,17 @@ class GangCoordinator(ChaosTarget):
                 continue
             cands = []
             if host in st.procs:
-                cands.append(st.procs[host])
+                # A spawn-window host's st.procs pid IS the dead
+                # predecessor being replaced (`launching` postdates
+                # it): never a candidate — the OS may have recycled it
+                # onto an unrelated process we would adopt and later
+                # kill.  The grace loop above already distrusts it.
+                if host not in st.launching:
+                    cands.append(st.procs[host])
             hb_pid = (beats.get(host) or {}).get("pid")
-            if isinstance(hb_pid, int) and hb_pid not in cands:
+            if isinstance(hb_pid, int) and hb_pid not in cands \
+                    and not (host in st.launching
+                             and hb_pid == stale.get(host)):
                 cands.append(hb_pid)
             live = next((p for p in cands if pid_alive(p)), None)
             if live is not None:
@@ -864,14 +922,16 @@ class GangCoordinator(ChaosTarget):
         self.coord_adoptions_c.add()
         self._j("adopted", hosts=adopted_hosts,
                 dead=[f.host_id for f in pending_failures],
-                pending=None if st.pending is None else st.pending.incident)
+                pending=None if st.pending is None else st.pending.incident,
+                replay_ms=self._journal_replay_ms)
         self._event("coordinator_adopted", hosts=adopted_hosts,
                     dead=[f.host_id for f in pending_failures],
                     budget_used=self.policy.budget.used,
                     incident=self._incident,
                     pending_incident=(None if st.pending is None
                                       else st.pending.incident),
-                    torn=bool(torn))
+                    torn=bool(torn),
+                    journal_replay_ms=self._journal_replay_ms)
         if st.pending is None \
                 or st.pending.action != Action.DRAIN_RESTART.value:
             # No drain is in flight: drain/notice files (and a notice
@@ -929,7 +989,16 @@ class GangCoordinator(ChaosTarget):
         planned = p.planned or action == Action.DRAIN_RESTART.value
         (self.ft_planned_mttr_s if planned else self.ft_mttr_s).observe(mttr)
         self._event("recovered", incident=p.incident, action=action,
-                    planned=planned, mttr_s=round(mttr, 4), adopted=True)
+                    planned=planned, mttr_s=round(mttr, 4), adopted=True,
+                    journal_replay_ms=self._journal_replay_ms)
+        # Goodput attribution for the adoption-completed incident: the
+        # pre-crash coordinator died before it could write this row, and
+        # the replay share of the downtime is named (ISSUE 13 satellite).
+        self._event("goodput_incident", incident=p.incident, action=action,
+                    planned=planned, downtime_s=round(mttr, 4),
+                    detection_s=round(self.poll_interval, 4),
+                    fleet_step=self._last_fleet_step,
+                    journal_replay_ms=self._journal_replay_ms)
         return completed
 
     def _handle_input_failures(self, failures: list[Failure]

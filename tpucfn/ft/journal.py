@@ -52,6 +52,7 @@ from typing import Callable
 # opts into the vocab-drift rule of ``tpucfn check``, like EVENT_KINDS).
 JOURNAL_KINDS = (
     "run_start",        # fresh run: argv, hosts, policy, budget max
+    "launching",        # spawn imminent for these hosts (pids unknown yet)
     "gang_launched",    # whole-gang (re)launch committed: host→pid map
     "solo_launched",    # one host relaunched: host, pid
     "host_exit",        # a supervised rank left the process table: host, rc
@@ -250,6 +251,12 @@ class CoordinatorState:
     max_restarts: int | None = None
     budget_used: int = 0
     incident: int = 0
+    # Hosts with a ``launching`` record but no pid record yet: the
+    # coordinator died inside the spawn window (ISSUE 13 satellite —
+    # the PR 12 hazard).  Their processes may exist without any journal
+    # trace, so adoption must give their first heartbeat a grace period
+    # before relaunching over them.
+    launching: set[int] = dataclasses.field(default_factory=set)
     procs: dict[int, int] = dataclasses.field(default_factory=dict)
     finished: dict[int, int] = dataclasses.field(default_factory=dict)
     pending: PendingIntent | None = None
@@ -274,9 +281,12 @@ class CoordinatorState:
             self.started = True
             self.argv = rec.get("argv")
             self.max_restarts = rec.get("max_restarts")
+        elif k == "launching":
+            self.launching.update(int(h) for h in rec.get("hosts") or ())
         elif k == "gang_launched":
             self.procs = {int(h): int(p)
                           for h, p in (rec.get("pids") or {}).items()}
+            self.launching.clear()
             if self.pending is not None:
                 # A whole-gang launch completes ANY pending act — even a
                 # solo intent: the only solo intent a gang launch follows
@@ -287,6 +297,7 @@ class CoordinatorState:
                 self.pending.launched = True
         elif k == "solo_launched":
             self.procs[int(rec["host"])] = int(rec["pid"])
+            self.launching.discard(int(rec["host"]))
             self.finished.pop(int(rec["host"]), None)
             if self.pending is not None \
                     and self.pending.action == "solo_restart":
@@ -296,6 +307,7 @@ class CoordinatorState:
         elif k == "host_exit":
             h = int(rec["host"])
             self.procs.pop(h, None)
+            self.launching.discard(h)
             self.finished[h] = int(rec.get("rc") or 0)
         elif k == "incident_open":
             self.incident = max(self.incident, int(rec.get("incident", 0)))

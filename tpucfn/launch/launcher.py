@@ -101,6 +101,14 @@ class Launcher:
     input_hosts: int = 0
     input_port: int | None = None
     input_argv: list[str] | None = dataclasses.field(default=None)
+    # Fleet warm start (ISSUE 13): every host learns where the compiled-
+    # artifact servers are (TPUCFN_COMPILE_CACHE_ADDRS, same pattern as
+    # TPUCFN_INPUT_ADDRS) — trainers/serve replicas consult them before
+    # compiling, so host 0 compiles once and N-1 peers fetch.  A RELAUNCH
+    # through launch_host / a gang restart re-derives the same env, which
+    # is what makes restart MTTR stop repaying the compile.  None/empty ⇒
+    # the env key is absent and behavior is byte-identical (pinned).
+    compile_cache_addrs: list[str] | None = dataclasses.field(default=None)
 
     @property
     def trainer_count(self) -> int:
@@ -147,6 +155,10 @@ class Launcher:
                 for h in self.input_host_ids)
             if host_id in self.input_host_ids:
                 env["TPUCFN_INPUT_PORT"] = str(base + host_id)
+        if self.compile_cache_addrs:
+            from tpucfn.compilecache.service import COMPILE_CACHE_ADDRS_ENV
+
+            env[COMPILE_CACHE_ADDRS_ENV] = ",".join(self.compile_cache_addrs)
         env.update(self.extra_env)
         return env
 

@@ -19,6 +19,7 @@ and notebooks share one implementation.
 
 from __future__ import annotations
 
+import json
 import statistics
 from pathlib import Path
 from typing import Iterable
@@ -149,6 +150,44 @@ def request_breakdown(events: Iterable[dict]) -> tuple[list[dict], dict]:
         agg[part] = {"p50": nearest_rank(xs, 50), "p95": nearest_rank(xs, 95),
                      "max": xs[-1] if xs else None}
     return rows, agg
+
+
+# Control-plane span families the fleet view surfaces (one canonical
+# tuple — the span-balance rule of `tpucfn check` reads consumers by
+# ast, and a scattered literal here would be exactly the drift it
+# exists to catch): recovery spans from the gang coordinator, on-demand
+# profiler captures, and the compile-artifact fetch leg of the fleet
+# warm start (ISSUE 13).
+CONTROL_SPAN_NAMES = ("ft_recover", "ft_give_up", "profile_capture",
+                      "compile_fetch")
+
+
+def control_timeline(events: Iterable[dict]) -> list[dict]:
+    """One row per control-plane span, fleet-ordered: when a recovery,
+    profiler capture, or compile-artifact fetch ran relative to the
+    steps around it — the read side that makes those spans part of the
+    merged story instead of write-only trace lines."""
+    rows = []
+    for e in events:
+        if e.get("kind") != "span" or e.get("name") not in \
+                CONTROL_SPAN_NAMES:
+            continue
+        attrs = e.get("attrs") or {}
+        detail = {k: attrs[k] for k in ("action", "hosts", "rc", "key",
+                                        "label", "addr", "bytes",
+                                        "artifact", "seconds")
+                  if k in attrs}
+        rows.append({
+            "ts": e.get("ts_adj", e.get("ts")),
+            "host": e.get("host"),
+            "role": e.get("role"),
+            "span": e.get("name"),
+            "dur_s": e.get("dur_s"),
+            "trace_id": e.get("trace_id"),
+            "detail": json.dumps(detail, sort_keys=True) if detail else "",
+        })
+    rows.sort(key=lambda r: (r["ts"] is None, r["ts"] or 0.0))
+    return rows
 
 
 def step_spans_by_host(events: Iterable[dict]) -> dict[str, list[dict]]:

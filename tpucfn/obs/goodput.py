@@ -66,14 +66,17 @@ from typing import Iterable
 # warm-restart refinement (ISSUE 6 satellite): a first step served from
 # the persistent compile cache pays deserialization + warmup, not a real
 # XLA compile — ``TrainerObs`` splits the two via CompileCacheProbe so
-# warm restarts stop inflating ``compile`` (old ledgers that only ever
-# wrote ``compile`` merge unchanged).
-RECORDED_BUCKETS = ("step", "compile", "compile_cached", "data_wait",
-                    "ckpt")
+# warm restarts stop inflating ``compile``.  ``compile_fetched`` is the
+# fleet refinement (ISSUE 13): a first step whose executable was fetched
+# from a peer's artifact cache paid network + deserialization — its own
+# column, so the fleet warm-start plane's effect is visible per run
+# (old ledgers that only ever wrote ``compile`` merge unchanged).
+RECORDED_BUCKETS = ("step", "compile", "compile_cached", "compile_fetched",
+                    "data_wait", "ckpt")
 DERIVED_BUCKETS = ("idle", "lost_work", "restart_downtime")
 REPORT_BUCKETS = ("productive_step", "compile", "compile_cached",
-                  "data_wait", "ckpt", "lost_work", "idle",
-                  "restart_downtime")
+                  "compile_fetched", "data_wait", "ckpt", "lost_work",
+                  "idle", "restart_downtime")
 
 LEDGER_GLOB = "goodput-host*.jsonl"
 
@@ -376,12 +379,12 @@ def host_goodput(records: Iterable[dict]) -> dict:
                 if step is not None:
                     max_step = step if max_step is None else max(max_step,
                                                                  step)
-            else:  # compile / compile_cached / data_wait / ckpt
+            else:  # compile* / data_wait / ckpt
                 buckets[bucket] += dur
                 # compile of a re-run window still advances max_step so
                 # the re-run detector has the right horizon
-                if bucket in ("compile", "compile_cached") \
-                        and step is not None:
+                if bucket in ("compile", "compile_cached",
+                              "compile_fetched") and step is not None:
                     max_step = step if max_step is None else max(max_step,
                                                                  step)
         elif kind == "close":
@@ -459,7 +462,13 @@ def _incidents_from_events(events: Iterable[dict]) -> list[dict]:
                         # retried-step detail the renderers show.
                         "planned": bool(e.get("planned", False)),
                         "shrink": e.get("shrink"),
-                        "ckpt": e.get("ckpt")})
+                        "ckpt": e.get("ckpt"),
+                        # adopted-coordinator recovery (ISSUE 13
+                        # satellite): how much of the downtime was
+                        # journal replay — measured by the adopter,
+                        # attributed here instead of vanishing into
+                        # the restart_downtime residual.
+                        "journal_replay_ms": e.get("journal_replay_ms")})
         elif inc in recovered:
             out.append({"incident": inc,
                         "action": recovered[inc].get("action"),
@@ -470,7 +479,9 @@ def _incidents_from_events(events: Iterable[dict]) -> list[dict]:
                         "planned": bool(recovered[inc].get("planned",
                                                            False)),
                         "shrink": recovered[inc].get("shrink"),
-                        "ckpt": recovered[inc].get("ckpt")})
+                        "ckpt": recovered[inc].get("ckpt"),
+                        "journal_replay_ms":
+                            recovered[inc].get("journal_replay_ms")})
         else:
             e = give_ups.get(inc) or decides.get(inc) or detects[inc]
             action = ("give_up" if inc in give_ups
@@ -479,7 +490,8 @@ def _incidents_from_events(events: Iterable[dict]) -> list[dict]:
                         "ts": e.get("ts"), "downtime_s": None,
                         "detection_s": None, "fleet_step": None,
                         "lost_steps": None, "planned": False,
-                        "shrink": None, "ckpt": None})
+                        "shrink": None, "ckpt": None,
+                        "journal_replay_ms": None})
     return out
 
 
@@ -572,6 +584,11 @@ def merge_goodput(by_host: dict[int, list[dict]],
         "unplanned_downtime_s": sum(i["downtime_s"] or 0.0
                                     for i in incidents
                                     if not i.get("planned")),
+        # Of the restart downtime, how much was the adopted
+        # coordinator replaying its journal (ISSUE 13 satellite) —
+        # the crash-safety plane's own MTTR cost, named.
+        "journal_replay_ms": sum(i.get("journal_replay_ms") or 0.0
+                                 for i in incidents),
     }
 
 
@@ -612,6 +629,7 @@ def append_goodput_ledger(path: str | Path, report: dict, *,
             1 for i in (report.get("incidents") or ())
             if i.get("planned")),
         "unplanned_downtime_s": report.get("unplanned_downtime_s"),
+        "journal_replay_ms": report.get("journal_replay_ms"),
         "buckets": dict(buckets),
         "shares": {b: (v / wall if wall > 0 else None)
                    for b, v in buckets.items()},

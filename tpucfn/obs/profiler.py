@@ -44,20 +44,35 @@ def start_profiler_server(port: int = 9012):
     return start_profiler_server._server
 
 
-def enable_compile_cache(cache_dir: str | None = None) -> str:
+def enable_compile_cache(cache_dir: str | None = None,
+                         min_compile_time_s: float | None = None) -> str:
     """Point XLA's persistent compilation cache at ``cache_dir`` (default
     ``$TPUCFN_XLA_CACHE`` or /tmp/tpucfn_xla_cache).  A relaunch of the
     same program — the restart supervisor's resume, or the second
     ``tpucfn launch`` on a pod — then skips recompilation, which is what
     keeps time_to_first_step from being compile-dominated (SURVEY.md §7.4
-    item 6, BASELINE.md metric 2).  Safe to call multiple times."""
+    item 6, BASELINE.md metric 2).  Safe to call multiple times.
+
+    ``min_compile_time_s`` (or ``$TPUCFN_XLA_CACHE_MIN_S``) overrides
+    the persistence threshold — the ft drills and compile bench pin
+    warm-restart accounting on programs that compile in well under the
+    production default of 1 s."""
+    import os
+
     import jax
 
     from tpucfn.utils.env import xla_cache_dir
 
     cache_dir = cache_dir or xla_cache_dir()
+    if min_compile_time_s is None:
+        raw = os.environ.get("TPUCFN_XLA_CACHE_MIN_S", "").strip()
+        try:
+            min_compile_time_s = float(raw) if raw else 1.0
+        except ValueError:
+            min_compile_time_s = 1.0
     jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_time_s))
     return cache_dir
 
 
@@ -148,11 +163,22 @@ class CompileCacheProbe:
       plain ``compile``; no number beats a wrong number.  Notably a
       SHARED non-empty cache dir holding none of this run's programs
       stays unknown, not a phantom hit.
+
+    The fleet artifact plane (ISSUE 13) bypasses jax's persistent
+    cache entirely — a fetched AOT executable deserializes without
+    touching this directory — so the
+    :class:`~tpucfn.compilecache.service.CompileCacheClient` reports
+    its verdict explicitly through :meth:`mark`; an explicit mark wins
+    over the directory heuristic.  :meth:`outcome` is the three-way
+    answer the goodput ledger buckets on: ``"fetch"`` (a fleet peer's
+    artifact) / ``"hit"`` (persistent cache or local artifact store) /
+    ``"miss"`` (a real compile ran) / None (unknown).
     """
 
     def __init__(self, cache_dir: str | Path):
         self.cache_dir = Path(cache_dir)
         self._before = self._snapshot()
+        self._mark: str | None = None
 
     def _snapshot(self) -> tuple[int, int]:
         """(entry count, newest ``*-atime`` mtime_ns): persists move
@@ -171,21 +197,48 @@ class CompileCacheProbe:
         return count, atime_ns
 
     def rearm(self) -> None:
-        """Re-snapshot both signals.  TrainerObs calls this at the
-        FIRST step's entry: programs compiled (or cache-loaded) between
-        enabling the cache and the loop reaching step 1 — checkpoint
-        restore's re-materialize copy, eval_shape probes — move them
-        too, and counting those against the step would misread every
-        resumed run."""
+        """Re-snapshot both signals (and clear any explicit mark).
+        TrainerObs calls this at the FIRST step's entry: programs
+        compiled (or cache-loaded) between enabling the cache and the
+        loop reaching step 1 — checkpoint restore's re-materialize
+        copy, eval_shape probes — move them too, and counting those
+        against the step would misread every resumed run."""
         self._before = self._snapshot()
+        self._mark = None
+
+    def mark(self, outcome: str) -> None:
+        """Explicit verdict from the artifact plane, recorded as the
+        compile ran: ``"fetch"`` (fleet artifact installed),
+        ``"store"`` (local artifact store hit), ``"compile"`` (the
+        client compiled for real).  Wins over the directory heuristic
+        in :meth:`outcome` — the artifact path never touches the
+        persistent-cache dir, so the heuristic cannot see it."""
+        self._mark = outcome
 
     def hit(self) -> bool | None:
+        if self._mark is not None:
+            return self._mark in ("fetch", "store")
         count, atime_ns = self._snapshot()
         if count > self._before[0]:
             return False
         if atime_ns > self._before[1]:
             return True
         return None
+
+    def outcome(self) -> str | None:
+        """``"fetch"`` | ``"hit"`` | ``"miss"`` | None (unknown) — the
+        goodput split: fetch → ``compile_fetched``, hit →
+        ``compile_cached``, miss/None → ``compile``."""
+        if self._mark == "fetch":
+            return "fetch"
+        if self._mark == "store":
+            return "hit"
+        if self._mark == "compile":
+            return "miss"
+        h = self.hit()
+        if h is None:
+            return None
+        return "hit" if h else "miss"
 
 
 @contextlib.contextmanager

@@ -56,6 +56,15 @@ import numpy as np
 from tpucfn.parallel.sharding import _path_str
 
 
+def _maybe_warm(jitted, label: str):
+    """Fleet warm start (ISSUE 13): route through the compile-artifact
+    cache when a process-default client is configured; otherwise
+    ``maybe_warm`` returns the jitted callable itself, untouched."""
+    from tpucfn.compilecache.jit import maybe_warm
+
+    return maybe_warm(jitted, label=label)
+
+
 def _sample(logits: jax.Array, temps: jax.Array, key: jax.Array) -> jax.Array:
     """(N, V) fp32 logits -> (N,) int32 tokens.  temp<=0 is greedy;
     otherwise categorical over logits/temp (the ``models/generate.py``
@@ -115,11 +124,22 @@ class ServeEngine:
         # the prefill program (decode reads it in place).
         self._temps = jnp.zeros((max_batch,), jnp.float32)
 
-        self._prefill_jit = jax.jit(self._prefill_many_impl,
-                                    donate_argnums=(0, 1))
-        self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(0,))
-        self._copy_prefix_jit = jax.jit(self._copy_prefix_impl,
-                                        donate_argnums=(0,))
+        # Fleet warm start (ISSUE 13): when a compile-artifact client is
+        # configured (cmd_serve does it from TPUCFN_COMPILE_CACHE_ADDRS
+        # before building engines), each program's first call per shape
+        # bucket fetches the serialized executable a peer replica (or a
+        # previous incarnation — relaunch, probation) already compiled
+        # instead of recompiling.  No client ⇒ the plain jit callables,
+        # byte-identical (pinned).
+        self._prefill_jit = _maybe_warm(
+            jax.jit(self._prefill_many_impl, donate_argnums=(0, 1)),
+            "serve_prefill")
+        self._decode_jit = _maybe_warm(
+            jax.jit(self._decode_impl, donate_argnums=(0,)),
+            "serve_decode")
+        self._copy_prefix_jit = _maybe_warm(
+            jax.jit(self._copy_prefix_impl, donate_argnums=(0,)),
+            "serve_copy_prefix")
 
     @classmethod
     def from_llama(cls, cfg, params, *, max_batch: int = 8,
