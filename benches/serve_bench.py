@@ -37,6 +37,18 @@ correctness and scheduling-overhead bench.
   ``availability`` (fraction of ACCEPTED requests completing within
   deadline), the retry success rate, and the hedge win rate.
 
+* **Speculative decoding** (``--spec``, ISSUE 14): three legs over one
+  prompt set — plain decode, a self-draft (identical weights ⇒ the
+  synthetic high-acceptance workload), and an adversarial nano draft
+  with divergent weights (⇒ zero acceptance, the controller's worst
+  case).  Every leg's outputs are asserted bit-identical (greedy spec
+  decode's correctness contract), then two rc gates: the high-
+  acceptance leg must reach >= 1.5x ``tokens_per_target_step`` vs
+  plain, and the adversarial leg's measured TPOT must stay within
+  1.3x of plain — the acceptance-driven controller shrinking k and
+  then turning speculation off (amortized probes only) is what makes
+  that bound real rather than hoped.
+
 Usage: python benches/serve_bench.py [--preset tiny --requests 32 ...]
 """
 
@@ -216,6 +228,152 @@ def run_availability(args) -> int:
     return 0 if dropped == 0 else 1
 
 
+def run_spec(args) -> int:
+    """Plain vs speculative decode on one prompt set (see module
+    docstring).  The worst-case leg runs the ADAPTIVE controller with a
+    short window so the run demonstrates the bound it gates on: shrink
+    to k=1, then speculation OFF with amortized probes."""
+    import jax
+    import numpy as np
+
+    from tpucfn.serve import Server
+    from tpucfn.serve.engine import ServeEngine, demo_llama_engine
+    from tpucfn.serve.scheduler import prefill_bucket
+    from tpucfn.serve.spec import SpecDecoder, SpecKController
+
+    print(f"# backend={jax.default_backend()} spec drill "
+          f"preset={args.preset} k={args.spec_k} "
+          f"requests={args.spec_requests} max_new={args.spec_max_new}",
+          file=sys.stderr)
+    cfg, target_plain = demo_llama_engine(
+        args.preset, seed=args.seed, max_batch=args.max_batch,
+        cache_len=args.cache_len, prefill_width=args.max_prefill_batch)
+    params = target_plain.params
+
+    def eng(p=None, seed=None):
+        if p is not None:
+            return ServeEngine.from_llama(
+                cfg, p, max_batch=args.max_batch, cache_len=args.cache_len,
+                prefill_width=args.max_prefill_batch)
+        _, e = demo_llama_engine(
+            "nano", seed=seed, max_batch=args.max_batch,
+            cache_len=args.cache_len, prefill_width=args.max_prefill_batch)
+        return e
+
+    # High-acceptance leg: self-draft (identical weights — the draft
+    # always agrees, the synthetic upper bound real distilled drafts
+    # approach).  Worst-case leg: a nano draft with DIVERGENT weights
+    # (different init seed) — acceptance ~0 on random-init models.
+    spec_hi = SpecDecoder(eng(params), eng(params), k=args.spec_k)
+    spec_lo = SpecDecoder(
+        eng(params), eng(seed=args.seed + 1),
+        controller=SpecKController(k=args.spec_k, window=4,
+                                   probe_every=64))
+
+    rs = np.random.RandomState(args.seed)
+    prompts = [rs.randint(0, cfg.vocab_size,
+                          rs.randint(args.prompt_len_lo,
+                                     args.prompt_len_hi + 1)).tolist()
+               for _ in range(args.spec_requests)]
+
+    def leg(engine, fresh_controller=None):
+        # compile warmup on the engine pair (buckets, decode, verify
+        # widths, rollback), excluded from the timed pass — bench.py's
+        # warmup-exclusion rule.
+        warm = Server(engine, num_blocks=args.num_blocks,
+                      block_size=args.block_size, prefix_cache=False,
+                      max_prefill_batch=args.max_prefill_batch)
+        for b in sorted({prefill_bucket(len(q), args.cache_len)
+                         for q in prompts}):
+            warm.submit([1] * min(b, args.cache_len - args.spec_max_new),
+                        max_new_tokens=min(args.spec_max_new, 24))
+        warm.run_until_idle()
+        if fresh_controller is not None:
+            # The warmup also ADAPTED the controller (an adversarial
+            # warmup leaves it already off).  Reset it so the timed
+            # pass pays the full shrink-to-off transient — the gate
+            # bounds the controller's whole trajectory, not just its
+            # steady state.
+            engine.controller = fresh_controller()
+        server = Server(engine, num_blocks=args.num_blocks,
+                        block_size=args.block_size, prefix_cache=False,
+                        max_prefill_batch=args.max_prefill_batch)
+        t0 = time.perf_counter()
+        reqs = [server.submit(q, max_new_tokens=args.spec_max_new)
+                for q in prompts]
+        server.run_until_idle()
+        wall = time.perf_counter() - t0
+        outs = [r.result(timeout=0) for r in reqs]
+        tpots = [(r.t_done - r.t_first_token) / (len(r.tokens) - 1)
+                 for r in reqs if r.tokens and len(r.tokens) > 1]
+        snap = server.metrics.snapshot()
+        assert server.kv.allocator.num_used == 0, "KV blocks leaked"
+        return outs, {
+            "wall_s": round(wall, 3),
+            "tokens_per_target_step": snap["tokens_per_target_step"],
+            "acceptance_rate": snap["spec_acceptance_rate"],
+            "spec_proposed": snap["spec_proposed"],
+            "spec_accepted": snap["spec_accepted"],
+            "decode_rounds": snap["decode_rounds"],
+            "spec_rounds": snap["spec_rounds"],
+            "tpot_mean_s": (round(sum(tpots) / len(tpots), 6)
+                            if tpots else None),
+            "tokens_per_sec": round(snap["generated_tokens"] / wall, 3),
+        }
+
+    ref, plain = leg(target_plain)
+    out_hi, hi = leg(spec_hi)
+    out_lo, lo = leg(
+        spec_lo,
+        fresh_controller=lambda: SpecKController(
+            k=args.spec_k, window=4, probe_every=64))
+    hi["controller_k_final"] = spec_hi.controller.k
+    lo["controller_k_final"] = spec_lo.controller.k
+
+    identical = (out_hi == ref) and (out_lo == ref)
+    tps_gain = (hi["tokens_per_target_step"] or 0.0) \
+        / max(plain["tokens_per_target_step"] or 1.0, 1e-9)
+    tpot_ratio = (lo["tpot_mean_s"] / plain["tpot_mean_s"]
+                  if lo["tpot_mean_s"] and plain["tpot_mean_s"] else None)
+    gates = {
+        "bit_identical": identical,
+        "tokens_per_target_step_gain": round(tps_gain, 3),
+        "tokens_per_target_step_gate": tps_gain >= 1.5,
+        "worst_case_tpot_ratio": (round(tpot_ratio, 3)
+                                  if tpot_ratio is not None else None),
+        "worst_case_tpot_gate": (tpot_ratio is not None
+                                 and tpot_ratio <= 1.3),
+    }
+    row = {
+        "metric": "serve_spec_tokens_per_target_step",
+        "value": hi["tokens_per_target_step"],
+        "unit": "decode tokens per target dispatch per slot "
+                "(high-acceptance self-draft leg)",
+        "vs_baseline": 0.0,
+        "detail": {
+            "baseline_note": "reference harness was training-only; no "
+                             "published speculative-decode number exists",
+            "backend": jax.default_backend(),
+            "preset": args.preset,
+            "draft": {"high_acceptance": "self",
+                      "worst_case": "nano (divergent init)"},
+            "spec_k": args.spec_k,
+            "requests": args.spec_requests,
+            "max_new": args.spec_max_new,
+            "max_batch": args.max_batch,
+            "plain": plain,
+            "spec_high_acceptance": hi,
+            "spec_worst_case": lo,
+            "gates": gates,
+            "seed": args.seed,
+        },
+    }
+    print(json.dumps(row))
+    ok = (identical and gates["tokens_per_target_step_gate"]
+          and gates["worst_case_tpot_gate"])
+    return 0 if ok else 1
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--preset", choices=["tiny", "llama3-1b", "llama3-8b"],
@@ -246,10 +404,22 @@ def main() -> int:
     p.add_argument("--hedge-ms", type=float, default=250.0,
                    help="hedge delay floor for the availability drill "
                         "(0 disables hedging)")
+    p.add_argument("--spec", action="store_true",
+                   help="run the speculative-decoding drill (plain vs "
+                        "self-draft vs adversarial nano draft) instead "
+                        "of the throughput workloads")
+    p.add_argument("--spec-k", type=int, default=4)
+    p.add_argument("--spec-requests", type=int, default=8)
+    p.add_argument("--spec-max-new", type=int, default=96,
+                   help="decode length of the spec drill (long enough "
+                        "for the adaptive controller to reach its "
+                        "steady state on the adversarial leg)")
     args = p.parse_args()
 
     if args.availability:
         return run_availability(args)
+    if args.spec:
+        return run_spec(args)
 
     import jax
     import numpy as np

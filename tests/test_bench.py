@@ -350,3 +350,38 @@ def test_data_bench_service_row_schema():
     assert rec["stall_share_served"] < rec["stall_share_local"]
     assert rec["served_step_s"] < rec["loader_step_s"]
     assert rec["ok"] is True  # served within 1.5x of prestaged (rc gate)
+
+
+def test_serve_bench_spec_row_schema():
+    """ISSUE 14 CI satellite: `serve_bench --spec` emits the
+    speculative-decoding BENCH row and rc-gates the two acceptance
+    numbers — >= 1.5x tokens_per_target_step on the high-acceptance
+    self-draft leg, worst-case TPOT within 1.3x of plain on the
+    adversarial leg — with every leg's output bit-identical."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, str(REPO / "benches" / "serve_bench.py"),
+         "--spec", "--cache-len", "192", "--prompt-len-hi", "64"],
+        env=env, capture_output=True, text=True, timeout=1200)
+    assert r.returncode == 0, f"stderr:\n{r.stderr[-2000:]}"
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "serve_spec_tokens_per_target_step"
+    d = rec["detail"]
+    for leg in ("plain", "spec_high_acceptance", "spec_worst_case"):
+        for key in ("tokens_per_target_step", "tpot_mean_s",
+                    "decode_rounds", "wall_s"):
+            assert key in d[leg], (leg, key)
+    gates = d["gates"]
+    assert gates["bit_identical"] is True
+    assert gates["tokens_per_target_step_gate"] is True
+    assert gates["worst_case_tpot_gate"] is True
+    assert gates["tokens_per_target_step_gain"] >= 1.5
+    assert gates["worst_case_tpot_ratio"] <= 1.3
+    # The high-acceptance leg really speculated; the adversarial leg's
+    # controller really reached its floor (off).
+    assert d["spec_high_acceptance"]["acceptance_rate"] == 1.0
+    assert d["spec_worst_case"]["acceptance_rate"] == 0.0
+    assert d["spec_worst_case"]["controller_k_final"] == 0
+    assert rec["value"] >= 1.5

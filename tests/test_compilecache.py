@@ -507,3 +507,117 @@ def test_racing_publishers_cannot_cross_poison(tmp_path):
     (tmp_path / f"{k}.meta.json").write_text(json.dumps(meta_b))
     payload, _ = st.get(k)
     assert payload == b"payload-B"
+
+
+# ---- store GC (ISSUE 14 satellite) --------------------------------------
+
+def _fill(tmp_path, n=6, size=1000):
+    st = ArtifactStore(tmp_path)
+    keys = []
+    for i in range(n):
+        key = ("%02x" % i) * 32
+        st.put(key, bytes([i]) * size, {"label": f"p{i}"})
+        keys.append(key)
+    # Deterministic recency: entry i read (i+1) "hours ago" — oldest
+    # first in LRU order.
+    import os as _os
+    import time as _time
+
+    for i, k in enumerate(keys):
+        t = _time.time() - (n - i) * 3600
+        _os.utime(st._meta_path(k), (t, t))
+    return st, keys
+
+
+def test_gc_evicts_lru_until_under_cap(tmp_path):
+    st, keys = _fill(tmp_path)
+    per = st._meta_path(keys[0]).stat().st_size + 1000
+    stats = st.gc(3 * per + 10)
+    assert stats["evicted"] == 3
+    assert st.keys() == keys[3:]  # oldest-read evicted first
+    assert stats["live_bytes_after"] <= 3 * per + 10
+    # Evicted entries read as clean misses, not corruption.
+    assert st.get(keys[0]) is None
+
+
+def test_gc_get_refreshes_recency(tmp_path):
+    st, keys = _fill(tmp_path)
+    st.get(keys[0])  # oldest entry becomes hottest
+    stats = st.gc(0)
+    assert stats["evicted"] == len(keys) - 1 or stats["evicted"] == len(keys)
+    # With cap 0 everything unclaimed goes; instead pin the ORDER with a
+    # cap that keeps exactly one entry:
+    st2, keys2 = _fill(tmp_path / "b")
+    st2.get(keys2[0])
+    per = st2._meta_path(keys2[1]).stat().st_size + 1000
+    st2.gc(per + 10)
+    assert st2.keys() == [keys2[0]]
+
+
+def test_gc_never_evicts_claimed_keys(tmp_path):
+    st, keys = _fill(tmp_path)
+    assert st.claim(keys[0])
+    stats = st.gc(0)
+    assert st.keys() == [keys[0]]
+    assert stats["kept_claimed"] == 1
+    st.release(keys[0])
+    st.gc(0)
+    assert st.keys() == []
+
+
+def test_gc_sweeps_old_orphans_keeps_young(tmp_path):
+    import os as _os
+    import time as _time
+
+    st, keys = _fill(tmp_path, n=2)
+    old_orphan = tmp_path / (keys[0] + ".beadfeedbeadfeed.bin")
+    old_orphan.write_bytes(b"x" * 100)
+    t = _time.time() - 7200
+    _os.utime(old_orphan, (t, t))
+    young_orphan = tmp_path / (keys[1] + ".feedbeadfeedbead.bin")
+    young_orphan.write_bytes(b"y" * 100)  # in-flight publish window
+    stats = st.gc(1 << 30, orphan_age_s=3600)
+    assert stats["evicted"] == 0
+    assert stats["orphans_removed"] == 1
+    assert not old_orphan.exists() and young_orphan.exists()
+    # The REFERENCED bins survived.
+    for k in keys:
+        assert st.get(k) is not None
+
+
+def test_gc_cli_row(tmp_path):
+    import subprocess
+    import sys as _sys
+
+    _fill(tmp_path, n=3)
+    r = subprocess.run(
+        [_sys.executable, "-m", "tpucfn.cli", "compilecache", "gc",
+         "--dir", str(tmp_path), "--max-bytes", "2K"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    row = json.loads(r.stdout)
+    assert row["max_bytes"] == 2048
+    for key in ("entries", "live_bytes", "evicted", "kept_claimed",
+                "orphans_removed", "live_bytes_after"):
+        assert key in row, key
+    assert row["live_bytes_after"] <= 2048
+
+
+def test_gc_concurrent_get_sees_clean_miss_not_corruption(tmp_path):
+    """A reader that loaded the meta just before gc evicted the entry
+    must see a plain miss (the entry is GONE, not corrupt) — no
+    quarantine, no CacheCorrupt, exactly what a reader arriving a
+    moment later sees.  A payload unreadable while the meta is STILL
+    present stays the loud quarantine path."""
+    st, keys = _fill(tmp_path, n=1)
+    loaded = st.meta(keys[0])
+    st.meta = lambda k: loaded  # the reader already holds the meta...
+    st._meta_path(keys[0]).unlink()     # ...when gc unlinks meta
+    (tmp_path / loaded["bin"]).unlink()  # ...then the payload
+    assert st.get(keys[0]) is None
+    assert not (tmp_path / "corrupt").exists()
+    # Control: same situation but the meta file survives -> corrupt.
+    st2, keys2 = _fill(tmp_path / "b", n=1)
+    (tmp_path / "b" / st2.meta(keys2[0])["bin"]).unlink()
+    with pytest.raises(CacheCorrupt):
+        st2.get(keys2[0])

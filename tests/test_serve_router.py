@@ -454,14 +454,23 @@ def test_shed_off_by_default():
 # ---- observability ---------------------------------------------------------
 
 def test_replica_state_gauges_exported():
+    """ISSUE 14 migration: the PR 8 per-replica state family is now two
+    AGGREGATE series (cardinality does not scale with --replicas);
+    per-replica detail moved to snapshot()."""
     reg = MetricRegistry()
     router = make_router(n=2, registry=reg)
     m = reg.varz()["metrics"]
-    assert m["router_replica_state_0"] == REPLICA_STATE_CODES["closed"]
+    assert m["router_replica_state_worst"] == REPLICA_STATE_CODES["closed"]
+    assert m["router_replicas_routable"] == 2
     router.auto_relaunch = False
     router.kill_replica(1)
     m = reg.varz()["metrics"]
-    assert m["router_replica_state_1"] == REPLICA_STATE_CODES["dead"]
+    assert m["router_replica_state_worst"] == REPLICA_STATE_CODES["dead"]
+    assert m["router_replicas_routable"] == 1
+    assert not any(k.startswith("router_replica_state_0") for k in m)
+    states = {r["replica"]: r["state"]
+              for r in router.snapshot()["replicas"]}
+    assert states == {0: "closed", 1: "dead"}
     for name in ("router_retries_total", "router_hedges_total",
                  "router_hedges_won_total", "router_failovers_total",
                  "router_sheds_total"):

@@ -302,3 +302,77 @@ def test_mixed_workload_with_prefix_cache_zero_leaks():
     assert all(q.state is SequenceState.FINISHED for q in done)
     assert s.kv.allocator.num_used == 0
     assert s.kv.allocator.num_free == 20
+
+
+# ---- multi-token decode recording (ISSUE 14) ----------------------------
+
+def test_record_decode_tokens_multi_and_eos_mid_run():
+    """An accepted run retires on the FIRST stop condition: tokens past
+    an EOS (or past max_new) are dropped, the slot vacates, and the
+    recorded count tells the caller where to roll the caches back to."""
+    s = _sched(num_blocks=16, block_size=4, max_batch=2, eos_id=99)
+    s.add(_seq(0, max_new=10))
+    w = s.next_work()
+    s.record_prefill(w.slot, 5)
+    s.next_work()  # reserve the round's first token
+    fin, n = s.record_decode_tokens(w.slot, [6, 7, 99, 8, 9])
+    assert fin is not None and fin.state is SequenceState.FINISHED
+    assert n == 3
+    assert fin.generated == [5, 6, 7, 99]  # nothing after the EOS
+    assert s.kv.allocator.num_used == 0
+
+
+def test_record_decode_tokens_max_new_mid_run():
+    s = _sched(num_blocks=16, block_size=4, max_batch=2)
+    s.add(_seq(0, max_new=3))
+    w = s.next_work()
+    s.record_prefill(w.slot, 5)
+    s.next_work()
+    fin, n = s.record_decode_tokens(w.slot, [6, 7, 8, 9])
+    assert fin is not None and n == 2  # 5 counted already: stop at 3
+    assert fin.generated == [5, 6, 7]
+    assert s.kv.allocator.num_used == 0
+
+
+def test_record_decode_tokens_truncates_when_pool_dry():
+    """Tokens past the up-front reservation are best-effort: a dry pool
+    truncates the acceptance instead of preempting mid-commit, and the
+    sequence finishes later once capacity returns."""
+    s = _sched(num_blocks=4, block_size=2, max_batch=1, cache_len=8)
+    s.add(_seq(0, prompt_len=4, max_new=4))
+    w = s.next_work()
+    s.record_prefill(w.slot, 5)
+    s.next_work()  # reserves the round's first token (3rd block)
+    s.kv.admit("dummy", prompt_len=2)  # drains the last free block
+    fin, n = s.record_decode_tokens(w.slot, [6, 7, 8])
+    assert fin is None
+    assert n == 2  # first token reserved up front, second fit the
+    #                reserved block, third found the pool dry
+    seq = s.running[w.slot]
+    assert seq.generated == [5, 6, 7]
+    s.kv.release("dummy")
+    done = _drive(s)
+    assert [q.seq_id for q in done] == [0]
+    assert len(done[0].generated) == 4
+    assert s.kv.allocator.num_used == 0
+
+
+def test_record_decode_single_token_delegates():
+    """record_decode(slot, tok) == record_decode_tokens(slot, [tok]) —
+    the plain path is the K=1 case of the multi-token one."""
+    s = _sched(max_batch=1)
+    s.add(_seq(0, max_new=1))
+    w = s.next_work()
+    fin = s.record_prefill(w.slot, 5)
+    assert fin is not None  # max_new=1 retires at the prefill token
+    assert s.kv.allocator.num_used == 0
+
+
+def test_decode_work_carries_proposed_runs():
+    s = _sched(max_batch=1)
+    s.add(_seq(0))
+    s.record_prefill(s.next_work().slot, 5)
+    w = s.next_work()
+    assert isinstance(w, DecodeWork) and w.proposed is None
+    w.proposed = {0: [1, 2]}  # the serve loop stashes the round here
+    assert w.proposed == {0: [1, 2]}

@@ -1,0 +1,456 @@
+"""Speculative decoding (tpucfn.serve.spec, ISSUE 14): the greedy
+bit-identity pin (spec output == plain engine output across mixed
+prefill/decode workloads, preemption, slot reuse, prefix hits, and a
+DIVERGENT draft), the k-controller's shrink/off/probe behavior, the
+multi-token record path through the Server, and the no-draft
+byte-identity guarantee.
+
+Compile-budget note: jax tests share module-scoped engines (tiny
+target, self and divergent drafts) the same way test_serve_engine.py
+does — slots are fully overwritten per prefill, so cross-test state
+cannot leak.
+"""
+
+import dataclasses
+import time
+
+import pytest
+
+from tpucfn.serve.spec import SpecDecoder, SpecKController
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from tpucfn.models.llama import Llama, LlamaConfig  # noqa: E402
+from tpucfn.serve import Cancelled, ServeEngine, Server  # noqa: E402
+
+
+# ---- SpecKController (pure, no jax needed but grouped here) -------------
+
+def test_controller_validation():
+    with pytest.raises(ValueError, match="k must be"):
+        SpecKController(k=0)
+    with pytest.raises(ValueError, match="min_k"):
+        SpecKController(k=4, min_k=5)
+    with pytest.raises(ValueError, match="shrink_below"):
+        SpecKController(k=4, shrink_below=0.9, grow_above=0.5)
+    with pytest.raises(ValueError, match="probe_every"):
+        SpecKController(k=4, probe_every=1)
+
+
+def test_controller_shrinks_to_off_and_probes():
+    ctl = SpecKController(k=4, window=4, probe_every=3)
+    # Four zero-acceptance rounds per window: 4 -> 2 -> 1 -> off.
+    for expect in (2, 1, 0):
+        for _ in range(4):
+            ctl.observe(proposed=8, accepted=0)
+        assert ctl.k == expect, expect
+    # Off: only every probe_every-th round proposes.
+    ks = [ctl.round_k() for _ in range(6)]
+    assert ks == [0, 0, 1, 0, 0, 1]
+    # A failed probe stays off; a perfect probe re-enables at min_k.
+    ctl.observe(proposed=8, accepted=0)
+    assert ctl.k == 0
+    ctl.round_k()
+    ctl.round_k()
+    assert ctl.round_k() == 1  # the probe round
+    ctl.observe(proposed=8, accepted=8)
+    assert ctl.k == 1
+
+
+def test_controller_grows_on_sustained_acceptance():
+    ctl = SpecKController(k=2, max_k=8, window=4)
+    for _ in range(4):
+        ctl.observe(proposed=8, accepted=8)
+    assert ctl.k == 4
+    for _ in range(4):
+        ctl.observe(proposed=16, accepted=16)
+    assert ctl.k == 8
+    for _ in range(8):
+        ctl.observe(proposed=32, accepted=32)
+    assert ctl.k == 8  # capped at max_k
+
+
+def test_controller_window_resets_on_decision():
+    ctl = SpecKController(k=4, window=4)
+    for _ in range(4):
+        ctl.observe(proposed=8, accepted=0)
+    assert ctl.k == 2
+    # Fresh evidence after the shrink: three good rounds must NOT be
+    # judged against the stale bad window.
+    for _ in range(3):
+        ctl.observe(proposed=4, accepted=4)
+    assert ctl.k == 2 and ctl.acceptance_rate() == 1.0
+
+
+def test_controller_non_adaptive_pins_k():
+    ctl = SpecKController(k=3, adaptive=False)
+    for _ in range(32):
+        ctl.observe(proposed=8, accepted=0)
+    assert ctl.k == 3
+
+
+# ---- shared engines ------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = dataclasses.replace(LlamaConfig.tiny(), max_seq=64)
+    params = Llama(cfg).init(jax.random.key(2),
+                             jnp.zeros((2, 8), jnp.int32))["params"]
+    divergent = Llama(cfg).init(jax.random.key(77),
+                                jnp.zeros((2, 8), jnp.int32))["params"]
+    return cfg, params, divergent
+
+
+def _eng(cfg, params, max_batch=4):
+    return ServeEngine.from_llama(cfg, params, max_batch=max_batch,
+                                  cache_len=64)
+
+
+@pytest.fixture(scope="module")
+def spec_self(tiny):
+    cfg, params, _ = tiny
+    return SpecDecoder(_eng(cfg, params), _eng(cfg, params), k=4)
+
+
+@pytest.fixture(scope="module")
+def spec_div(tiny):
+    """Divergent draft: proposals are near-always wrong — the output
+    must STILL be bit-identical (acceptance is a perf dial, never a
+    correctness input)."""
+    cfg, params, divergent = tiny
+    return SpecDecoder(_eng(cfg, params), _eng(cfg, divergent), k=4,
+                       adaptive=False)
+
+
+def _run_server(engine, prompts, max_new, **kw):
+    server = Server(engine, **{"num_blocks": 48, "block_size": 8, **kw})
+    reqs = [server.submit(p, max_new_tokens=max_new) for p in prompts]
+    server.run_until_idle()
+    assert server.kv.allocator.num_used == 0, "KV blocks leaked"
+    return [r.result(timeout=0) if r.error is None else r.error
+            for r in reqs], server
+
+
+# ---- engine-level verify/rollback ---------------------------------------
+
+def test_engine_verify_matches_sequential_decode(tiny):
+    cfg, params, _ = tiny
+    eng_a = _eng(cfg, params)
+    eng_b = _eng(cfg, params)
+    prompt = [5, 9, 2, 77, 31]
+    ref = [eng_a.prefill(slot=1, prefix=prompt, bucket=16)]
+    for _ in range(6):
+        ref.append(eng_a.decode({1: ref[-1]})[1])
+    assert eng_b.prefill(slot=1, prefix=prompt, bucket=16) == ref[0]
+    out = eng_b.verify({1: ref[:3]}, 3)   # all "proposals" correct
+    assert out[1] == ref[1:4]
+    eng_b.rollback({1: len(prompt) + 3})
+    # Wrong proposals: position 0 must still match plain decode, and
+    # after rollback the plain path continues bit-identically.
+    out2 = eng_b.verify({1: [ref[3], 1234 % cfg.vocab_size, 7]}, 3)
+    assert out2[1][0] == ref[4]
+    eng_b.rollback({1: len(prompt) + 4})
+    assert eng_b.decode({1: ref[4]})[1] == ref[5]
+    counts = eng_b.compile_counts()
+    assert "verify" in counts and "rollback" in counts
+
+
+def test_engine_rollback_is_masked(tiny):
+    """Rolling back one slot must not disturb another slot's position
+    (free slots hold prefix-cache residue the scheduler still uses)."""
+    cfg, params, _ = tiny
+    eng = _eng(cfg, params)
+    p = [3, 1, 4, 1, 5]
+    a = [eng.prefill(slot=0, prefix=p, bucket=16)]
+    b = [eng.prefill(slot=2, prefix=p, bucket=16)]
+    eng.verify({0: [a[0], 1, 2]}, 3)
+    # Discard the whole verify (roll slot 0 back to just-prefilled);
+    # slot 2 is NOT listed and must keep its own position.
+    eng.rollback({0: len(p)})
+    for _ in range(3):
+        out = eng.decode({0: a[-1], 2: b[-1]})
+        a.append(out[0])
+        b.append(out[2])
+    assert a == b  # identical prompts, identical greedy continuations
+
+
+def test_engine_verify_validates(tiny):
+    cfg, params, _ = tiny
+    eng = _eng(cfg, params)
+    with pytest.raises(ValueError, match="width"):
+        eng.verify({0: [1, 2]}, 3)
+    with pytest.raises(ValueError, match="width must be"):
+        eng.verify({}, 0)
+    with pytest.raises(ValueError, match="rollback length"):
+        eng.rollback({0: 65})
+
+
+# ---- the bit-identity pins ----------------------------------------------
+
+def _mixed_prompts(cfg, seed=0, n=10):
+    rs = np.random.RandomState(seed)
+    system = rs.randint(0, cfg.vocab_size, 16).tolist()
+    out = []
+    for i in range(n):
+        if i % 3 == 0:  # shared-prefix arrivals exercise copy_prefix
+            out.append(system + rs.randint(
+                0, cfg.vocab_size, 2 + i % 4).tolist())
+        else:
+            out.append(rs.randint(
+                0, cfg.vocab_size, rs.randint(3, 14)).tolist())
+    return out
+
+
+def test_spec_bit_identical_mixed_workload(tiny, spec_self, spec_div):
+    """THE acceptance pin: the full emitted sequence with a draft —
+    agreeing or divergent — equals the plain engine's over a mixed
+    prefill/decode workload with prefix-cache hits."""
+    cfg, params, _ = tiny
+    prompts = _mixed_prompts(cfg)
+    ref, rs_ = _run_server(_eng(cfg, params), prompts, 6)
+    out_self, s_self = _run_server(spec_self, prompts, 6)
+    out_div, s_div = _run_server(spec_div, prompts, 6)
+    assert out_self == ref
+    assert out_div == ref
+    snap = s_self.metrics.snapshot()
+    assert snap["spec_accepted"] == snap["spec_proposed"] > 0
+    assert snap["tokens_per_target_step"] > 1.5
+    assert s_self.metrics.registry.varz()["metrics"][
+        "serve_spec_acceptance_rate"] == 1.0
+    # Divergent draft: near-zero acceptance, same output.
+    dsnap = s_div.metrics.snapshot()
+    assert dsnap["spec_accepted"] < dsnap["spec_proposed"]
+
+
+def test_spec_bit_identical_across_prefix_hits(tiny):
+    """Staged arrivals so the second wave HITS the prefix cache (a
+    prefilled backer exists): the copy_prefix mirror and the residue
+    path must keep spec output identical to plain."""
+    cfg, params, _ = tiny
+    rs = np.random.RandomState(11)
+    system = rs.randint(0, cfg.vocab_size, 16).tolist()
+    first = [system + rs.randint(0, cfg.vocab_size, 2).tolist()]
+    second = [system + rs.randint(0, cfg.vocab_size, 3 + i).tolist()
+              for i in range(3)]
+
+    def staged(engine):
+        server = Server(engine, num_blocks=48, block_size=8)
+        reqs = [server.submit(p, max_new_tokens=5) for p in first]
+        server.run_until_idle()   # retired: residue backs later hits
+        reqs += [server.submit(p, max_new_tokens=5) for p in second]
+        server.run_until_idle()
+        assert server.kv.allocator.num_used == 0
+        return [r.result(timeout=0) for r in reqs], server
+
+    ref, _ = staged(_eng(cfg, params))
+    spec = SpecDecoder(_eng(cfg, params), _eng(cfg, params), k=3)
+    out, server = staged(spec)
+    assert out == ref
+    assert server.metrics.snapshot()["prefix_hit_requests"] > 0
+
+
+def test_spec_bit_identical_after_preemption_and_slot_reuse(tiny):
+    """Preempt-during-verify coverage: a pool the batch outgrows forces
+    evictions in the SAME steps that run propose-verify rounds; the
+    recompute (and the reused slots' spec rounds) stay bit-identical."""
+    cfg, params, divergent = tiny
+    rs = np.random.RandomState(1)
+    prompts = [rs.randint(0, cfg.vocab_size, 5).tolist() for _ in range(3)]
+    ref, _ = _run_server(_eng(cfg, params), prompts, 6,
+                         num_blocks=9, block_size=2)
+    spec = SpecDecoder(_eng(cfg, params), _eng(cfg, divergent), k=2,
+                       adaptive=False)
+    out, server = _run_server(spec, prompts, 6, num_blocks=9, block_size=2)
+    assert out == ref
+    assert server.metrics.snapshot()["preemptions"] > 0
+
+
+def test_spec_deadline_expiry_mid_flight(tiny, spec_self):
+    cfg, params, _ = tiny
+    server = Server(spec_self, num_blocks=48, block_size=8)
+    dead = server.submit([1, 2, 3, 4, 5], max_new_tokens=4, deadline_s=-1.0)
+    live = server.submit([1, 2, 3, 4, 5], max_new_tokens=4)
+    server.run_until_idle()
+    assert dead.error is not None
+    assert live.error is None
+    assert server.kv.allocator.num_used == 0
+
+
+def test_spec_off_probe_resync_recovers(tiny):
+    """Speculation forced OFF goes stale (draft unfed while the target
+    advances); the probe round's resync re-mirrors through the draft's
+    prefill machinery and a perfect probe re-enables speculation —
+    output bit-identical throughout."""
+    cfg, params, _ = tiny
+    prompts = _mixed_prompts(cfg, seed=3, n=4)
+    ref, _ = _run_server(_eng(cfg, params), prompts, 10)
+    spec = SpecDecoder(_eng(cfg, params), _eng(cfg, params),
+                       controller=SpecKController(k=2, probe_every=3))
+    spec.controller.k = 0  # force off, as a zero-acceptance run would
+    out, server = _run_server(spec, prompts, 10)
+    assert out == ref
+    assert spec.controller.k >= 1, "perfect probe should re-enable"
+    snap = server.metrics.snapshot()
+    assert snap["spec_rounds"] < snap["decode_rounds"]  # off rounds ran
+    assert snap["spec_accepted"] > 0  # post-resync proposals landed
+
+
+def test_spec_cancel_with_proposed_tokens_in_flight(tiny, spec_self):
+    """A cancel arriving while a propose-verify round is executing lands
+    at the next step boundary: the cancelled handle settles, the
+    survivor's output is unaffected, nothing leaks."""
+    cfg, params, _ = tiny
+    ref, _ = _run_server(_eng(cfg, params), [[7, 11, 2]], 12)
+    server = Server(spec_self, num_blocks=48, block_size=8)
+    server.start()
+    try:
+        victim = server.submit([9, 8, 7], max_new_tokens=40)
+        keeper = server.submit([7, 11, 2], max_new_tokens=12)
+        time.sleep(0.05)  # let rounds (with proposals) get in flight
+        server.cancel(victim.req_id)
+        out = keeper.result(timeout=120)
+    finally:
+        server.stop()
+    assert out == ref[0]
+    assert victim.done.wait(10)
+    assert victim.status in ("cancelled", "ok")  # ok iff it outran us
+    if victim.status == "cancelled":
+        assert isinstance(victim.error, Cancelled)
+    assert server.kv.allocator.num_used == 0
+
+
+def test_spec_abandon_round_on_replica_failure(tiny):
+    cfg, params, _ = tiny
+    spec = SpecDecoder(_eng(cfg, params), _eng(cfg, params), k=2)
+    server = Server(spec, num_blocks=48, block_size=8)
+    req = server.submit([1, 2, 3], max_new_tokens=8)
+    server.step()  # prefill
+    # Simulate dying between run_round and commit_round.
+    outs, _ = spec.run_round(server.scheduler.running)
+    assert spec._pending is not None
+    server.fail()
+    assert spec._pending is None  # _fail_all abandoned the round
+    assert req.error is not None
+    # The pair is reusable by a fresh incarnation: a new server
+    # re-prefills and decodes bit-identically.
+    ref, _ = _run_server(_eng(cfg, params), [[4, 5, 6]], 5)
+    out, _ = _run_server(spec, [[4, 5, 6]], 5)
+    assert out == ref
+
+
+def test_spec_layout_validation(tiny):
+    cfg, params, _ = tiny
+    with pytest.raises(ValueError, match="slot layout"):
+        SpecDecoder(_eng(cfg, params, max_batch=4),
+                    _eng(cfg, params, max_batch=2))
+    small = ServeEngine.from_llama(cfg, params, max_batch=4, cache_len=32)
+    with pytest.raises(ValueError, match="slot layout"):
+        SpecDecoder(_eng(cfg, params), small)
+    with pytest.raises(ValueError, match="prefill_width"):
+        SpecDecoder(_eng(cfg, params),
+                    ServeEngine.from_llama(cfg, params, max_batch=4,
+                                           cache_len=64, prefill_width=1))
+
+
+def test_spec_round_protocol_misuse_raises(tiny):
+    cfg, params, _ = tiny
+    spec = SpecDecoder(_eng(cfg, params), _eng(cfg, params), k=2)
+    with pytest.raises(RuntimeError, match="without a pending round"):
+        spec.commit_round({})
+
+
+# ---- no-draft byte-identity ---------------------------------------------
+
+def test_no_draft_engine_path_untouched(tiny):
+    """The PR 13 idiom, applied here: without a SpecDecoder the Server
+    holds the engine ITSELF (is-level) and the engine never builds the
+    spec programs — the plain path is byte-identical to pre-spec."""
+    cfg, params, _ = tiny
+    eng = _eng(cfg, params)
+    server = Server(eng, num_blocks=16, block_size=8)
+    assert server.engine is eng
+    server.submit([1, 2, 3], max_new_tokens=3)
+    server.run_until_idle()
+    assert eng._verify_jit is None and eng._rollback_jit is None
+    assert set(eng.compile_counts()) == {"prefill", "decode",
+                                         "copy_prefix"}
+    snap = server.metrics.snapshot()
+    assert snap["spec_rounds"] == 0 and snap["spec_proposed"] == 0
+    assert snap["tokens_per_target_step"] == 1.0
+    # No spec gauges registered for a plain engine.
+    assert "serve_spec_acceptance_rate" not in \
+        server.metrics.registry.varz()["metrics"]
+
+
+# ---- observability -------------------------------------------------------
+
+def test_spec_spans_and_breakdown(tiny, tmp_path):
+    """spec_propose/spec_verify spans are balanced (real durations) and
+    consumed by the request breakdown: per-request decode time splits
+    into draft and verify halves."""
+    import json
+
+    from tpucfn.obs.aggregate import request_breakdown
+    from tpucfn.obs.trace import Tracer
+
+    cfg, params, _ = tiny
+    spec = SpecDecoder(_eng(cfg, params), _eng(cfg, params), k=2)
+    tracer = Tracer(tmp_path, host_id=0, role="server")
+    server = Server(spec, num_blocks=48, block_size=8, tracer=tracer)
+    reqs = [server.submit([5, 4, 3, 2, 1], max_new_tokens=8)]
+    server.run_until_idle()
+    tracer.close()
+    assert reqs[0].error is None
+    events = []
+    for f in tmp_path.glob("trace-*.jsonl"):
+        events += [json.loads(ln) for ln in f.read_text().splitlines()]
+    spans = {e["name"] for e in events if e.get("kind") == "span"}
+    assert {"spec_propose", "spec_verify", "decode_round"} <= spans
+    for e in events:
+        if e.get("kind") == "span" and e["name"].startswith("spec_"):
+            assert e["dur_s"] > 0.0  # balanced, not a zero-width stub
+    rows, agg = request_breakdown(events)
+    assert rows and rows[0]["spec_propose_s"] > 0.0
+    assert rows[0]["spec_verify_s"] > 0.0
+    assert "spec_propose_s" in agg and "spec_verify_s" in agg
+
+
+def test_spec_flight_ring_carries_round_shape(tiny):
+    from tpucfn.obs.flight import FlightRecorder
+
+    cfg, params, _ = tiny
+    flight = FlightRecorder(host_id=0, role="server")
+    spec = SpecDecoder(_eng(cfg, params), _eng(cfg, params), k=2)
+    server = Server(spec, num_blocks=48, block_size=8, flight=flight)
+    server.submit([3, 2, 1], max_new_tokens=4)
+    server.run_until_idle()
+    decode_samples = [s for s in flight.snapshot()["samples"]
+                      if s.get("kind") == "sched"
+                      and s.get("work") == "decode"]
+    assert decode_samples
+    assert any(s.get("spec") == "spec" and s.get("proposed", 0) > 0
+               for s in decode_samples)
+
+
+def test_spec_mixed_temperature_batch(tiny, spec_self):
+    """A sampled request riding a spec batch accepts no proposals
+    (budget 1 — greedy verification would change its distribution),
+    while its greedy batch-mates stay bit-identical to the plain run."""
+    cfg, params, _ = tiny
+    greedy = [[5, 9, 2], [7, 1, 3, 8]]
+    ref, _ = _run_server(_eng(cfg, params), greedy, 6)
+
+    def submit_mixed(engine):
+        server = Server(engine, num_blocks=48, block_size=8)
+        reqs = [server.submit(p, max_new_tokens=6) for p in greedy]
+        sampled = server.submit([2, 4, 6], max_new_tokens=6,
+                                temperature=0.9)
+        server.run_until_idle()
+        assert server.kv.allocator.num_used == 0
+        return [r.result(timeout=0) for r in reqs], sampled
+
+    outs, sampled = submit_mixed(spec_self)
+    assert outs == ref
+    assert sampled.error is None and len(sampled.tokens) == 6

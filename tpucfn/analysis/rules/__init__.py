@@ -60,9 +60,10 @@ ALL_RULES: dict[str, Rule] = {r.id: r for r in (
     Rule("registry-cardinality",
          "no metric name family formatted with a fleet-scaled loop "
          "variable — aggregate, or use a label",
-         "PR 8 router_replica_state_{i} per-replica names (baselined: "
-         "CLI-bounded count); the input service (ISSUE 11) is the "
-         "surface that would ship this at fleet scale",
+         "PR 8 router_replica_state_{i} per-replica names (migrated to "
+         "aggregates in ISSUE 14 — zero baseline entries); the input "
+         "service (ISSUE 11) is the surface that would ship this at "
+         "fleet scale",
          cardinality.check),
     Rule("jax-hazards",
          "no donated-buffer read after the jitted call that donated it; "

@@ -17,12 +17,12 @@ fleet-scaled by construction; f-strings over constants or config
 attributes (``f"{prefix}_depth"``) stay silent, as does every
 aggregate registration.
 
-The shipped ``router_replica_state_{i}`` family (PR 8) fires here by
-design — it is exactly the shape this rule exists to catch — and is
-baselined with a justification (replica count is a small CLI-bounded
-constant with slot-stable indices), which is the escape hatch's job:
-visible, justified, and re-litigated the moment the baseline goes
-stale.
+The shipped ``router_replica_state_{i}`` family (PR 8) fired here by
+design — it was exactly the shape this rule exists to catch — and
+lived behind a justified baseline entry until ISSUE 14 migrated it to
+the ``router_replica_state_worst`` / ``router_replicas_routable``
+aggregates and deleted the entry: the escape hatch's whole lifecycle
+(visible, justified, re-litigated, retired) on one finding.
 """
 
 from __future__ import annotations

@@ -599,6 +599,41 @@ def cmd_compilecache_serve(args) -> int:
     return 0
 
 
+def _parse_bytes(s: str) -> int:
+    """'512M', '2G', '100K', or a plain byte count."""
+    s = s.strip()
+    mult = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30}.get(s[-1:].lower())
+    if mult is not None:
+        return int(float(s[:-1]) * mult)
+    return int(s)
+
+
+def cmd_compilecache_gc(args) -> int:
+    """Cap a compile-artifact store at ``--max-bytes`` (ISSUE 14
+    satellite): live entries evict LRU by meta atime (reads touch it),
+    claimed keys are never evicted, racing publishers' orphan payloads
+    and stale tmp files older than ``--orphan-age`` sweep out.  Prints
+    the stats JSON line; jax-free (safe from cron on any host sharing
+    the dir)."""
+    import json as _json
+
+    from tpucfn.compilecache.store import ArtifactStore, default_store_dir
+
+    store = ArtifactStore(args.dir or default_store_dir())
+    try:
+        max_bytes = _parse_bytes(args.max_bytes)
+        if max_bytes < 0:
+            raise ValueError(max_bytes)
+    except ValueError:
+        print(f"error: bad --max-bytes {args.max_bytes!r} "
+              "(use a non-negative N, NK, NM, or NG)", file=sys.stderr)
+        return 2
+    stats = store.gc(max_bytes, orphan_age_s=args.orphan_age)
+    print(_json.dumps({"dir": str(store.dir), "max_bytes": max_bytes,
+                       **stats}))
+    return 0
+
+
 def cmd_compilecache_stats(args) -> int:
     """Query a running artifact server's stats (entries, live claims,
     fleet identity) — the operator's is-the-warm-start-plane-working
@@ -626,7 +661,13 @@ def cmd_serve(args) -> int:
     ``--replicas N`` (ISSUE 9) runs N engine replicas behind a
     :class:`~tpucfn.serve.router.ReplicaRouter` — health-driven
     failover, deadline-budgeted retry (``--retry-budget``), optional
-    hedging (``--hedge-ms``), graceful drain on SIGTERM."""
+    hedging (``--hedge-ms``), graceful drain on SIGTERM.
+
+    ``--spec-draft PRESET`` (ISSUE 14) pairs each engine (or the
+    ``--spec-replicas`` subset) with a draft engine for speculative
+    decoding: greedy output stays bit-identical, throughput rides the
+    measured acceptance rate, and the adaptive controller bounds the
+    worst case at plain decode plus an amortized probe."""
     import json as _json
     import signal as _signal
 
@@ -655,6 +696,42 @@ def cmd_serve(args) -> int:
                                     max_batch=args.max_batch,
                                     cache_len=args.cache_len,
                                     prefill_width=args.max_prefill_batch)
+
+    # Speculative decoding (ISSUE 14): each selected engine is paired
+    # with its OWN draft engine (per-replica caches) at the target's
+    # exact slot layout.  Unset ⇒ spec_set is empty and every engine is
+    # the plain object itself — the byte-identical default.
+    spec_set: set = set()
+    if args.spec_draft:
+        spec_set = set(range(max(args.replicas, 1)))
+        if args.spec_replicas:
+            spec_set = {int(t) for t in args.spec_replicas.split(",")
+                        if t.strip()}
+            bad = [i for i in spec_set if not 0 <= i < args.replicas]
+            if bad:
+                print(f"error: --spec-replicas {bad} outside "
+                      f"0..{args.replicas - 1}", file=sys.stderr)
+                return 2
+
+    def _maybe_spec(i, eng):
+        if i not in spec_set:
+            return eng
+        from tpucfn.serve.spec import SpecDecoder
+
+        if args.spec_draft == "self":
+            draft = ServeEngine.from_llama(
+                cfg, engine.params, max_batch=args.max_batch,
+                cache_len=eng.cache_len,
+                prefill_width=args.max_prefill_batch)
+        else:
+            _, draft = demo_llama_engine(
+                args.spec_draft,
+                seed=(args.seed if args.spec_draft_seed is None
+                      else args.spec_draft_seed),
+                max_batch=args.max_batch, cache_len=eng.cache_len,
+                prefill_width=args.max_prefill_batch)
+        return SpecDecoder(eng, draft, k=args.spec_k,
+                           adaptive=args.spec_adaptive)
 
     rs = np.random.RandomState(args.seed)
     if args.prompts:
@@ -740,6 +817,10 @@ def cmd_serve(args) -> int:
                                        cache_len=args.cache_len,
                                        prefill_width=args.max_prefill_batch)
                 for _ in range(args.replicas - 1)]
+            # Wrapped OUTSIDE the factory so a probation relaunch
+            # reuses the same engine pair (and its jit caches) instead
+            # of recompiling a fresh draft.
+            engines = [_maybe_spec(i, e) for i, e in enumerate(engines)]
 
             class _FlightTee:
                 """Replica samples land in the replica's OWN ring (what
@@ -786,7 +867,8 @@ def cmd_serve(args) -> int:
                 hedge_ms=args.hedge_ms, slo_shed=args.slo_shed,
                 drain_grace_s=args.drain_grace)
         else:
-            server = Server(engine, num_blocks=args.num_blocks,
+            server = Server(_maybe_spec(0, engine),
+                            num_blocks=args.num_blocks,
                             block_size=args.block_size,
                             max_queued_tokens=args.max_queued_tokens,
                             registry=registry, tracer=tracer,
@@ -1017,10 +1099,15 @@ def cmd_obs(args) -> int:
                                 "detail"], float_fmt="{:.3f}"))
         if report["requests"]:
             print("\n== request latency breakdown ==")
-            print(render_table(
-                report["requests"],
-                ["host", "request", "queue_wait_s", "prefill_s", "decode_s",
-                 "ttft_s", "total_s", "generated", "outcome"]))
+            cols = ["host", "request", "queue_wait_s", "prefill_s",
+                    "decode_s", "ttft_s", "total_s", "generated", "outcome"]
+            if any(r.get("spec_propose_s") or r.get("spec_verify_s")
+                   for r in report["requests"]):
+                # Speculative rounds ran (ISSUE 14): show the decode
+                # split — the read side of the spec_propose/spec_verify
+                # spans, same contract as the control timeline.
+                cols[5:5] = ["spec_propose_s", "spec_verify_s"]
+            print(render_table(report["requests"], cols))
             agg = report["request_aggregate"]
             print(f"\n{agg['completed']}/{agg['requests']} completed; "
                   "p50/p95 (s): " + "  ".join(
@@ -1776,6 +1863,22 @@ def build_parser() -> argparse.ArgumentParser:
                      help="exit cleanly after this long (0 = until "
                           "SIGTERM)")
     ccs.set_defaults(fn=cmd_compilecache_serve)
+    ccg = ccsub.add_parser(
+        "gc",
+        help="cap a store dir at --max-bytes: LRU eviction by meta "
+             "atime, claimed keys kept, orphan payloads swept")
+    ccg.add_argument("--dir", metavar="DIR",
+                     help="store dir (default: TPUCFN_COMPILE_CACHE_DIR "
+                          "or the persistent-XLA-cache sibling)")
+    ccg.add_argument("--max-bytes", required=True, metavar="N[KMG]",
+                     help="live-entry byte cap (0 = evict everything "
+                          "unclaimed)")
+    ccg.add_argument("--orphan-age", type=float, default=3600.0,
+                     metavar="SECONDS",
+                     help="age before unreferenced payloads / tmp files "
+                          "are swept (younger may be an in-flight "
+                          "publish)")
+    ccg.set_defaults(fn=cmd_compilecache_gc)
     cct = ccsub.add_parser(
         "stats", help="query a running artifact server's stats")
     cct.add_argument("--addr", required=True, metavar="HOST:PORT")
@@ -1785,7 +1888,8 @@ def build_parser() -> argparse.ArgumentParser:
         "serve",
         help="continuous-batching inference over a prompt workload "
              "(paged KV cache, bucketed prefills, admission control)")
-    sv.add_argument("--preset", choices=["tiny", "llama3-1b", "llama3-8b"],
+    sv.add_argument("--preset",
+                    choices=["nano", "tiny", "llama3-1b", "llama3-8b"],
                     default="tiny")
     sv.add_argument("--prompts",
                     help='JSONL file of {"tokens": [ids...]} prompts')
@@ -1846,6 +1950,33 @@ def build_parser() -> argparse.ArgumentParser:
                     help="SIGTERM drain window: admission closes and "
                          "accepted work gets this long to finish before "
                          "being failed/requeued")
+    sv.add_argument("--spec-draft", metavar="PRESET",
+                    choices=["self", "nano", "tiny", "llama3-1b",
+                             "llama3-8b"],
+                    help="speculative decoding: pair each engine with a "
+                         "DRAFT engine of this preset at the same slot "
+                         "layout ('self' = same preset and weights — the "
+                         "acceptance-rate drill).  Greedy output is "
+                         "bit-identical to plain decode; unset = the "
+                         "plain engine path, byte-identical")
+    sv.add_argument("--spec-k", type=int, default=4, metavar="K",
+                    help="draft tokens proposed per slot per round (the "
+                         "adaptive controller's ceiling)")
+    sv.add_argument("--spec-adaptive", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="acceptance-driven k controller: shrink toward 1 "
+                         "when the measured acceptance rate drops, turn "
+                         "speculation off (with periodic probes) below "
+                         "that (--no-spec-adaptive pins k)")
+    sv.add_argument("--spec-draft-seed", type=int, default=None,
+                    help="draft init seed for random-init draft presets "
+                         "(default: --seed, which for the same preset "
+                         "means identical weights)")
+    sv.add_argument("--spec-replicas", metavar="I,J,...",
+                    help="with --replicas N: comma-separated replica "
+                         "indices that decode speculatively (default all) "
+                         "— the router mixes spec and plain replicas "
+                         "freely because greedy output is identical")
     sv.add_argument("--seed", type=int, default=0)
     sv.add_argument("--obs-port", type=int, default=None, metavar="PORT",
                     help="serve /metrics, /healthz, /varz on PORT while the "

@@ -162,6 +162,12 @@ class ArtifactStore:
                 raise OSError("meta names no payload")
             payload = bin_path.read_bytes()
         except OSError:
+            if not self._meta_path(key).exists():
+                # Concurrent eviction (gc unlinks meta first, then bin):
+                # we loaded the meta just before it went.  The entry is
+                # GONE, not corrupt — a clean miss, exactly what a
+                # reader arriving a moment later would see.
+                return None
             self.quarantine(key)
             raise CacheCorrupt(
                 f"artifact {key} meta present but payload unreadable "
@@ -171,6 +177,13 @@ class ArtifactStore:
             raise CacheCorrupt(
                 f"artifact {key} payload fails its recorded sha256 in "
                 f"{self.dir} — quarantined, treating as a miss")
+        # Mark recency for LRU eviction (gc orders by meta atime):
+        # relatime mounts update atime at most daily, which would make
+        # a hot entry look cold — touch it explicitly on every hit.
+        try:
+            os.utime(self._meta_path(key))
+        except OSError:
+            pass
         if self.device_kind and meta.get("device_kind") \
                 and meta["device_kind"] != self.device_kind:
             raise CacheMismatch(
@@ -244,6 +257,98 @@ class ArtifactStore:
                     p.replace(qdir / f"{p.name}.{stamp}")
                 except OSError:
                     pass
+
+    def gc(self, max_bytes: int, *, orphan_age_s: float = 3600.0) -> dict:
+        """Cap the store at ``max_bytes`` of live entries, LRU by meta
+        atime (``get`` touches it on every hit), and sweep debris
+        (ISSUE 14 satellite — shared long-lived dirs accumulate one
+        entry per program per jax version forever, and the draft-engine
+        programs of speculative serving double the rate):
+
+        * live entries (meta + its payload) evict oldest-read first
+          until the live total fits ``max_bytes`` — a key with a LIVE
+          ``.claim`` lockfile is NEVER evicted (a compiler owns it right
+          now; its publish must not race a deletion);
+        * orphan payloads (hash-named bins no meta points at — racing
+          publishers' losers) and stale ``.tmp`` files older than
+          ``orphan_age_s`` are removed outright (younger ones may be a
+          publish in flight: put() renames bin before meta);
+        * eviction removes the meta FIRST (the commit marker: readers
+          downgrade to a clean miss mid-eviction, never a torn entry).
+
+        Returns a stats dict; quarantined ``corrupt/`` forensics are
+        reported but never deleted (they exist to be looked at)."""
+        if max_bytes < 0:
+            raise ValueError(f"max_bytes must be >= 0, got {max_bytes}")
+        now = time.time()
+        entries = []  # (atime, key, size, meta_path, bin_path)
+        referenced: set[str] = set()
+        live_bytes = 0
+        for key in self.keys():
+            meta_path = self._meta_path(key)
+            # Stat BEFORE reading: on a strictatime mount the read
+            # below would stamp every meta with gc's own pass, erasing
+            # the very recency order this collects.
+            try:
+                st = meta_path.stat()
+            except OSError:
+                continue
+            size, atime = st.st_size, st.st_atime
+            meta = self.meta(key)
+            if meta is None:
+                continue
+            bin_path = self._bin_from_meta(key, meta)
+            if bin_path is not None:
+                referenced.add(bin_path.name)
+                try:
+                    size += bin_path.stat().st_size
+                except OSError:
+                    pass
+            entries.append((atime, key, size, meta_path, bin_path))
+            live_bytes += size
+        stats = {"entries": len(entries), "live_bytes": live_bytes,
+                 "evicted": 0, "evicted_bytes": 0, "kept_claimed": 0,
+                 "orphans_removed": 0, "orphan_bytes": 0,
+                 "corrupt_bytes": sum(
+                     p.stat().st_size
+                     for p in (self.dir / "corrupt").glob("*")
+                     if p.is_file()) if (self.dir / "corrupt").is_dir()
+                 else 0}
+        for atime, key, size, meta_path, bin_path in sorted(entries):
+            if live_bytes <= max_bytes:
+                break
+            if (self.dir / f"{key}.claim").exists():
+                stats["kept_claimed"] += 1
+                continue
+            for p in ([meta_path] + ([bin_path] if bin_path else [])):
+                try:
+                    p.unlink()
+                except OSError:
+                    pass
+            live_bytes -= size
+            stats["evicted"] += 1
+            stats["evicted_bytes"] += size
+        for p in self.dir.glob("*.bin"):
+            if p.name in referenced:
+                continue
+            try:
+                if now - p.stat().st_mtime <= orphan_age_s:
+                    continue
+                stats["orphan_bytes"] += p.stat().st_size
+                p.unlink()
+                stats["orphans_removed"] += 1
+            except OSError:
+                pass
+        for p in self.dir.glob(".*.tmp"):
+            try:
+                if now - p.stat().st_mtime > orphan_age_s:
+                    stats["orphan_bytes"] += p.stat().st_size
+                    p.unlink()
+                    stats["orphans_removed"] += 1
+            except OSError:
+                pass
+        stats["live_bytes_after"] = live_bytes
+        return stats
 
     def keys(self) -> list[str]:
         return sorted(p.name[: -len(".meta.json")]

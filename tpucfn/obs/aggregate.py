@@ -111,6 +111,7 @@ def request_breakdown(events: Iterable[dict]) -> tuple[list[dict], dict]:
             "host": host, "request": tid,
             "queue_wait_s": None, "prefill_s": None,
             "re_prefill_s": 0.0, "decode_s": 0.0, "decode_rounds": 0,
+            "spec_propose_s": 0.0, "spec_verify_s": 0.0,
             "ttft_s": None, "total_s": None, "generated": None,
             "outcome": None})
 
@@ -124,7 +125,12 @@ def request_breakdown(events: Iterable[dict]) -> tuple[list[dict], dict]:
                 req(host, tid)["re_prefill_s"] += e["dur_s"]
             else:
                 req(host, tid)["prefill_s"] = e["dur_s"]
-        elif name == "decode_round":
+        elif name in ("decode_round", "spec_propose", "spec_verify"):
+            # Round-level spans (one per decode batch, fanned out to
+            # each member request below).  spec_propose/spec_verify are
+            # the propose-verify halves of a speculative round (ISSUE
+            # 14): per request, decode_s splits into draft time and
+            # target-verify time, so a TPOT regression names its layer.
             decode_rounds.append(e)
         elif name == "request_done" and tid is not None:
             r = req(host, tid)
@@ -135,7 +141,13 @@ def request_breakdown(events: Iterable[dict]) -> tuple[list[dict], dict]:
     for e in decode_rounds:
         for sid in e.get("attrs", {}).get("seqs", ()):
             key = (e.get("host"), sid)
-            if key in per_req:
+            if key not in per_req:
+                continue
+            if e.get("name") == "spec_propose":
+                per_req[key]["spec_propose_s"] += e["dur_s"]
+            elif e.get("name") == "spec_verify":
+                per_req[key]["spec_verify_s"] += e["dur_s"]
+            else:
                 per_req[key]["decode_s"] += e["dur_s"]
                 per_req[key]["decode_rounds"] += 1
     rows = [per_req[k] for k in sorted(per_req,
@@ -149,6 +161,13 @@ def request_breakdown(events: Iterable[dict]) -> tuple[list[dict], dict]:
         xs = sorted(r[part] for r in rows if r[part] is not None)
         agg[part] = {"p50": nearest_rank(xs, 50), "p95": nearest_rank(xs, 95),
                      "max": xs[-1] if xs else None}
+    for part in ("spec_propose_s", "spec_verify_s"):
+        # only when speculation ran — a plain run's aggregate is
+        # byte-identical to the pre-spec shape
+        xs = sorted(r[part] for r in rows if r[part])
+        if xs:
+            agg[part] = {"p50": nearest_rank(xs, 50),
+                         "p95": nearest_rank(xs, 95), "max": xs[-1]}
     return rows, agg
 
 

@@ -14,6 +14,10 @@ The serving counterpart of the training stack (ROADMAP north star:
 * :mod:`tpucfn.serve.router` — the resilient tier (ISSUE 9): N replica
   Servers behind health-driven failover, deadline-budgeted retry,
   hedging, and graceful drain.
+* :mod:`tpucfn.serve.spec` — speculative decoding (ISSUE 14): a draft
+  ``ServeEngine`` at the same slot layout proposes, the target verifies
+  k+1 positions per dispatch, greedy output stays bit-identical, and an
+  acceptance-driven controller bounds the worst case.
 
 CLI: ``tpucfn serve`` (see ``tpucfn/cli/main.py``); bench:
 ``benches/serve_bench.py``.
@@ -51,4 +55,8 @@ from tpucfn.serve.scheduler import (  # noqa: F401
     PrefillWork,
     Sequence,
     prefill_bucket,
+)
+from tpucfn.serve.spec import (  # noqa: F401
+    SpecDecoder,
+    SpecKController,
 )

@@ -34,6 +34,26 @@ multi-hundred-MB buffer is updated in place, never double-buffered):
   state, dead by the same write-before-read causality argument as the
   bucket padding.
 
+Two more entry points exist for speculative decoding (ISSUE 14) and are
+built LAZILY on first use, so an engine that never speculates carries
+exactly the three programs above and nothing else:
+
+* ``verify``: score ``width`` token positions for EVERY slot in one
+  dispatch — the propose-verify round's target-model half.  Each slot's
+  input row is its last emitted token followed by ``width - 1`` draft
+  proposals; position 0 is sampled exactly as ``decode`` samples (same
+  ``_sample``, same temps array, same key fold), positions 1+ are
+  greedy argmax (draft acceptance is defined for greedy decode only).
+  The pass is a peek: K/V rows gain the ``width`` new entries but every
+  ``cache_index`` is restored inside the program — ``rollback`` then
+  advances accepted slots to what actually landed.
+* ``rollback``: set selected slots' ``cache_index`` to given lengths
+  (masked — unselected slots, including free slots holding prefix-cache
+  residue, are untouched).  K/V written past the accepted position
+  stays in the buffer but is dead: the next step writes position
+  ``len`` before anything attends past it — the same causality argument
+  the bucket-pad rewind rests on.
+
 Sampling temperatures live in a DEVICE-resident ``(max_batch,)`` array
 updated inside the prefill program, so the steady-state decode loop
 transfers one token per active slot and nothing else (ISSUE 3
@@ -140,6 +160,11 @@ class ServeEngine:
         self._copy_prefix_jit = _maybe_warm(
             jax.jit(self._copy_prefix_impl, donate_argnums=(0,)),
             "serve_copy_prefix")
+        # Speculative-decoding programs (ISSUE 14), built on first use so
+        # a plain engine's program set (and compile_counts surface) is
+        # byte-identical to the pre-spec engine's.
+        self._verify_jit = None
+        self._rollback_jit = None
 
     @classmethod
     def from_llama(cls, cfg, params, *, max_batch: int = 8,
@@ -206,6 +231,58 @@ class ServeEngine:
 
         logits, new_cache = jax.vmap(one)(cache, tokens)
         return _sample(logits.astype(jnp.float32), temps, key), new_cache
+
+    def _verify_impl(self, cache, params, tokens, temps, key):
+        """tokens (B, W) int32 -> (out (B, W) int32, cache).  Every slot
+        scores all W positions in one pass: out[:, 0] is sampled exactly
+        as ``_decode_impl`` samples (bit-identical for greedy — the
+        propose-verify correctness anchor), out[:, 1:] is greedy argmax
+        (speculative acceptance is defined for greedy decode only).
+
+        The pass is a PEEK: K/V rows gain the W new entries but every
+        ``cache_index`` is restored to its pre-verify value before the
+        cache is returned — the caller then ADVANCES accepted slots via
+        :meth:`rollback`.  Restoring inside the program matters for the
+        slots NOT in the round: a free slot's residue still backs
+        prefix-cache hits, and letting its index creep up by W per
+        round would eventually clamp this pass's writes back INTO the
+        residue region (``dynamic_update_slice`` clamps at capacity) —
+        corrupting bytes the scheduler still points at."""
+
+        def one(cache_row, toks):
+            logits, row = self._apply_one(params, cache_row, toks[None])
+            return logits[0], row
+
+        logits, new_cache = jax.vmap(one)(cache, tokens)
+
+        def keep_index(path, new, old):
+            if _path_str(path).endswith("cache_index"):
+                return old
+            return new
+
+        new_cache = jax.tree_util.tree_map_with_path(
+            keep_index, new_cache, cache)
+        logits = logits.astype(jnp.float32)
+        first = _sample(logits[:, 0], temps, key)
+        rest = jnp.argmax(logits[:, 1:], axis=-1).astype(jnp.int32)
+        return jnp.concatenate([first[:, None], rest], axis=1), new_cache
+
+    def _rollback_impl(self, cache, lens, mask):
+        """Set ``cache_index`` of masked slots to ``lens``; unmasked
+        slots (vacant, or free slots backing prefix hits with residue)
+        keep theirs.  K/V past the new index is dead by the standard
+        write-before-read argument."""
+
+        def fix(path, leaf):
+            if _path_str(path).endswith("cache_index"):
+                shape = (-1,) + (1,) * (leaf.ndim - 1)
+                tgt = jnp.broadcast_to(
+                    lens.reshape(shape), leaf.shape).astype(leaf.dtype)
+                m = jnp.broadcast_to(mask.reshape(shape), leaf.shape)
+                return jnp.where(m, tgt, leaf)
+            return leaf
+
+        return jax.tree_util.tree_map_with_path(fix, cache)
 
     def _copy_prefix_impl(self, cache, src, dst, n):
         """Plant slot ``src``'s row into slot ``dst`` with cache_index
@@ -311,6 +388,62 @@ class ServeEngine:
         nxt = np.asarray(nxt)
         return {slot: int(nxt[slot]) for slot in tokens_by_slot}
 
+    def _ensure_spec_jits(self) -> None:
+        if self._verify_jit is None:
+            self._verify_jit = _maybe_warm(
+                jax.jit(self._verify_impl, donate_argnums=(0,)),
+                "serve_verify")
+            self._rollback_jit = _maybe_warm(
+                jax.jit(self._rollback_impl, donate_argnums=(0,)),
+                "serve_rollback")
+
+    def verify(self, tokens_by_slot: dict[int, list[int]],
+               width: int) -> dict[int, list[int]]:
+        """One multi-token verify dispatch: each ACTIVE slot's row is
+        its last emitted token plus ``width - 1`` proposed tokens, all
+        padded to the fixed ``width`` (one compile per width).  Returns
+        the target model's ``width`` next-token verdicts per active
+        slot; vacant slots run dead lanes.  The pass is a PEEK: K/V
+        rows gain the ``width`` new entries but every ``cache_index``
+        comes back unchanged (see ``_verify_impl`` for why that is
+        load-bearing) — the caller then ADVANCES each active slot to
+        its accepted length via :meth:`rollback` before the next engine
+        call touches it."""
+        if width < 1:
+            raise ValueError(f"verify width must be >= 1, got {width}")
+        self._ensure_spec_jits()
+        toks = np.zeros((self.max_batch, width), np.int32)
+        for slot, run in tokens_by_slot.items():
+            if len(run) != width:
+                raise ValueError(
+                    f"slot {slot}: run of {len(run)} tokens vs width "
+                    f"{width}")
+            toks[slot] = np.asarray(run, np.int32)
+        out, self.cache = self._verify_jit(
+            self.cache, self.params, jnp.asarray(toks), self._temps,
+            self._next_key())
+        out = np.asarray(out)
+        return {slot: [int(t) for t in out[slot]]
+                for slot in tokens_by_slot}
+
+    def rollback(self, lengths_by_slot: dict[int, int]) -> None:
+        """Repair ``cache_index`` after a verify (or a draft's proposal
+        run) over-advanced it: each listed slot's index is set to its
+        accepted cache length; every other slot is untouched."""
+        if not lengths_by_slot:
+            return
+        self._ensure_spec_jits()
+        lens = np.zeros((self.max_batch,), np.int32)
+        mask = np.zeros((self.max_batch,), bool)
+        for slot, n in lengths_by_slot.items():
+            if not 0 <= n <= self.cache_len:
+                raise ValueError(
+                    f"rollback length {n} outside [0, {self.cache_len}]")
+            lens[slot] = n
+            mask[slot] = True
+        self.cache = self._rollback_jit(
+            self.cache, jnp.asarray(lens), jnp.asarray(mask))
+
     def compile_counts(self) -> dict[str, int]:
         """Compiled-program counts per entry point — the compile-budget
         contract (len(prefill buckets) + 1 decode + 1 copy_prefix) a
@@ -322,14 +455,31 @@ class ServeEngine:
             except Exception:  # pragma: no cover - jax internals moved
                 return -1
 
-        return {"prefill": n(self._prefill_jit),
-                "decode": n(self._decode_jit),
-                "copy_prefix": n(self._copy_prefix_jit)}
+        counts = {"prefill": n(self._prefill_jit),
+                  "decode": n(self._decode_jit),
+                  "copy_prefix": n(self._copy_prefix_jit)}
+        # Spec programs only exist once verify/rollback ran — a plain
+        # engine's surface stays exactly the three entries above.
+        if self._verify_jit is not None:
+            counts["verify"] = n(self._verify_jit)
+            counts["rollback"] = n(self._rollback_jit)
+        return counts
 
 
 # Named Llama configs for the demo/bench surfaces (one source of truth
-# for `tpucfn serve --preset` and `benches/serve_bench.py`).
-LLAMA_PRESETS = ("tiny", "llama3-1b", "llama3-8b")
+# for `tpucfn serve --preset` and `benches/serve_bench.py`).  "nano" is
+# the draft-model demo size (ISSUE 14): a deliberately-smaller decoder
+# for `--spec-draft` whose per-step cost is a fraction of tiny's.
+LLAMA_PRESETS = ("nano", "tiny", "llama3-1b", "llama3-8b")
+
+
+def _nano_config():
+    import dataclasses as _dc
+
+    from tpucfn.models.llama import LlamaConfig
+
+    return _dc.replace(LlamaConfig.tiny(), dim=32, n_layers=1, n_heads=2,
+                       n_kv_heads=1, ffn_dim=64)
 
 
 def demo_llama_engine(preset: str, *, seed: int = 0, max_batch: int = 8,
@@ -342,7 +492,8 @@ def demo_llama_engine(preset: str, *, seed: int = 0, max_batch: int = 8,
 
     from tpucfn.models.llama import Llama, LlamaConfig
 
-    ctors = {"tiny": LlamaConfig.tiny, "llama3-1b": LlamaConfig.llama3_1b,
+    ctors = {"nano": _nano_config, "tiny": LlamaConfig.tiny,
+             "llama3-1b": LlamaConfig.llama3_1b,
              "llama3-8b": LlamaConfig.llama3_8b}
     cfg = ctors[preset]()
     params = Llama(cfg).init(jax.random.key(seed),

@@ -193,6 +193,31 @@ class ServingMetrics:
             "serve_slo_shed_total",
             "requests shed at submit because the SLO burn rate was "
             "sustained above 1 (--slo-shed)")
+        # Decode-round economics (ISSUE 14): tokens / slot_steps is the
+        # per-slot tokens-per-target-step — exactly 1.0 for plain
+        # decode, acceptance-driven above 1 with --spec-draft.
+        self.decode_rounds = r.counter(
+            "serve_decode_rounds_total",
+            "decode rounds dispatched (one target verify or decode "
+            "program each)")
+        self.decode_slot_steps = r.counter(
+            "serve_decode_slot_steps_total",
+            "active slot-steps across decode rounds (one per running "
+            "slot per round)")
+        self.decode_tokens = r.counter(
+            "serve_decode_tokens_total",
+            "tokens emitted by decode rounds (excludes prefill's first "
+            "tokens)")
+        self.spec_rounds = r.counter(
+            "serve_spec_rounds_total",
+            "decode rounds that ran propose-verify (speculation on and "
+            "proposing; off/probe-idle rounds excluded)")
+        self.spec_proposed = r.counter(
+            "serve_spec_proposed_total",
+            "draft tokens proposed to greedy slots")
+        self.spec_accepted = r.counter(
+            "serve_spec_accepted_total",
+            "proposed draft tokens the target verified and emitted")
         self.queue_depth = r.gauge(
             "serve_queue_depth", "requests waiting (frontend + scheduler)")
         self.running = r.gauge(
@@ -220,6 +245,18 @@ class ServingMetrics:
             "prefix_hit_requests": self.prefix_hit_requests.value,
             "prefix_hit_tokens": self.prefix_hit_tokens.value,
             "prefill_batch_size": self.prefill_batch_size.snapshot(),
+            "decode_rounds": self.decode_rounds.value,
+            "decode_slot_steps": self.decode_slot_steps.value,
+            "decode_tokens": self.decode_tokens.value,
+            "tokens_per_target_step": (
+                self.decode_tokens.value / self.decode_slot_steps.value
+                if self.decode_slot_steps.value else None),
+            "spec_rounds": self.spec_rounds.value,
+            "spec_proposed": self.spec_proposed.value,
+            "spec_accepted": self.spec_accepted.value,
+            "spec_acceptance_rate": (
+                self.spec_accepted.value / self.spec_proposed.value
+                if self.spec_proposed.value else None),
             "slo_shed": self.slo_shed.value,
             "queue_depth": self.queue_depth.value,
             "running_sequences": self.running.value,
@@ -454,6 +491,19 @@ class Server:
             cache_len=engine.cache_len, eos_id=eos_id,
             max_prefill_batch=k, flight=flight)
         self.metrics = ServingMetrics(registry)
+        if getattr(engine, "spec_enabled", False):
+            # Live acceptance-rate observability (ISSUE 14): the same
+            # windowed rate the k-controller acts on, scrapeable — a
+            # burn-rate dashboard next to a falling acceptance rate is
+            # the whole speculative-decode story in two series.
+            ctl = engine.controller
+            self.metrics.registry.computed_gauge(
+                "serve_spec_acceptance_rate", ctl.acceptance_rate,
+                "windowed draft-token acceptance rate (the k-controller's "
+                "shrink/grow signal)")
+            self.metrics.registry.computed_gauge(
+                "serve_spec_k", lambda: float(ctl.k),
+                "current proposal depth k (0 = speculation off, probing)")
         self.slo = SLOTracker(self.metrics.registry, ttft_slo_s=ttft_slo_s,
                               tpot_slo_s=tpot_slo_s,
                               objective=slo_objective,
@@ -776,6 +826,59 @@ class Server:
                 self.metrics.generated_tokens.add()
                 self._finish(
                     self.scheduler.record_prefill(it.slot, toks[it.slot]))
+        elif getattr(self.engine, "spec_enabled", False):
+            # Propose-verify round (ISSUE 14): the draft proposes k
+            # tokens per slot, ONE target dispatch verifies k+1
+            # positions, and the scheduler records the accepted run —
+            # truncating on EOS/max_new or a dry pool, after which
+            # commit_round repairs both caches to what actually landed.
+            t_dec0 = time.monotonic()
+            outs, st = self.engine.run_round(work.slots)
+            work.proposed = outs
+            recorded: dict[int, int] = {}
+            emitted = 0
+            for slot, toks in outs.items():
+                seq = work.slots[slot]
+                fin, n = self.scheduler.record_decode_tokens(slot, toks)
+                recorded[slot] = len(seq.prompt) + len(seq.generated) - 1
+                emitted += n
+                self.metrics.generated_tokens.add(n)
+                self.metrics.decode_tokens.add(n)
+                self._finish(fin)
+            self.engine.commit_round(recorded)
+            t_dec1 = time.monotonic()
+            self.metrics.decode_rounds.add()
+            self.metrics.decode_slot_steps.add(len(work.slots))
+            if st.mode == "spec":
+                self.metrics.spec_rounds.add()
+                self.metrics.spec_proposed.add(st.proposed)
+                self.metrics.spec_accepted.add(st.accepted)
+            if self.flight is not None:
+                self.flight.record(
+                    "sched", work="decode", batch=len(work.slots),
+                    dur_s=round(t_dec1 - t_dec0, 6), spec=st.mode,
+                    emitted=emitted, proposed=st.proposed,
+                    accepted=st.accepted)
+            if self.tracer.enabled:
+                seqs = sorted(s.seq_id for s in work.slots.values())
+                self.tracer.record("decode_round", start=t_dec0,
+                                   end=t_dec1, batch=len(work.slots),
+                                   seqs=seqs)
+                if st.mode == "spec":
+                    # The round's TTFT/TPOT attribution splits into its
+                    # draft and verify halves; the request breakdown
+                    # (obs.aggregate) sums both per request, so the SLO
+                    # burn math sees where the per-token time went.
+                    self.tracer.record(
+                        "spec_propose", start=st.t_propose0,
+                        end=st.t_propose1, batch=len(work.slots),
+                        seqs=seqs, width=st.width, proposed=st.proposed,
+                        resyncs=st.resyncs)
+                    self.tracer.record(
+                        "spec_verify", start=st.t_verify0,
+                        end=st.t_verify1, batch=len(work.slots),
+                        seqs=seqs, width=st.width, accepted=st.accepted,
+                        emitted=emitted)
         else:
             t_dec0 = time.monotonic()
             out = self.engine.decode(
@@ -789,8 +892,11 @@ class Server:
                     "decode_round", start=t_dec0, end=time.monotonic(),
                     batch=len(work.slots),
                     seqs=sorted(s.seq_id for s in work.slots.values()))
+            self.metrics.decode_rounds.add()
+            self.metrics.decode_slot_steps.add(len(work.slots))
             for slot, tok in out.items():
                 self.metrics.generated_tokens.add()
+                self.metrics.decode_tokens.add()
                 self._finish(self.scheduler.record_decode(slot, tok))
         evicted = self.kv.evictions - preempt0
         if evicted and self.tracer.enabled:
@@ -939,6 +1045,12 @@ class Server:
             self._failed = exc
             batch = list(self._incoming)
             self._incoming.clear()
+        abandon = getattr(self.engine, "abandon_round", None)
+        if abandon is not None:
+            # A spec round killed between propose-verify and commit
+            # must not wedge the engine pair's next incarnation
+            # (ISSUE 14); the relaunch re-prefills every slot anyway.
+            abandon()
         reqs = batch + [self._by_seq.pop(k) for k in list(self._by_seq)]
         self.scheduler.waiting.clear()
         self.scheduler.running.clear()

@@ -361,6 +361,28 @@ class KVCacheManager:
         if t.num_tokens + 1 > t.capacity(self.block_size):
             t.blocks.extend(self.allocator.alloc(1))
 
+    def try_reserve_next(self, seq_id) -> bool:
+        """Non-raising :meth:`reserve_next` for the multi-token commit
+        path (ISSUE 14): speculative decode may land several tokens per
+        slot per round, and tokens past the round's up-front reservation
+        are best-effort — a dry pool TRUNCATES the acceptance (greedy
+        decode re-derives the same tokens next round) instead of
+        preempting mid-commit.  Returns True when the next token's slot
+        is covered.
+
+        Draft-side accounting note: the draft engine runs at the SAME
+        slot layout (``SpecDecoder`` refuses anything else) and every
+        round writes strictly no more positions than the target's
+        verify pass, then rolls back to the same accepted length — so
+        this manager's per-sequence token accounting bounds BOTH the
+        target's and the draft's cache occupancy, and admission can
+        never over-commit either cache."""
+        try:
+            self.reserve_next(seq_id)
+            return True
+        except OutOfBlocksError:
+            return False
+
     def commit_token(self, seq_id, token: int | None = None) -> None:
         t = self._tables[seq_id]
         if t.num_tokens + 1 > t.capacity(self.block_size):

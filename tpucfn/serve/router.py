@@ -70,9 +70,14 @@ from tpucfn.serve.frontend import (
     Server,
 )
 
-# Per-replica state gauge encoding (``router_replica_state_{i}``): the
-# routable states first, so "value > 0" alerts read as "replica not
-# fully trusted" and "value >= 3" as "replica out of rotation".
+# Replica state encoding for the aggregate gauges: the routable states
+# first, so "worst > 0" alerts read as "some replica not fully trusted"
+# and "worst >= 3" as "some replica out of rotation".  Exported as
+# AGGREGATES (`router_replica_state_worst`, `router_replicas_routable`)
+# — the ISSUE 14 migration off PR 8's per-replica
+# `router_replica_state_{i}` family, which scaled /metrics cardinality
+# with the fleet (the registry-cardinality rule's one baselined
+# finding, now deleted; per-replica detail lives in `snapshot()`).
 REPLICA_STATE_CODES = {"closed": 0, "half_open": 1, "open": 2,
                        "draining": 3, "stopped": 4, "dead": 5}
 
@@ -405,13 +410,26 @@ class ReplicaRouter(ChaosTarget):
 
         self.replicas: list[_Replica] = [
             self._build_replica(i) for i in range(num_replicas)]
-        for rep in self.replicas:
-            r.computed_gauge(
-                f"router_replica_state_{rep.idx}",
-                (lambda rep=rep:
-                 float(REPLICA_STATE_CODES[rep.state(self.clock())])),
-                "replica state: 0 closed, 1 half_open, 2 open, "
-                "3 draining, 4 stopped, 5 dead")
+        r.computed_gauge(
+            "router_replica_state_worst", self._worst_state,
+            "worst replica state across the fleet: 0 closed, 1 "
+            "half_open, 2 open, 3 draining, 4 stopped, 5 dead "
+            "(per-replica detail in the router snapshot)")
+        r.computed_gauge(
+            "router_replicas_routable", self._num_routable,
+            "replicas currently able to take fresh traffic (closed or "
+            "half_open, not draining/stopped/dead)")
+
+    def _worst_state(self) -> float:
+        now = self.clock()
+        return float(max((REPLICA_STATE_CODES[rep.state(now)]
+                          for rep in self.replicas), default=0))
+
+    def _num_routable(self) -> float:
+        now = self.clock()
+        return float(sum(
+            1 for rep in self.replicas
+            if rep.state(now) in ("closed", "half_open")))
 
     # -- replica lifecycle -------------------------------------------------
 
@@ -1041,8 +1059,15 @@ class ReplicaRouter(ChaosTarget):
         """The router dashboard in one dict (CLI JSON line, bench row)."""
         now = self.clock()
         with self._lock:
+            # "spec" marks replicas decoding speculatively (ISSUE 14) —
+            # the router mixes them with plain replicas freely, because
+            # greedy output is bit-identical either way (retries and
+            # hedges cross the boundary transparently).
             reps = [{"replica": rep.idx, "state": rep.state(now),
-                     "inflight": rep.inflight} for rep in self.replicas]
+                     "inflight": rep.inflight,
+                     "spec": bool(getattr(rep.server.engine,
+                                          "spec_enabled", False))}
+                    for rep in self.replicas]
         return {
             "replicas": reps,
             "requests": self.requests_c.value,

@@ -169,8 +169,17 @@ class _PrefillPlan:
 
 @dataclasses.dataclass
 class DecodeWork:
-    """Run one decode iteration over every running slot."""
+    """Run one decode iteration over every running slot.
+
+    With speculative decoding (ISSUE 14) the round is propose-verify:
+    the serve loop stashes each slot's verified candidate run in
+    ``proposed`` before recording it, so the flight ring's scheduler-
+    decision samples carry what was speculated — every slot still has
+    exactly ONE up-front block reservation; tokens past it are
+    committed best-effort by :meth:`~ContinuousBatchingScheduler.
+    record_decode_tokens`."""
     slots: dict[int, Sequence]  # slot -> sequence, all reserved for +1 token
+    proposed: dict[int, list[int]] | None = None
 
 
 class ContinuousBatchingScheduler:
@@ -431,10 +440,45 @@ class ContinuousBatchingScheduler:
         K/V of its INPUT token, covered by this round's reservation),
         append, retire in place when done.  Returns the sequence iff
         finished."""
+        fin, _ = self.record_decode_tokens(slot, [token])
+        return fin
+
+    def record_decode_tokens(self, slot: int,
+                             tokens: list[int]) -> tuple[Sequence | None,
+                                                         int]:
+        """A variable-length decode result for one slot (ISSUE 14: a
+        propose-verify round emits 1..k+1 tokens).  Tokens are applied
+        IN ORDER, each charging its input token's cache entry; the
+        first rides the round's up-front reservation, later ones
+        reserve as they commit.  The run stops early — and the rest of
+        the candidates are DROPPED — when:
+
+        * a token hits a stop condition (EOS / ``max_new_tokens``): the
+          sequence retires and the slot vacates exactly as a one-token
+          round would;
+        * the block pool runs dry mid-run: acceptance is truncated, not
+          preempted — greedy decode re-derives the dropped tokens
+          bit-identically next round, so a tight pool degrades
+          throughput, never output.
+
+        Returns ``(finished sequence or None, number of tokens actually
+        recorded)`` — the caller repairs the engine caches to the
+        recorded length (``SpecDecoder.commit_round``)."""
+        if not tokens:
+            raise ValueError("record_decode_tokens with no tokens")
         seq = self.running[slot]
-        self.kv.commit_token(seq.seq_id, token=seq.last_token)
-        seq.generated.append(token)
-        return self._maybe_retire(slot, token)
+        fin = None
+        recorded = 0
+        for i, token in enumerate(tokens):
+            if i > 0 and not self.kv.try_reserve_next(seq.seq_id):
+                break
+            self.kv.commit_token(seq.seq_id, token=seq.last_token)
+            seq.generated.append(token)
+            recorded += 1
+            fin = self._maybe_retire(slot, token)
+            if fin is not None:
+                break
+        return fin, recorded
 
     def _maybe_retire(self, slot: int, token: int) -> Sequence | None:
         seq = self.running[slot]
