@@ -73,6 +73,9 @@ def main() -> int:
     def on_degrade(reason: str) -> None:
         mode["degraded"] = True
         mode["reason"] = reason
+        # wall-clock stamp: the gray-failure drill rc-gates the
+        # fault-injection -> degradation detection latency against it
+        mode["degraded_ts"] = time.time()
         print(f"degraded to local loading: {reason}", flush=True)
 
     ds = ShardedDataset(

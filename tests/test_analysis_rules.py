@@ -1304,3 +1304,180 @@ def emit(flight):
     flight.record("step", step=3, dur_s=0.1)
 '''
     assert check(tmp_path, {"obs.py": src}, rules=["span-balance"]) == []
+
+
+# -- net-deadline (ISSUE 15) ------------------------------------------------
+
+# The gray-failure shape the rule encodes: a blocking socket op with no
+# timeout/deadline ever set on that socket — a stalled or trickling
+# peer pins the caller forever.
+NETDL_CONNECT_BUG = '''
+import socket
+
+
+def dial(addr):
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.connect(addr)
+    return s
+'''
+
+NETDL_CONNECT_FIXED = NETDL_CONNECT_BUG.replace(
+    "    s.connect(addr)",
+    "    s.settimeout(5.0)\n    s.connect(addr)")
+
+
+def test_netdl_connect_without_timeout_fires(tmp_path):
+    fs = check(tmp_path, {"net.py": NETDL_CONNECT_BUG},
+               rules=["net-deadline"])
+    assert [f.key for f in fs] == ["netdl:dial:s:connect"]
+    assert "timeout/deadline" in fs[0].message
+
+
+def test_netdl_connect_with_timeout_is_silent(tmp_path):
+    assert check(tmp_path, {"net.py": NETDL_CONNECT_FIXED},
+                 rules=["net-deadline"]) == []
+
+
+def test_netdl_settimeout_none_unarms_the_deadline(tmp_path):
+    # settimeout(None) flips the socket back to blocking mode: the op
+    # after it is exactly the bug shape again
+    src = NETDL_CONNECT_FIXED.replace(
+        "    s.connect(addr)",
+        "    s.settimeout(None)\n    s.connect(addr)")
+    fs = check(tmp_path, {"net.py": src}, rules=["net-deadline"])
+    assert [f.key for f in fs] == ["netdl:dial:s:connect"]
+
+
+def test_netdl_accepted_conn_used_raw_fires_once(tmp_path):
+    # the accept() result is a NEW timeout-less socket — and the
+    # finding is deduped even though accept is seen twice (assignment
+    # RHS and call scan)
+    src = '''
+import socket
+
+
+def serve(srv):
+    srv.settimeout(0.25)
+    conn, _ = srv.accept()
+    return conn.recv(1024)
+'''
+    fs = check(tmp_path, {"net.py": src}, rules=["net-deadline"])
+    assert [f.key for f in fs] == ["netdl:serve:conn:recv"]
+
+
+def test_netdl_fresh_socket_into_blocking_helper_fires_at_caller(tmp_path):
+    # the helper chain: pump() blocks on its parameter, so the CALLER
+    # owns the deadline obligation — exactly the send_frame/recv_frame
+    # contract the planes live by
+    src = '''
+import socket
+
+
+def pump(sock):
+    sock.sendall(b"x")
+
+
+def go(addr):
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.connect(addr)
+    pump(s)
+'''
+    fs = check(tmp_path, {"net.py": src}, rules=["net-deadline"])
+    assert {f.key for f in fs} == {"netdl:go:s:connect",
+                                   "netdl:go:s:arg0 of helper"}
+
+
+def test_netdl_helper_with_internal_settimeout_is_silent(tmp_path):
+    # a helper that sets its own per-chunk timeout from a deadline (the
+    # tpucfn.net shape) imposes nothing on callers
+    src = '''
+import socket
+
+
+def pump(sock, deadline):
+    if deadline is not None:
+        sock.settimeout(deadline)
+    sock.sendall(b"x")
+
+
+def go(addr):
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.settimeout(3.0)
+    s.connect(addr)
+    pump(s, 1.0)
+'''
+    assert check(tmp_path, {"net.py": src}, rules=["net-deadline"]) == []
+
+
+def test_netdl_ctor_hop_fires_and_deadlined_conn_is_silent(tmp_path):
+    # one constructor hop: the class stores the ctor param into an attr
+    # a method blocks on — the conn handed to it must be deadlined
+    src = '''
+import socket
+
+
+class Stream:
+    def __init__(self, conn):
+        self.conn = conn
+
+    def run(self):
+        return self.conn.recv(64)
+
+
+def serve(srv):
+    srv.settimeout(0.25)
+    conn, _ = srv.accept()
+    Stream(conn)
+'''
+    fs = check(tmp_path, {"net.py": src}, rules=["net-deadline"])
+    assert [f.key for f in fs] == ["netdl:serve:conn:arg0 of helper"]
+    fixed = src.replace("    Stream(conn)",
+                        "    conn.settimeout(30.0)\n    Stream(conn)")
+    assert check(tmp_path, {"net.py": fixed}, rules=["net-deadline"]) == []
+
+
+def test_netdl_self_attr_never_deadlined_fires_class_wide(tmp_path):
+    # the accept-loop shape: the listening socket lives on self; SOME
+    # method must settimeout it or the accept blocks unwakeably
+    src = '''
+import socket
+
+
+class Server:
+    def start(self):
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.bind(("", 0))
+        s.listen(8)
+        self._sock = s
+
+    def loop(self):
+        conn, _ = self._sock.accept()
+        conn.settimeout(5.0)
+'''
+    fs = check(tmp_path, {"net.py": src}, rules=["net-deadline"])
+    assert [f.key for f in fs] == ["netdl:Server._sock:accept"]
+    fixed = src.replace("        self._sock = s",
+                        "        s.settimeout(0.25)\n        self._sock = s")
+    assert check(tmp_path, {"net.py": fixed}, rules=["net-deadline"]) == []
+
+
+def test_netdl_ignores_modules_without_socket_import(tmp_path):
+    # scope: only modules that import socket — an event bus's
+    # `conn.recv(...)` duck-type is not a socket
+    src = '''
+def pull(conn):
+    return conn.recv(64)
+
+
+def go(bus):
+    c = bus.open()
+    c.connect("topic")
+'''
+    assert check(tmp_path, {"bus.py": src}, rules=["net-deadline"]) == []
+
+
+def test_netdl_pragma_suppresses(tmp_path):
+    src = NETDL_CONNECT_BUG.replace(
+        "    s.connect(addr)",
+        "    s.connect(addr)  # tpucfn: allow[net-deadline] probe socket")
+    assert check(tmp_path, {"net.py": src}, rules=["net-deadline"]) == []

@@ -205,3 +205,170 @@ def test_check_update_baseline_refuses_partial_views(tmp_path, capsys):
     assert rc == 2
     assert "--rules" in capsys.readouterr().err
     assert not bp.exists()
+
+
+# -- chaos plane (ISSUE 15) -------------------------------------------------
+
+def test_chaos_proxy_usage_errors_rc2(tmp_path, capsys):
+    # bad upstream format
+    assert _cli(tmp_path, "chaos", "proxy", "--upstream", "nocolon") == 2
+    assert "HOST:PORT" in capsys.readouterr().err
+    # bad spec JSON
+    assert _cli(tmp_path, "chaos", "proxy", "--upstream", "127.0.0.1:1",
+                "--spec", '{"faults": [{"kind": "flood"}]}') == 2
+    assert "bad --spec" in capsys.readouterr().err
+    # missing spec file
+    assert _cli(tmp_path, "chaos", "proxy", "--upstream", "127.0.0.1:1",
+                "--spec", str(tmp_path / "absent.json")) == 2
+
+
+def test_chaos_proxy_serves_and_prints_stats(tmp_path, capsys):
+    """The real CLI path: a proxy fronting a live socket, one proxied
+    round trip, scheduled fault fired, stats JSON on exit."""
+    import socket as _socket
+    import threading
+    import time
+
+    srv = _socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    srv.settimeout(5.0)
+    up_port = srv.getsockname()[1]
+    spec = json.dumps({"seed": 5, "faults": [
+        {"kind": "latency", "at_s": 0.0, "delay_s": 0.01,
+         "duration_s": 9.0}]})
+    result = {}
+
+    def drive():
+        # wait for the proxy's address line on stderr is not available
+        # in-process; poll-connect to the fixed listen port instead
+        for _ in range(100):
+            try:
+                c = _socket.create_connection(("127.0.0.1", listen),
+                                              timeout=1.0)
+                break
+            except OSError:
+                time.sleep(0.05)
+        else:
+            return
+        a, _ = srv.accept()
+        c.sendall(b"ping")
+        got = a.recv(4)
+        a.sendall(got)
+        result["echo"] = c.recv(4)
+        c.close()
+        a.close()
+
+    # an ephemeral free port (bind-0-then-close), never a hardcoded
+    # number — any occupant would EADDRINUSE the proxy and fail the
+    # test with no product defect
+    probe = _socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    listen = probe.getsockname()[1]
+    probe.close()
+    t = threading.Thread(target=drive, daemon=True)
+    t.start()
+    rc = _cli(tmp_path, "chaos", "proxy", "--listen", str(listen),
+              "--upstream", f"127.0.0.1:{up_port}", "--spec", spec,
+              "--serve-for", "1.5")
+    t.join(timeout=10)
+    srv.close()
+    assert rc == 0
+    assert result.get("echo") == b"ping"
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    stats = json.loads(line)
+    assert stats["connections"] == 1
+    assert stats["faults_fired"] == 1
+    assert stats["fired"][0]["kind"] == "latency"
+    assert stats["forwarded_bytes"] >= 8
+
+
+def test_launch_chaos_requires_ft_rc2(tmp_path, capsys):
+    _cli(tmp_path, "create-stack", "--name", "cx", "--accelerator",
+         "v4-8")
+    capsys.readouterr()
+    rc = _cli(tmp_path, "launch", "--name", "cx",
+              "--chaos", '{"events": []}', "--",
+              sys.executable, "-c", "pass")
+    assert rc == 2
+    assert "--chaos needs --ft" in capsys.readouterr().err
+
+
+def test_launch_chaos_bad_spec_and_bad_proxy_rc2(tmp_path, capsys):
+    _cli(tmp_path, "create-stack", "--name", "cy", "--accelerator",
+         "v4-8")
+    capsys.readouterr()
+    rc = _cli(tmp_path, "launch", "--name", "cy", "--ft",
+              "--chaos", '{"events": [{"action": "flood", "at_s": 1}]}',
+              "--", sys.executable, "-c", "pass")
+    assert rc == 2
+    assert "bad --chaos spec" in capsys.readouterr().err
+    rc = _cli(tmp_path, "launch", "--name", "cy", "--ft",
+              "--chaos", '{"events": []}',
+              "--chaos-proxy", "notaport:127.0.0.1:7641",
+              "--", sys.executable, "-c", "pass")
+    assert rc == 2
+    assert "--chaos-proxy wants" in capsys.readouterr().err
+
+
+def test_launch_chaos_spec_schedules_like_kill(tmp_path, capsys):
+    """Acceptance: a net_* op rides `tpucfn launch --chaos` exactly
+    like kill — one spec file schedules a kill AND a net fault against
+    the launch-owned proxy; the run completes and journals both
+    firings."""
+    spec = tmp_path / "chaos.json"
+    spec.write_text(json.dumps({"seed": 0, "events": [
+        {"action": "net_latency", "at_s": 0.2, "delay_s": 0.01,
+         "duration_s": 5.0},
+        {"action": "kill", "at_s": 0.4, "host": 0},
+    ]}))
+    _cli(tmp_path, "create-stack", "--name", "cz", "--accelerator",
+         "v4-8")
+    capsys.readouterr()
+    # an idle upstream for the proxy to front (never dialed here; the
+    # net_latency lands on the proxy regardless of traffic)
+    import socket as _socket
+
+    up = _socket.socket()
+    up.bind(("127.0.0.1", 0))
+    up.listen(1)
+    rc = _cli(tmp_path, "launch", "--name", "cz", "--ft",
+              "--restarts", "1", "--ft-startup-grace", "30",
+              "--chaos", str(spec),
+              "--chaos-proxy", f"0:127.0.0.1:{up.getsockname()[1]}",
+              "--", sys.executable, "-c", "import time; time.sleep(1.2)")
+    up.close()
+    assert rc == 0  # the killed rank was relaunched within budget
+    ft_dir = tmp_path / "state" / "clusters" / "cz" / "ft"
+    events = [json.loads(s) for s in
+              (ft_dir / "events.jsonl").read_text().splitlines() if s]
+    kinds = [e["kind"] for e in events]
+    assert "chaos_net_fault" in kinds
+    net = next(e for e in events if e["kind"] == "chaos_net_fault")
+    assert net["fault"] == "latency"
+    from tpucfn.ft.journal import journal_path as _jp, replay_journal as _rj
+
+    _st, recs, _ = _rj(_jp(ft_dir))
+    fired = [r for r in recs if r["kind"] == "chaos_fired"]
+    assert {r["action"] for r in fired} == {"net_latency", "kill"}
+
+
+def test_launch_chaos_net_events_require_a_proxy_rc2(tmp_path, capsys):
+    """Review fix: a net_* event with no --chaos-proxy to land on is a
+    usage error at parse time, never a coordinator exception mid-run."""
+    _cli(tmp_path, "create-stack", "--name", "cw", "--accelerator",
+         "v4-8")
+    capsys.readouterr()
+    rc = _cli(tmp_path, "launch", "--name", "cw", "--ft",
+              "--chaos",
+              '{"events": [{"action": "net_stall", "at_s": 1}]}',
+              "--", sys.executable, "-c", "pass")
+    assert rc == 2
+    assert "--chaos-proxy" in capsys.readouterr().err
+    # bad net params fail the SPEC PARSE (ChaosEvent validation)
+    rc = _cli(tmp_path, "launch", "--name", "cw", "--ft",
+              "--chaos",
+              '{"events": [{"action": "net_latency", "at_s": 1}]}',
+              "--", sys.executable, "-c", "pass")
+    assert rc == 2
+    assert "bad --chaos spec" in capsys.readouterr().err

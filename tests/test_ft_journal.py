@@ -931,3 +931,229 @@ def test_adoption_spawn_grace_applies_to_relaunch_window(tmp_path):
     finally:
         orphan.kill()
         orphan.wait()
+
+
+# -- journal compaction (ISSUE 15 satellite) --------------------------------
+
+def _long_journal(tmp_path, records=30):
+    """A journal with `records` total records whose replayed state has
+    real content in every compactable field."""
+    ft_dir = tmp_path / "ft"
+    (ft_dir / "journal").mkdir(parents=True)
+    with JournalWriter(journal_path(ft_dir)) as j:
+        j.append("run_start", argv=["x"], hosts=2, policy="solo",
+                 max_restarts=9)
+        j.append("launching", hosts=[0, 1], first=True)
+        j.append("gang_launched", first=True,
+                 pids={"0": 111, "1": 222},
+                 starts={"0": 1000, "1": 2000})
+        j.append("chaos_fired", index=0, action="kill", host=1)
+        j.append("incident_open", incident=1,
+                 failures=[{"host": 1, "kind": "crash", "rc": 9}])
+        j.append("restart_intent", incident=1, action="solo_restart",
+                 hosts=[1], budget_used=1)
+        n_solo = records - 7
+        for i in range(n_solo):
+            j.append("solo_launched", host=1, pid=300 + i, start=5000 + i)
+        j.append("input_restarted", host=1, restarts=2)
+    return ft_dir
+
+
+def test_compact_journal_folds_state_and_replays_identically(tmp_path):
+    from tpucfn.ft.journal import compact_journal
+
+    ft_dir = _long_journal(tmp_path, records=30)
+    before, recs_before, _ = replay_journal(journal_path(ft_dir))
+    assert len(recs_before) == 30
+    assert compact_journal(journal_path(ft_dir), max_records=10)
+    after, recs_after, torn = replay_journal(journal_path(ft_dir))
+    # one snapshot record now replays to the IDENTICAL state
+    assert len(recs_after) == 1 and recs_after[0]["kind"] == "snapshot"
+    assert not torn
+    assert after.to_json() == before.to_json()
+    assert after.seq == before.seq
+    assert after.pending is not None
+    assert after.pending.action == "solo_restart"
+    assert after.pending.launched  # the solo_launched records landed
+    assert after.proc_starts == before.proc_starts
+    # forensics: the pre-compaction bytes were archived
+    assert (journal_path(ft_dir).parent
+            / "journal-compacted.jsonl").exists()
+
+
+def test_compact_journal_appends_continue_contiguously(tmp_path):
+    from tpucfn.ft.journal import compact_journal
+
+    ft_dir = _long_journal(tmp_path)
+    st0, _, _ = replay_journal(journal_path(ft_dir))
+    assert compact_journal(journal_path(ft_dir), max_records=5)
+    with JournalWriter(journal_path(ft_dir), start_seq=st0.seq) as j:
+        j.append("host_exit", host=1, rc=0)
+        j.append("done", rc=0)
+    st, recs, _ = replay_journal(journal_path(ft_dir))
+    assert st.done_rc == 0 and st.seq == st0.seq + 2
+    assert [r["kind"] for r in recs] == ["snapshot", "host_exit", "done"]
+
+
+def test_compact_journal_below_threshold_is_a_noop(tmp_path):
+    from tpucfn.ft.journal import compact_journal
+
+    ft_dir = _long_journal(tmp_path, records=30)
+    raw = journal_path(ft_dir).read_bytes()
+    assert not compact_journal(journal_path(ft_dir), max_records=100)
+    assert journal_path(ft_dir).read_bytes() == raw
+
+
+def test_compact_journal_skips_finished_runs(tmp_path):
+    from tpucfn.ft.journal import compact_journal
+
+    ft_dir = _long_journal(tmp_path)
+    st0, _, _ = replay_journal(journal_path(ft_dir))
+    with JournalWriter(journal_path(ft_dir), start_seq=st0.seq) as j:
+        j.append("done", rc=0)
+    assert not compact_journal(journal_path(ft_dir), max_records=5)
+
+
+def test_snapshot_mid_journal_refuses_as_spliced(tmp_path):
+    from tpucfn.ft.journal import CoordinatorState
+
+    ft_dir = tmp_path / "ft"
+    (ft_dir / "journal").mkdir(parents=True)
+    st = CoordinatorState()
+    p = journal_path(ft_dir)
+    with open(p, "w") as f:
+        f.write(encode_record({"seq": 1, "kind": "run_start",
+                               "argv": ["x"], "hosts": 1}))
+        f.write(encode_record({"seq": 5, "kind": "snapshot",
+                               "state": st.to_json()}))
+    with pytest.raises(JournalError, match="spliced|first"):
+        replay_journal(p)
+
+
+def test_adoption_compacts_past_the_threshold(tmp_path):
+    """The wired path: an adopting coordinator with a tiny compaction
+    threshold folds the journal before appending its own records."""
+    ft_dir = tmp_path / "ft"
+    (ft_dir / "journal").mkdir(parents=True)
+    procs = [subprocess.Popen([sys.executable, "-c",
+                               "import time; time.sleep(0.6)"])
+             for _ in range(2)]
+    with JournalWriter(journal_path(ft_dir)) as j:
+        j.append("run_start", argv=["x"], hosts=2, policy="gang",
+                 max_restarts=2)
+        for k in range(10):
+            j.append("launching", hosts=[0, 1], first=k == 0)
+            j.append("gang_launched", first=k == 0,
+                     pids={str(i): p.pid for i, p in enumerate(procs)})
+    import threading
+
+    def reap():
+        for p in procs:
+            write_rc(ft_dir, p.pid, p.wait())
+
+    threading.Thread(target=reap, daemon=True).start()
+    coord = GangCoordinator(
+        _launcher(tmp_path, n=2), [sys.executable, "-c", "pass"],
+        policy=GangRestart(RestartBudget(2)), ft_dir=ft_dir,
+        poll_interval=0.01, term_grace_s=0.5,
+        journal_compact_records=5)
+    launches = []
+    coord.launcher.launch = lambda *a, **k: launches.append(1) or []
+    assert coord.run() == 0
+    assert coord._adopted and launches == []
+    st, recs, _ = replay_journal(journal_path(ft_dir))
+    assert recs[0]["kind"] == "snapshot"
+    # snapshot + adopted + host_exits + done, NOT the 21 old records
+    assert len(recs) < 10
+    assert st.done_rc == 0
+    adopted = next(r for r in recs if r["kind"] == "adopted")
+    assert adopted["compacted"] is True
+
+
+# -- pid start-time identity (ISSUE 15 satellite) ---------------------------
+
+def test_pid_start_time_is_stable_and_differs_across_processes():
+    from tpucfn.ft.journal import pid_start_time
+
+    mine = pid_start_time(os.getpid())
+    assert isinstance(mine, int)
+    assert pid_start_time(os.getpid()) == mine  # stable for a lifetime
+    p = subprocess.Popen([sys.executable, "-c",
+                          "import time; time.sleep(0.5)"])
+    try:
+        theirs = pid_start_time(p.pid)
+        assert isinstance(theirs, int) and theirs != mine
+    finally:
+        p.kill()
+        p.wait()
+    assert pid_start_time(999999999) is None  # gone: no identity
+
+
+def test_adopted_process_refuses_a_recycled_pid():
+    """A live pid whose start time disagrees with the journaled one is
+    an unrelated process: the handle reads it as dead (rc degrades, no
+    rc file) and NEVER signals it."""
+    from tpucfn.ft.journal import pid_start_time
+
+    p = subprocess.Popen([sys.executable, "-c",
+                          "import time; time.sleep(5)"])
+    try:
+        real = pid_start_time(p.pid)
+        honest = AdoptedProcess(p.pid, start_time=real)
+        assert honest.poll() is None  # same identity: alive
+        recycled = AdoptedProcess(p.pid, start_time=real + 12345)
+        assert recycled.poll() == 1  # identity mismatch: dead-unwatched
+        recycled.kill()  # must NOT touch the innocent live process
+        assert p.poll() is None
+    finally:
+        p.kill()
+        p.wait()
+
+
+def test_gang_launch_journals_start_times_and_replay_carries_them(tmp_path):
+    ft_dir = tmp_path / "ft"
+    coord = GangCoordinator(
+        _launcher(tmp_path, n=2), [sys.executable, "-c", "pass"],
+        policy=GangRestart(RestartBudget(0)), ft_dir=ft_dir,
+        poll_interval=0.01, term_grace_s=0.5)
+    assert coord.run() == 0
+    st, recs, _ = replay_journal(journal_path(ft_dir))
+    launched = next(r for r in recs if r["kind"] == "gang_launched")
+    assert set(launched["starts"]) == {"0", "1"}
+    assert all(isinstance(s, int) for s in launched["starts"].values())
+    # host_exit pops them back out of the replayed state
+    assert st.proc_starts == {}
+
+
+def test_adoption_condemns_recycled_pid_as_dead_unwatched(tmp_path):
+    """The cross-reboot shape: the journal names OUR OWN live pid (the
+    ultimate recycled-pid stand-in) with a WRONG start time — adoption
+    must treat the rank as dead-unwatched (a CRASH through the normal
+    path) instead of attaching to a stranger; with the RIGHT start time
+    it attaches."""
+    from tpucfn.ft.journal import pid_start_time
+
+    me = os.getpid()
+    for wrong, expect_dead in ((True, True), (False, False)):
+        ft_dir = tmp_path / ("ft-wrong" if wrong else "ft-right")
+        (ft_dir / "journal").mkdir(parents=True)
+        start = pid_start_time(me) + (999 if wrong else 0)
+        with JournalWriter(journal_path(ft_dir)) as j:
+            j.append("run_start", argv=["x"], hosts=1, policy="solo",
+                     max_restarts=1)
+            j.append("gang_launched", first=True, pids={"0": me},
+                     starts={"0": start})
+        coord = GangCoordinator(
+            _launcher(tmp_path, n=1), [sys.executable, "-c", "pass"],
+            policy=SoloRestart(RestartBudget(1)), ft_dir=ft_dir,
+            poll_interval=0.01, term_grace_s=0.5)
+        coord._startup_adopt()
+        if expect_dead:
+            assert 0 not in coord._procs
+            assert [f.host_id for f in coord._adopt_failures] == [0]
+        else:
+            assert 0 in coord._procs
+            assert coord._procs[0].pid == me
+            assert coord._adopt_failures == []
+        if coord._journal is not None:
+            coord._journal.close()

@@ -1,0 +1,220 @@
+"""tpucfn.net.deadline (ISSUE 15): the end-to-end Deadline composed
+over per-chunk socket timeouts, the shared RetryPolicy, and the
+deadline-aware framing in tpucfn.data.service — including the headline
+gray-failure pin: a TRICKLING peer (one byte per chunk timeout, which
+resets a naive per-chunk clock forever) now times out inside the
+end-to-end bound."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from tpucfn.data.service import (
+    FRAME_BATCH,
+    ServiceError,
+    _recv_exact,
+    recv_frame,
+    send_frame,
+)
+from tpucfn.net.deadline import (
+    Deadline,
+    DeadlineExceeded,
+    NetMetrics,
+    RetryPolicy,
+    sendall_deadline,
+)
+from tpucfn.obs.registry import MetricRegistry
+
+
+# -- Deadline ---------------------------------------------------------------
+
+
+def test_deadline_remaining_and_expiry_on_fake_clock():
+    t = [100.0]
+    d = Deadline(5.0, clock=lambda: t[0])
+    assert d.remaining() == pytest.approx(5.0)
+    assert not d.expired()
+    t[0] = 104.9
+    assert d.timeout() == pytest.approx(0.1)
+    t[0] = 105.1
+    assert d.expired()
+    with pytest.raises(DeadlineExceeded):
+        d.timeout(what="recv")
+    with pytest.raises(DeadlineExceeded):
+        d.check()
+
+
+def test_deadline_timeout_cap_and_floor():
+    t = [0.0]
+    d = Deadline(100.0, clock=lambda: t[0])
+    assert d.timeout(cap=5.0) == pytest.approx(5.0)
+    t[0] = 100.0 - 1e-5  # nearly spent, but not expired
+    assert d.timeout(floor=0.01) == pytest.approx(0.01)
+
+
+def test_deadline_at_anchors_an_absolute_endpoint():
+    t = [50.0]
+    d = Deadline.at(60.0, clock=lambda: t[0])
+    assert d.remaining() == pytest.approx(10.0)
+
+
+def test_deadline_exceeded_is_oserror():
+    # the planes' existing `except OSError` transport handling must
+    # catch an expired deadline — degradation, not a new crash class
+    assert issubclass(DeadlineExceeded, OSError)
+
+
+# -- RetryPolicy ------------------------------------------------------------
+
+
+def test_retry_backoff_is_capped_exponential_with_seeded_jitter():
+    rp = RetryPolicy(base_s=0.1, multiplier=2.0, max_s=0.5, jitter=0.25,
+                     seed=7)
+    seq = [rp.backoff_s(i) for i in range(6)]
+    for i, d in enumerate(seq):
+        nominal = min(0.5, 0.1 * 2.0 ** i)
+        assert nominal * 0.75 <= d <= nominal * 1.25
+    # seeded: same seed, same delays
+    rp2 = RetryPolicy(base_s=0.1, multiplier=2.0, max_s=0.5, jitter=0.25,
+                      seed=7)
+    assert [rp2.backoff_s(i) for i in range(6)] == seq
+
+
+def test_retry_attempts_respect_max_and_sleep_between():
+    slept = []
+    rp = RetryPolicy(max_attempts=4, base_s=0.1, multiplier=2.0,
+                     max_s=10.0, jitter=0.0, sleep=slept.append)
+    assert list(rp.attempts()) == [0, 1, 2, 3]
+    assert slept == pytest.approx([0.1, 0.2, 0.4])
+
+
+def test_retry_attempts_stop_at_deadline_and_bound_the_last_sleep():
+    t = [0.0]
+
+    def sleep(s):
+        t[0] += s
+
+    rp = RetryPolicy(base_s=1.0, multiplier=1.0, max_s=1.0, jitter=0.0,
+                     clock=lambda: t[0], sleep=sleep)
+    d = Deadline(2.5, clock=lambda: t[0])
+    out = list(rp.attempts(deadline=d))
+    # attempt 0 free, then 1.0s sleeps; the deadline at 2.5 admits two
+    # more rounds (the final partial sleep is clamped and then expires)
+    assert out[0] == 0 and len(out) <= 3
+    assert t[0] <= 2.5 + 1e-9
+
+
+def test_retry_attempts_metrics_count_retries_and_backoff():
+    reg = MetricRegistry()
+    m = NetMetrics(reg, "input")
+    rp = RetryPolicy(max_attempts=3, base_s=0.01, multiplier=1.0,
+                     max_s=0.01, jitter=0.0, sleep=lambda s: None)
+    list(rp.attempts(metrics=m))
+    v = reg.varz()["metrics"]
+    assert v["net_input_retries_total"] == 2
+    assert v["net_input_backoff_seconds_total"] == pytest.approx(0.02)
+
+
+# -- deadline-aware framing -------------------------------------------------
+
+
+def _pair():
+    a, b = socket.socketpair()
+    a.settimeout(5.0)
+    b.settimeout(5.0)
+    return a, b
+
+
+def test_recv_exact_trickle_times_out_within_the_deadline():
+    """THE gray-failure pin: a peer delivering one byte per 50 ms
+    forever used to reset a per-chunk timeout on every byte; with the
+    end-to-end deadline the read fails inside the bound."""
+    a, b = _pair()
+    stop = threading.Event()
+
+    def trickle():
+        while not stop.is_set():
+            try:
+                b.sendall(b"x")
+            except OSError:
+                return
+            time.sleep(0.05)
+
+    t = threading.Thread(target=trickle, daemon=True)
+    t.start()
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(DeadlineExceeded):
+            _recv_exact(a, 1 << 20, Deadline(0.5))
+        dt = time.monotonic() - t0
+        assert dt < 2.0, f"trickle read took {dt:.2f}s against a 0.5s deadline"
+    finally:
+        stop.set()
+        a.close()
+        b.close()
+        t.join(timeout=2)
+
+
+def test_recv_frame_stall_times_out_within_the_deadline():
+    a, b = _pair()
+    try:
+        b.sendall(b"TPIB")  # a header's worth of nothing more: stall
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            recv_frame(a, deadline=Deadline(0.3))
+        assert time.monotonic() - t0 < 1.5
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_frame_without_deadline_keeps_socket_timeout_semantics():
+    a, b = _pair()
+    a.settimeout(0.2)
+    try:
+        with pytest.raises(OSError):  # socket.timeout is an OSError
+            recv_frame(a)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_roundtrip_under_deadline_is_byte_identical():
+    a, b = _pair()
+    try:
+        payload = bytes(range(256)) * 64
+        send_frame(b, FRAME_BATCH, payload, deadline=Deadline(5.0))
+        kind, got = recv_frame(a, deadline=Deadline(5.0))
+        assert kind == FRAME_BATCH and bytes(got) == payload
+    finally:
+        a.close()
+        b.close()
+
+
+def test_sendall_deadline_expires_on_a_stalled_receiver():
+    a, b = _pair()
+    try:
+        # tiny buffers so the kernel cannot swallow the whole payload
+        b.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 16 * 1024)
+        a.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 16 * 1024)
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            # nobody ever reads from `a`: the send must fail inside the
+            # bound instead of blocking forever
+            sendall_deadline(b, b"z" * (8 << 20), Deadline(0.4))
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        a.close()
+        b.close()
+
+
+def test_closed_peer_still_raises_service_error_shape():
+    a, b = _pair()
+    b.close()
+    try:
+        with pytest.raises(ServiceError):
+            recv_frame(a, deadline=Deadline(1.0))
+    finally:
+        a.close()

@@ -1,0 +1,247 @@
+"""ISSUE 15 acceptance drills: gray failures against the REAL fleet
+planes under the real launch fan-out, detection latency rc-gated.
+
+* **input plane** — 1 input host (the real ``tpucfn data serve`` CLI)
+  + 2 trainer hosts, with a :class:`ChaosProxy` between the trainers
+  and the input host.  Mid-run the proxy starts TRICKLING (the fault
+  per-chunk timeouts can never catch); every trainer must degrade to
+  local loading within the configured end-to-end deadline — ≤ 10 s in
+  the drill, vs the pre-ISSUE-15 worst case of minutes — and the full
+  trajectory must be bit-identical to an uninterrupted reference.
+* **compile-artifact plane** — same shape: a GET stalled mid-payload
+  (connection held open) must degrade to a local compile inside the op
+  deadline with the same program.
+
+The reference/served/degraded comparison discipline (and the worker)
+are shared with test_input_service_e2e.py.
+"""
+
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tpucfn.bootstrap import EnvContract
+from tpucfn.data import write_dataset_shards
+from tpucfn.ft import (
+    GangCoordinator,
+    GangRestart,
+    HeartbeatMonitor,
+    MonitorConfig,
+    RestartBudget,
+)
+from tpucfn.launch import Launcher, LocalTransport
+from tpucfn.net.proxy import ChaosProxy
+
+pytestmark = pytest.mark.slow
+
+REPO = Path(__file__).resolve().parent.parent
+WORKER = Path(__file__).resolve().parent / "input_e2e_worker.py"
+
+TRAINERS = 2
+BATCH = 8
+SEED = 5
+EPOCHS = 1
+EXAMPLES, SHARDS = 480, 8
+STEPS_PER_TRAINER = 30
+
+# the drill's rc gate: fault injection -> every trainer degraded
+DETECT_LATENCY_GATE_S = 10.0
+OP_DEADLINE_S = 2.0
+
+
+def _write_shards(tmp_path) -> Path:
+    d = tmp_path / "shards"
+    d.mkdir()
+    rs = np.random.RandomState(1)
+    write_dataset_shards(
+        ({"x": rs.randn(4096).astype(np.float32)} for _ in range(EXAMPLES)),
+        d, num_shards=SHARDS)
+    return d
+
+
+def _contract(tmp_path, n) -> EnvContract:
+    hostfile = tmp_path / f"hostfile{n}"
+    hostfile.write_text("".join("127.0.0.1:0\n" for _ in range(n)))
+    return EnvContract(
+        workers_path=str(hostfile), workers_count=n, worker_chip_count=1,
+        coordinator="127.0.0.1:1234", host_id=0, storage=str(tmp_path),
+        generation=1)
+
+
+def _worker_env(run_dir: Path, shards: Path) -> dict[str, str]:
+    return {
+        "INPUT_E2E_RUN_DIR": str(run_dir),
+        "INPUT_E2E_SHARDS": str(shards),
+        "INPUT_E2E_BATCH": str(BATCH),
+        "INPUT_E2E_SEED": str(SEED),
+        "INPUT_E2E_EPOCHS": str(EPOCHS),
+        "INPUT_E2E_STEP_SLEEP": "0.05",
+        "INPUT_E2E_DECODE_SLEEP": "0.004",
+        "TPUCFN_INPUT_RCVBUF": str(64 * 1024),
+    }
+
+
+def _serve_argv(shards: Path) -> list[str]:
+    return [sys.executable, "-m", "tpucfn.cli", "data", "serve",
+            "--shards", str(shards), "--batch-size", str(BATCH),
+            "--seed", str(SEED), "--num-epochs", str(EPOCHS),
+            "--host", "127.0.0.1", "--idle-exit", "2.0",
+            "--queue-batches", "2", "--sndbuf-kb", "64",
+            "--send-deadline", "30"]
+
+
+def _run(tmp_path, shards, run_dir, *, input_plane: bool, input_port: int,
+         proxy_addr: str | None = None) -> GangCoordinator:
+    run_dir.mkdir(parents=True, exist_ok=True)
+    n = TRAINERS + (1 if input_plane else 0)
+    ft_dir = run_dir / "ft"
+    extra = _worker_env(run_dir, shards)
+    if proxy_addr is not None:
+        # route the trainers THROUGH the proxy: extra_env is applied
+        # last in host_env, overriding the launcher's computed fan-out
+        extra["TPUCFN_INPUT_ADDRS"] = proxy_addr
+        extra["TPUCFN_INPUT_OP_DEADLINE_S"] = str(OP_DEADLINE_S)
+    launcher = Launcher(
+        _contract(tmp_path, n), LocalTransport(),
+        ft_dir=str(ft_dir), ft_heartbeat_s=0.2,
+        input_hosts=1 if input_plane else 0,
+        input_port=input_port,
+        input_argv=_serve_argv(shards) if input_plane else None,
+        extra_env=extra)
+    monitor = HeartbeatMonitor(
+        ft_dir, expected_hosts=n,
+        config=MonitorConfig(interval_s=0.2, startup_grace_s=60.0))
+    coord = GangCoordinator(
+        launcher, [sys.executable, str(WORKER)],
+        policy=GangRestart(RestartBudget(0)), monitor=monitor,
+        ft_dir=ft_dir, poll_interval=0.02, term_grace_s=2.0)
+    assert coord.run() == 0
+    return coord
+
+
+def _trajectories(run_dir: Path) -> dict[int, list[str]]:
+    out = {}
+    for h in range(TRAINERS):
+        p = run_dir / f"losses-host{h:03d}.jsonl"
+        out[h] = [ln for ln in p.read_text().splitlines() if ln.strip()]
+        assert len(out[h]) == STEPS_PER_TRAINER * EPOCHS, (h, len(out[h]))
+    return out
+
+
+def _mode(run_dir: Path, h: int) -> dict:
+    return json.loads((run_dir / f"mode-host{h:03d}.json").read_text())
+
+
+def _fleet_step(run_dir: Path) -> int:
+    steps = []
+    for h in range(TRAINERS):
+        p = run_dir / f"losses-host{h:03d}.jsonl"
+        if not p.is_file():
+            steps.append(0)
+            continue
+        lines = [s for s in p.read_text().splitlines() if s.strip()]
+        steps.append(json.loads(lines[-1])["step"] if lines else 0)
+    return min(steps)
+
+
+def test_gray_input_trickle_degrades_within_deadline_bit_identical(tmp_path):
+    shards = _write_shards(tmp_path)
+
+    # -- reference: local loading, the bit-identical ground truth --------
+    ref_dir = tmp_path / "ref"
+    _run(tmp_path, shards, ref_dir, input_plane=False, input_port=9410)
+    ref = _trajectories(ref_dir)
+    assert not _mode(ref_dir, 0)["used_service"]
+
+    # -- gray: served through a proxy that starts trickling mid-run ------
+    gray_dir = tmp_path / "gray"
+    proxy = ChaosProxy("127.0.0.1:9420", host="127.0.0.1").start()
+    injected_ts = [None]
+
+    import threading
+
+    def injector():
+        # wait for real mid-run evidence (fleet step >= 10), then make
+        # the input plane TRICKLE: bytes keep flowing one dribble per
+        # tick, so only the end-to-end deadline can notice
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if _fleet_step(gray_dir) >= 10:
+                proxy.inject("throttle", rate_bps=128.0, duration_s=600.0)
+                injected_ts[0] = time.time()
+                return
+            time.sleep(0.05)
+
+    t = threading.Thread(target=injector, daemon=True)
+    t.start()
+    try:
+        coord = _run(tmp_path, shards, gray_dir, input_plane=True,
+                     input_port=9420, proxy_addr=proxy.address)
+    finally:
+        t.join(timeout=5)
+        proxy.close()
+    assert injected_ts[0] is not None, "fault never armed: drill vacuous"
+
+    got = _trajectories(gray_dir)
+    assert got == ref  # the whole point: gray degradation changed NOTHING
+    for h in range(TRAINERS):
+        m = _mode(gray_dir, h)
+        assert m["used_service"], m
+        assert m["degraded"], (h, m)
+        # the rc gate: trickle onset -> this trainer degraded to local
+        latency = m["degraded_ts"] - injected_ts[0]
+        assert latency <= DETECT_LATENCY_GATE_S, (
+            f"host {h} took {latency:.1f}s to degrade "
+            f"(gate {DETECT_LATENCY_GATE_S}s, deadline {OP_DEADLINE_S}s)")
+        assert latency > -1.0  # degraded BECAUSE of the fault, not before
+    # a trickling host is not a dead host: no gang incident, no budget
+    events = [json.loads(s) for s in
+              (gray_dir / "ft" / "events.jsonl").read_text().splitlines()
+              if s.strip()]
+    kinds = [e["kind"] for e in events]
+    assert "detect" not in kinds and "recovered" not in kinds
+    assert coord.policy.budget.used == 0
+
+
+def test_gray_artifact_stall_degrades_to_local_compile_in_deadline(tmp_path):
+    """The compile-plane half of the acceptance: a stalled artifact
+    server (payload stalls mid-stream, connection held open) degrades
+    to local compile within the op deadline — same program, latency
+    cost only."""
+    from tpucfn.compilecache.service import ArtifactServer, CompileCacheClient
+    from tpucfn.compilecache.store import ArtifactStore, cache_key
+
+    store_dir = tmp_path / "srvstore"
+    store = ArtifactStore(store_dir)
+    key = cache_key({"program": "e2e-gray"})
+    payload = bytes(range(256)) * 4096  # 1 MiB artifact
+    store.put(key, payload, {"key": key, "label": "e2e"})
+    srv = ArtifactServer(store_dir, host="127.0.0.1").start()
+    proxy = ChaosProxy(srv.address, host="127.0.0.1").start()
+    compiled = []
+    try:
+        # handshake + meta pass; the payload stalls at 128 KiB forever
+        proxy.inject("stall", duration_s=3600.0, direction="down",
+                     after_bytes=128 * 1024)
+        client = CompileCacheClient(
+            ArtifactStore(tmp_path / "local"), [proxy.address],
+            op_deadline_s=OP_DEADLINE_S, wait_s=4.0)
+        t0 = time.monotonic()
+        result, outcome = client.get_or_compile(
+            key, lambda: compiled.append(1) or b"the-program")
+        wall = time.monotonic() - t0
+    finally:
+        proxy.close()
+        srv.close()
+    assert (result, outcome) == (b"the-program", "compile")
+    assert compiled == [1]
+    # the rc gate: the whole degrade-to-compile path inside the bound
+    assert wall <= DETECT_LATENCY_GATE_S, (
+        f"stalled fetch degraded in {wall:.1f}s "
+        f"(gate {DETECT_LATENCY_GATE_S}s, op deadline {OP_DEADLINE_S}s)")
+    v = client.registry.varz()["metrics"]
+    assert v["net_compilecache_deadline_exceeded_total"] >= 1

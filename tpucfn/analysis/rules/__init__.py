@@ -17,6 +17,7 @@ from tpucfn.analysis.rules import (
     jax_hazards,
     locks,
     metrics_hygiene,
+    net_deadline,
     signal_safety,
     spans,
     totality,
@@ -84,6 +85,13 @@ ALL_RULES: dict[str, Rule] = {r.id: r for r in (
          "the HB_GLOB lesson (PR 5): scattered literals drift; one typo "
          "and a consumer silently never matches",
          vocab.check),
+    Rule("net-deadline",
+         "blocking socket ops in the fleet planes are reachable only "
+         "after a timeout/deadline is set on that socket",
+         "ISSUE 15: per-chunk socket timeouts let a trickling peer "
+         "reset the clock forever — the gray-failure class the "
+         "tpucfn.net deadline layer closes, kept closed here",
+         net_deadline.check),
     Rule("span-balance",
          "every emitted trace-span family is balanced (start AND "
          "end/duration observed) and consumed by some reader",

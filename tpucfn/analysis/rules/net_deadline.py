@@ -1,0 +1,385 @@
+"""net-deadline: blocking socket ops in the fleet planes are reachable
+only after a timeout/deadline is set on that socket (ISSUE 15).
+
+The incident encoded: the fleet planes' ``recv_frame`` loops ran
+per-chunk timeouts that a trickling peer reset forever, and one
+``accept``/``connect``/``sendall`` on a timeout-less socket blocks
+unboundedly — the gray-failure class the ``tpucfn.net`` deadline layer
+exists to close.  This rule makes the rewiring a proven property
+instead of a one-time cleanup: any NEW blocking socket op added to a
+plane without a ``settimeout`` (or a deadline-layer call, which sets
+one per chunk) fires here.
+
+Scope and mechanics (deliberately provenance-based — conservative,
+like every rule in the pack):
+
+* Only modules that ``import socket`` are scanned; only names whose
+  socket-ness is statically visible are tracked: ``socket.socket(...)``
+  results, ``accept()`` results of tracked sockets, aliases and
+  ``self.attr`` stores of those.
+* A tracked socket becomes *deadlined* at ``x.settimeout(t)`` with a
+  non-``None`` literal ``t`` (``settimeout(None)`` un-deadlines: that
+  is blocking mode), and stays so through plain aliasing.  A
+  ``self.attr`` is deadlined class-wide when ANY method settimeouts it
+  or stores a deadlined local into it.
+* Blocking ops: ``recv`` / ``recv_into`` / ``accept`` / ``connect`` /
+  ``send`` / ``sendall``.  Flagged on a tracked, un-deadlined receiver
+  — directly, or by passing it into a helper (same module) that blocks
+  on the corresponding parameter without its own prior ``settimeout``,
+  including one constructor hop (a class whose ``__init__`` stores the
+  parameter into an attr some method blocks on).
+* Unresolvable receivers (function parameters at the top of a call
+  chain, returns of opaque calls) stay silent — the rule prefers a
+  missed maybe-hazard to a false alarm, per the pack's standing rule.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpucfn.analysis.core import Analysis, Finding, Module, sub_suites
+
+RULE_ID = "net-deadline"
+
+BLOCKING_OPS = frozenset(
+    {"recv", "recv_into", "accept", "connect", "send", "sendall"})
+
+
+def _imports_socket(mod: Module) -> bool:
+    for node in mod.tree.body:
+        if isinstance(node, ast.Import):
+            if any(a.name == "socket" for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom) and node.module == "socket":
+            return True
+    return False
+
+
+def _recv_name(node: ast.expr) -> str | None:
+    """Normalized receiver identity: bare name, or ``self.attr`` as
+    ``"self.attr"`` (other attribute chains are untracked)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return f"self.{node.attr}"
+    return None
+
+
+def _is_socket_ctor(value: ast.expr) -> bool:
+    """``socket.socket(...)`` / bare ``socket(...)`` (from-import)."""
+    if not isinstance(value, ast.Call):
+        return False
+    f = value.func
+    if isinstance(f, ast.Attribute):
+        return (f.attr == "socket" and isinstance(f.value, ast.Name)
+                and f.value.id == "socket")
+    return isinstance(f, ast.Name) and f.id == "socket"
+
+
+def _accept_call(value: ast.expr) -> str | None:
+    """Receiver name of an ``<recv>.accept()`` RHS, else None."""
+    if isinstance(value, ast.Call) and isinstance(value.func, ast.Attribute) \
+            and value.func.attr == "accept":
+        return _recv_name(value.func.value)
+    return None
+
+
+class _ClassInfo:
+    def __init__(self):
+        self.deadlined_attrs: set[str] = set()   # settimeout'd somewhere
+        self.blocking_attrs: dict[str, tuple[int, str]] = {}  # attr->(line,op)
+        self.ctor_param_attrs: dict[str, str] = {}  # attr -> __init__ param
+
+
+class _FuncScan:
+    """One lexical pass over a function: tracks socket provenance and
+    deadlined-ness per name, records blocking uses."""
+
+    def __init__(self, rule, mod: Module, info, class_info: _ClassInfo | None):
+        self.rule = rule
+        self.mod = mod
+        self.info = info
+        self.class_info = class_info
+        self.params = set(info.params)
+        self.tracked: set[str] = set()     # names with socket provenance
+        self.deadlined: set[str] = set()
+        # params that received a blocking op before any settimeout —
+        # this function's summary (callers must pass deadlined sockets)
+        self.blocking_params: set[str] = set()
+        self.findings: list[Finding] = []
+        self._reported: set[tuple[str, str]] = set()  # (recv, op) dedupe
+
+    # -- events ------------------------------------------------------------
+
+    def _settimeout(self, recv: str, call: ast.Call) -> None:
+        none_arg = (len(call.args) >= 1
+                    and isinstance(call.args[0], ast.Constant)
+                    and call.args[0].value is None)
+        if none_arg:
+            self.deadlined.discard(recv)
+            return
+        self.deadlined.add(recv)
+        if recv.startswith("self.") and self.class_info is not None:
+            self.class_info.deadlined_attrs.add(recv[5:])
+
+    def _blocking_use(self, recv: str, op: str, line: int) -> None:
+        if recv in self.deadlined:
+            return
+        if (recv, op) in self._reported:
+            return  # e.g. an accept() seen by both _assign and _call
+        self._reported.add((recv, op))
+        if recv.startswith("self."):
+            attr = recv[5:]
+            if self.class_info is not None:
+                self.class_info.blocking_attrs.setdefault(attr, (line, op))
+            return  # resolved class-wide after all methods scanned
+        if recv in self.params:
+            self.blocking_params.add(recv)
+            return
+        if recv in self.tracked:
+            self.findings.append(Finding(
+                RULE_ID, self.mod.rel, line,
+                f"blocking socket op {op!r} on {recv!r} in "
+                f"{self.info.qualname} with no timeout/deadline set on "
+                "that socket — a stalled or trickling peer blocks this "
+                "call forever; settimeout() first (or route through the "
+                "tpucfn.net deadline layer, which sets one per chunk)",
+                key=f"netdl:{self.info.qualname}:{recv}:{op}"))
+
+    def _assign(self, stmt: ast.Assign) -> None:
+        v = stmt.value
+        src: str | None = None
+        fresh = False
+        if _is_socket_ctor(v):
+            fresh = True
+        else:
+            acc = _accept_call(v)
+            if acc is not None:
+                self._blocking_use(acc, "accept", stmt.lineno)
+                fresh = True  # the accepted conn: a new, timeout-less socket
+            elif isinstance(v, ast.Name) or (
+                    isinstance(v, ast.Attribute)
+                    and isinstance(v.value, ast.Name)
+                    and v.value.id == "self"):
+                src = _recv_name(v)
+        for t in stmt.targets:
+            names = []
+            if isinstance(t, (ast.Tuple, ast.List)) and t.elts:
+                # `conn, addr = s.accept()`: the socket is element 0
+                n0 = _recv_name(t.elts[0])
+                if n0 is not None:
+                    names.append(n0)
+            else:
+                n = _recv_name(t)
+                if n is not None:
+                    names.append(n)
+            for name in names:
+                if fresh:
+                    self.tracked.add(name)
+                    self.deadlined.discard(name)
+                elif src is not None and src in self.tracked:
+                    self.tracked.add(name)
+                    if src in self.deadlined:
+                        self.deadlined.add(name)
+                    else:
+                        self.deadlined.discard(name)
+                else:
+                    # reassigned from something untracked: stop tracking
+                    self.tracked.discard(name)
+                    self.deadlined.discard(name)
+                    continue
+                if name.startswith("self.") and self.class_info is not None \
+                        and src is not None and src in self.deadlined:
+                    self.class_info.deadlined_attrs.add(name[5:])
+
+    def _call(self, call: ast.Call, line: int) -> None:
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            recv = _recv_name(f.value)
+            if recv is not None:
+                if f.attr == "settimeout":
+                    self._settimeout(recv, call)
+                    return
+                if f.attr in BLOCKING_OPS:
+                    self._blocking_use(recv, f.attr, line)
+                    return
+        # passing a tracked socket into a helper that blocks on it
+        blocking_idx = self.rule.blocking_param_indices(self.mod, call)
+        if blocking_idx:
+            for i, arg in enumerate(call.args):
+                if i not in blocking_idx:
+                    continue
+                name = _recv_name(arg)
+                if name is None:
+                    continue
+                self._blocking_use(name, f"arg{i} of helper", line)
+
+    # -- the walk ----------------------------------------------------------
+
+    def run(self) -> None:
+        self._walk(self.info.node.body)
+
+    def _walk(self, body) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested defs are their own scan
+            if isinstance(stmt, ast.Assign):
+                self._assign(stmt)
+            for call in _calls_of(stmt):
+                self._call(call, getattr(call, "lineno", stmt.lineno))
+            for suite in sub_suites(stmt):
+                self._walk(suite)
+
+
+def _calls_of(stmt: ast.stmt):
+    """Call nodes in this statement's own expressions (not nested
+    suites — the walk recurses those, keeping lexical order), not
+    inside nested defs/lambdas."""
+    for field in stmt._fields:
+        if field in ("body", "orelse", "finalbody", "handlers", "cases"):
+            continue
+        v = getattr(stmt, field, None)
+        exprs = v if isinstance(v, list) else [v]
+        for e in exprs:
+            if isinstance(e, ast.withitem):
+                e = e.context_expr
+            if not isinstance(e, ast.expr):
+                continue
+            stack = [e]
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(node, ast.Call):
+                    yield node
+                stack.extend(ast.iter_child_nodes(node))
+
+
+class _NetDeadlineRule:
+    def __init__(self, analysis: Analysis):
+        self.analysis = analysis
+        # per-module: func qualname -> set of blocking param indices
+        self._summaries: dict[str, dict[str, set[int]]] = {}
+        self._class_infos: dict[str, dict[str, _ClassInfo]] = {}
+
+    # -- summaries ---------------------------------------------------------
+
+    def blocking_param_indices(self, mod: Module,
+                               call: ast.Call) -> set[int]:
+        """Which positional args of this call feed a parameter the
+        callee blocks on without its own settimeout — bare-name helper
+        calls and one-level constructor calls of same-module classes."""
+        f = call.func
+        summaries = self._summaries.get(mod.rel, {})
+        if isinstance(f, ast.Name):
+            if f.id in summaries:
+                return summaries[f.id]
+            # constructor hop: Cls(...) whose __init__ stores a param
+            # into an attr some method blocks on, class-undeadlined
+            cls_infos = self._class_infos.get(mod.rel, {})
+            ci = cls_infos.get(f.id)
+            if ci is not None:
+                funcs = self.analysis.functions(mod)
+                init = funcs.get(f"{f.id}.__init__")
+                if init is not None:
+                    params = [p for p in init.params if p != "self"]
+                    out = set()
+                    for attr, param in ci.ctor_param_attrs.items():
+                        if attr in ci.blocking_attrs \
+                                and attr not in ci.deadlined_attrs \
+                                and param in params:
+                            out.add(params.index(param))
+                    return out
+        return set()
+
+    def check(self):
+        findings: list[Finding] = []
+        mods = [m for m in self.analysis.modules if _imports_socket(m)]
+        for mod in mods:
+            self._summaries[mod.rel] = {}
+            self._class_infos[mod.rel] = {}
+        # Two fixpoint rounds: round 1 builds per-function summaries
+        # (direct blocking params) and class info; round 2 sees calls
+        # into those summaries (the recv_frame -> _recv_exact chain and
+        # the constructor hop).  Findings are taken from the LAST round
+        # only — earlier rounds exist to converge the summaries.
+        for round_ in range(2):
+            last = round_ == 1
+            for mod in mods:
+                funcs = self.analysis.functions(mod)
+                cls_infos = self._class_infos[mod.rel]
+                scans: list[tuple[str, _FuncScan]] = []
+                for q, info in funcs.items():
+                    if isinstance(info.node, ast.Lambda):
+                        continue
+                    ci = None
+                    if info.class_name is not None:
+                        ci = cls_infos.setdefault(info.class_name,
+                                                  _ClassInfo())
+                    scan = _FuncScan(self, mod, info, ci)
+                    scan.run()
+                    scans.append((q, scan))
+                    # __init__ param -> attr flow for the ctor hop
+                    if ci is not None and q.endswith(".__init__"):
+                        self._ctor_flow(info, ci)
+                summ = self._summaries[mod.rel]
+                for q, scan in scans:
+                    # only module-level helpers are resolvable at their
+                    # bare-name call sites; methods reach sockets via
+                    # self-attrs, which the class resolution covers
+                    if scan.blocking_params and "." not in q:
+                        params = scan.info.params
+                        summ[q] = {params.index(p)
+                                   for p in scan.blocking_params}
+                if last:
+                    for _q, scan in scans:
+                        findings.extend(scan.findings)
+        if not mods:
+            return findings
+        # class-wide resolution: blocking attrs never deadlined
+        # anywhere in the class, and not fed by a ctor param (those are
+        # the caller's obligation, checked at the constructor call)
+        for mod in mods:
+            for cname, ci in self._class_infos[mod.rel].items():
+                for attr, (line, op) in sorted(ci.blocking_attrs.items()):
+                    if attr in ci.deadlined_attrs:
+                        continue
+                    if attr in ci.ctor_param_attrs:
+                        continue
+                    findings.append(Finding(
+                        RULE_ID, mod.rel, line,
+                        f"blocking socket op {op!r} on self.{attr} but no "
+                        f"method of {cname} ever sets a timeout/deadline "
+                        "on it — a stalled or trickling peer blocks "
+                        "forever; settimeout() it (or route through the "
+                        "tpucfn.net deadline layer)",
+                        key=f"netdl:{cname}.{attr}:{op}"))
+        return findings
+
+    @staticmethod
+    def _ctor_flow(init_info, ci: _ClassInfo) -> None:
+        params = set(init_info.params) - {"self"}
+
+        def walk(body):
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                if isinstance(stmt, ast.Assign) \
+                        and isinstance(stmt.value, ast.Name) \
+                        and stmt.value.id in params:
+                    for t in stmt.targets:
+                        name = _recv_name(t)
+                        if name is not None and name.startswith("self."):
+                            ci.ctor_param_attrs[name[5:]] = stmt.value.id
+                for suite in sub_suites(stmt):
+                    walk(suite)
+
+        walk(init_info.node.body)
+
+
+def check(analysis: Analysis):
+    return _NetDeadlineRule(analysis).check()

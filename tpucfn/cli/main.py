@@ -197,6 +197,45 @@ def cmd_launch(args) -> int:
             print(f"error: --kill-host-after host {inject[0]} out of range "
                   f"(cluster has {len(contract.hosts())} hosts)", file=sys.stderr)
             return 2
+    # Chaos plane (ISSUE 15): a launch-level chaos spec replays against
+    # the gang coordinator — kills, hangs, AND the net_* gray-failure
+    # ops, which land on the --chaos-proxy instances this process runs.
+    # Spec parsing is pure validation and must precede every bind.
+    chaos_spec = None
+    if args.chaos:
+        if not args.ft:
+            print("error: --chaos needs --ft (chaos specs replay against "
+                  "the gang coordinator's supervision clock)",
+                  file=sys.stderr)
+            return 2
+        from tpucfn.ft.chaos import ChaosSpec
+
+        raw = args.chaos
+        try:
+            if not raw.lstrip().startswith("{"):
+                raw = Path(raw).read_text()
+            chaos_spec = ChaosSpec.from_json(raw)
+        except (OSError, ValueError, TypeError) as e:
+            print(f"error: bad --chaos spec: {e}", file=sys.stderr)
+            return 2
+    proxy_specs: list[tuple[int, str]] = []
+    for raw in args.chaos_proxy or []:
+        parts = raw.split(":")
+        if len(parts) != 3 or not parts[0].isdigit() \
+                or not parts[2].isdigit():
+            print("error: --chaos-proxy wants LISTEN:HOST:PORT (e.g. "
+                  f"7651:127.0.0.1:7641), got {raw!r}", file=sys.stderr)
+            return 2
+        proxy_specs.append((int(parts[0]), f"{parts[1]}:{parts[2]}"))
+    if chaos_spec is not None and not proxy_specs \
+            and any(e.action.startswith("net_")
+                    for e in chaos_spec.events):
+        # a net fault with nowhere to land is a usage error HERE, not a
+        # coordinator exception minutes into the run
+        print("error: --chaos spec schedules net_* events — they need "
+              "at least one --chaos-proxy LISTEN:HOST:PORT to land on",
+              file=sys.stderr)
+        return 2
     # Fleet warm start (ISSUE 13): the coordinator process runs the
     # jax-free artifact server and fans its address out to every host
     # (TPUCFN_COMPILE_CACHE_ADDRS) — host 0 compiles once, peers fetch;
@@ -233,6 +272,24 @@ def cmd_launch(args) -> int:
         cc_addrs = [f"{advertise}:{cc_server.port}"]
         print(f"compile-artifact server: {cc_addrs[0]} (store {cc_dir})",
               file=sys.stderr)
+    net_proxies = []
+    if proxy_specs:
+        from tpucfn.net.proxy import ChaosProxy
+
+        try:
+            for listen, upstream in proxy_specs:
+                p = ChaosProxy(upstream, host="0.0.0.0", port=listen,
+                               registry=registry)
+                p.start()
+                net_proxies.append(p)
+                print(f"chaos proxy: :{p.port} -> {upstream}",
+                      file=sys.stderr)
+        except BaseException:
+            for p in net_proxies:
+                p.close()
+            if cc_server is not None:
+                cc_server.close()
+            raise
     launcher = Launcher(contract, transport,
                         obs_base_port=args.obs_port or None,
                         ft_dir=str(ft_dir) if ft_dir else None,
@@ -304,6 +361,8 @@ def cmd_launch(args) -> int:
                   f"(hosts at ports {args.obs_port + 1}..."
                   f"{args.obs_port + n_launched})", file=sys.stderr)
     except BaseException:
+        for p in net_proxies:
+            p.close()
         if cc_server is not None:
             cc_server.close()
         raise
@@ -355,7 +414,9 @@ def cmd_launch(args) -> int:
                     flap_budget=args.ft_straggler_flap_budget),
                 restart_input_hosts=args.ft_restart_input_hosts,
                 adopt=(True if args.adopt
-                       else False if args.no_adopt else "auto"))
+                       else False if args.no_adopt else "auto"),
+                chaos=chaos_spec,
+                net_proxies=net_proxies)
             coord_ref["coord"] = coordinator
             rc = coordinator.run()
         else:
@@ -364,10 +425,87 @@ def cmd_launch(args) -> int:
     finally:
         if obs_srv is not None:
             obs_srv.close()
+        for p in net_proxies:
+            p.close()
         if cc_server is not None:
             cc_server.close()
     print(f"launch finished rc={rc}")
     return rc
+
+
+def cmd_chaos_proxy(args) -> int:
+    """Run the network fault-injection proxy standalone (ISSUE 15):
+    ``tpucfn chaos proxy --listen P --upstream H:P --spec faults.json``
+    fronts any fleet plane's port and injects the scheduled gray
+    failures (latency/throttle/stall/partition/tear/rst) at their
+    seeded, deterministic times.  SIGTERM (or ``--serve-for``) ends it
+    with a stats JSON line — the same operational shape as ``tpucfn
+    data serve`` and ``compilecache serve``."""
+    import json as _json
+    import signal as _signal
+    import time as _time
+
+    from tpucfn.net.proxy import ChaosProxy, NetFaultSchedule
+
+    host, _, port = args.upstream.rpartition(":")
+    if not port.isdigit():
+        print(f"error: --upstream wants HOST:PORT, got {args.upstream!r}",
+              file=sys.stderr)
+        return 2
+    schedule = None
+    if args.spec:
+        raw = args.spec
+        try:
+            if not raw.lstrip().startswith("{"):
+                raw = Path(raw).read_text()
+            schedule = NetFaultSchedule.from_json(raw)
+            if args.seed is not None:
+                schedule = NetFaultSchedule(faults=schedule.faults,
+                                            seed=args.seed)
+        except (OSError, ValueError, TypeError) as e:
+            print(f"error: bad --spec: {e}", file=sys.stderr)
+            return 2
+    from tpucfn.obs import MetricRegistry
+
+    registry = MetricRegistry(labels={"role": "chaosproxy"})
+    proxy = ChaosProxy(args.upstream, host=args.host, port=args.listen,
+                       schedule=schedule, registry=registry)
+    stop = [False]
+
+    def _on_term(signum, frame):
+        # ONE plain GIL-atomic store (the PR 8 signal lesson); the main
+        # loop notices and closes.
+        stop[0] = True
+
+    try:
+        _signal.signal(_signal.SIGTERM, _on_term)
+    except ValueError:
+        pass  # not the main thread (embedded use)
+    t0 = _time.monotonic()
+    try:
+        proxy.start()
+        print(f"chaos proxy listening on {proxy.address} -> "
+              f"{args.upstream}"
+              + (f" ({len(schedule.faults)} scheduled fault(s), "
+                 f"seed {schedule.seed})" if schedule else ""),
+              file=sys.stderr)
+        deadline = (t0 + args.serve_for) if args.serve_for > 0 else None
+        while not stop[0]:
+            if deadline is not None and _time.monotonic() >= deadline:
+                break
+            _time.sleep(0.2)
+    finally:
+        proxy.close()
+    m = registry.varz()["metrics"]
+    print(_json.dumps({
+        "served_s": round(_time.monotonic() - t0, 3),
+        "connections": m.get("net_proxy_connections_total", 0),
+        "faults_fired": m.get("net_proxy_faults_fired_total", 0),
+        "forwarded_bytes": m.get("net_proxy_forwarded_bytes_total", 0),
+        "dropped_bytes": m.get("net_proxy_dropped_bytes_total", 0),
+        "fired": proxy.fired,
+    }))
+    return 0
 
 
 def cmd_kill_host(args) -> int:
@@ -480,6 +618,7 @@ def cmd_data_serve(args) -> int:
         num_epochs=args.num_epochs, host=args.host, port=port,
         queue_batches=args.queue_batches, mp_workers=args.mp_workers,
         sndbuf_bytes=args.sndbuf_kb * 1024 if args.sndbuf_kb else None,
+        send_deadline_s=args.send_deadline,
         registry=registry, shuffle=not args.no_shuffle,
         cache_in_memory=not args.stream,
         num_workers=args.workers)
@@ -1718,6 +1857,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "the coordinator host — correct when tpucfn "
                         "launch runs ON host 0; set this when launching "
                         "from elsewhere, the server runs in THIS process)")
+    l.add_argument("--chaos", metavar="SPEC",
+                   help="deterministic fault injection (needs --ft): a "
+                        "ChaosSpec JSON file (or inline JSON) replayed "
+                        "against the coordinator — kill/hang/... plus the "
+                        "net_* gray-failure ops, which land on the "
+                        "--chaos-proxy instances")
+    l.add_argument("--chaos-proxy", metavar="LISTEN:HOST:PORT",
+                   action="append",
+                   help="run a fault-injection TCP proxy in this process: "
+                        "listen on LISTEN, forward to HOST:PORT "
+                        "(repeatable; the targets of net_* chaos ops, "
+                        "indexed by flag order)")
     l.add_argument("--supervise", action="store_true",
                    help="wrap the coordinator in a jax-free re-exec loop: "
                         "a crashed coordinator is relaunched and adopts "
@@ -1748,6 +1899,33 @@ def build_parser() -> argparse.ArgumentParser:
     fs.add_argument("--json", action="store_true",
                     help="emit the full fleet report as one JSON object")
     fs.set_defaults(fn=cmd_ft_status)
+
+    ch = sub.add_parser(
+        "chaos",
+        help="network fault injection (gray failures: latency, trickle, "
+             "stall, partition, tear, RST)")
+    chsub = ch.add_subparsers(dest="chaos_command", required=True)
+    cp = chsub.add_parser(
+        "proxy",
+        help="run a deterministic fault-injection TCP proxy in front of "
+             "any fleet plane's port")
+    cp.add_argument("--listen", type=int, default=0, metavar="PORT",
+                    help="port to listen on (0 = ephemeral, printed)")
+    cp.add_argument("--upstream", required=True, metavar="HOST:PORT",
+                    help="where healthy traffic forwards to")
+    cp.add_argument("--host", default="0.0.0.0",
+                    help="bind address (default 0.0.0.0)")
+    cp.add_argument("--spec", metavar="FILE|JSON",
+                    help="NetFaultSchedule JSON: {\"seed\": N, \"faults\": "
+                         "[{\"kind\": \"throttle\", \"at_s\": 5, "
+                         "\"rate_bps\": 1024, \"duration_s\": 30}, ...]}")
+    cp.add_argument("--seed", type=int, default=None,
+                    help="override the schedule's seed (determinism: same "
+                         "seed, same fault timeline)")
+    cp.add_argument("--serve-for", type=float, default=0.0,
+                    metavar="SECONDS",
+                    help="exit after this long (0 = until SIGTERM)")
+    cp.set_defaults(fn=cmd_chaos_proxy)
 
     k = sub.add_parser("kill-host", help="fault injection: mark a host dead")
     k.add_argument("--name", required=True)
@@ -1835,6 +2013,13 @@ def build_parser() -> argparse.ArgumentParser:
     dsv.add_argument("--obs-port", type=int, default=None, metavar="PORT",
                      help="serve /metrics /healthz /varz (default: "
                           "TPUCFN_OBS_PORT from the launch fan-out)")
+    dsv.add_argument("--send-deadline", type=float, default=120.0,
+                     metavar="SECONDS",
+                     help="end-to-end deadline per sent frame: a stalled/"
+                          "blackholed trainer is dropped (and its producer "
+                          "freed) after this long instead of pinning the "
+                          "stream; must exceed the trainers' worst-case "
+                          "step time (0 = disabled)")
     dsv.set_defaults(fn=cmd_data_serve)
 
     cc = sub.add_parser(

@@ -43,6 +43,12 @@ from tpucfn.data.service import (
     recv_frame,
     send_frame,
 )
+from tpucfn.net.deadline import (
+    Deadline,
+    DeadlineExceeded,
+    NetMetrics,
+    RetryPolicy,
+)
 from tpucfn.compilecache.store import (
     ArtifactStore,
     CacheCorrupt,
@@ -120,6 +126,7 @@ class ArtifactServer:
                  port: int = 0, device_kind: str | None = None,
                  jax_version: str | None = None,
                  claim_ttl_s: float = 600.0,
+                 send_deadline_s: float = 60.0,
                  registry=None,
                  clock: Callable[[], float] = time.monotonic):
         self.store = ArtifactStore(store_dir)
@@ -131,6 +138,11 @@ class ArtifactServer:
         self.device_kind = device_kind
         self.jax_version = jax_version
         self.claim_ttl_s = claim_ttl_s
+        # End-to-end bound on serving one response frame (ISSUE 15): an
+        # artifact payload is tens of MB, and a stalled/trickling client
+        # would otherwise pin this connection's thread for as long as
+        # per-chunk timeouts keep resetting.
+        self.send_deadline_s = float(send_deadline_s)
         self.clock = clock
         self._claims: dict[str, float] = {}  # key -> expiry
         self._lock = threading.Lock()
@@ -154,6 +166,10 @@ class ArtifactServer:
         self.refusals_c = registry.counter(
             "compilecache_handshake_refusals_total",
             "connections refused at the identity handshake")
+        self.send_stalls_c = registry.counter(
+            "compilecache_send_stalls_total",
+            "responses dropped because the send deadline expired "
+            "(stalled/trickling client)")
         self.bytes_c = registry.counter(
             "compilecache_served_bytes_total", "artifact payload bytes served")
         registry.computed_gauge(
@@ -247,23 +263,20 @@ class ArtifactServer:
         try:
             kind, payload = recv_frame(conn, magic=CC_MAGIC)
             if kind != CC_HELLO:
-                send_frame(conn, CC_ERROR, b"expected HELLO",
-                           magic=CC_MAGIC)
+                self._send(conn, CC_ERROR, b"expected HELLO")
                 return
             try:
                 hello = json.loads(bytes(payload).decode())
             except (UnicodeDecodeError, json.JSONDecodeError):
-                send_frame(conn, CC_ERROR, b"undecodable HELLO",
-                           magic=CC_MAGIC)
+                self._send(conn, CC_ERROR, b"undecodable HELLO")
                 return
             refusal = self._validate_hello(hello)
             if refusal:
                 self.refusals_c.add()
-                send_frame(conn, CC_ERROR, refusal.encode(), magic=CC_MAGIC)
+                self._send(conn, CC_ERROR, refusal.encode())
                 return
-            send_frame(conn, CC_OK,
-                       json.dumps({"v": CC_PROTOCOL_VERSION}).encode(),
-                       magic=CC_MAGIC)
+            self._send(conn, CC_OK,
+                       json.dumps({"v": CC_PROTOCOL_VERSION}).encode())
             kind, payload = recv_frame(conn, magic=CC_MAGIC)
             if kind == CC_GET:
                 self._op_get(conn, bytes(payload).decode())
@@ -274,15 +287,20 @@ class ArtifactServer:
             elif kind == CC_RELEASE:
                 self._op_release(conn, bytes(payload).decode())
             elif kind == CC_STAT:
-                send_frame(conn, CC_OK, json.dumps({
+                self._send(conn, CC_OK, json.dumps({
                     "entries": len(self.store.keys()),
                     "claims": len(self._live_claims()),
                     "device_kind": self.device_kind,
                     "jax_version": self.jax_version,
-                }).encode(), magic=CC_MAGIC)
+                }).encode())
             else:
-                send_frame(conn, CC_ERROR,
-                           f"unknown op {kind!r}".encode(), magic=CC_MAGIC)
+                self._send(conn, CC_ERROR,
+                           f"unknown op {kind!r}".encode())
+        except DeadlineExceeded:
+            # a response outlived its send deadline: the client is
+            # stalled or trickling — drop the connection (it is one-op;
+            # nothing to salvage) and count the gray failure
+            self.send_stalls_c.add()
         except (OSError, ServiceError):
             pass  # client vanished / torn frame: nothing to answer
         finally:
@@ -290,6 +308,18 @@ class ArtifactServer:
                 conn.close()
             except OSError:
                 pass
+
+    def _send(self, conn: socket.socket, kind: bytes,
+              payload: bytes) -> None:
+        """One response frame under its own end-to-end deadline — a
+        multi-MB artifact to a stalled client fails inside the bound
+        instead of pinning this connection thread per-chunk-forever.
+        0 disables the bound (the sibling-knob convention) instead of
+        minting an already-expired deadline."""
+        send_frame(conn, kind, payload, magic=CC_MAGIC,
+                   deadline=(Deadline(self.send_deadline_s,
+                                      label="compilecache send")
+                             if self.send_deadline_s > 0 else None))
 
     def _live_claims(self) -> dict[str, float]:
         now = self.clock()
@@ -300,34 +330,30 @@ class ArtifactServer:
     def _op_get(self, conn: socket.socket, key: str) -> None:
         self.gets_c.add()
         if not valid_key(key):
-            send_frame(conn, CC_ERROR, f"invalid key {key!r}".encode(),
-                       magic=CC_MAGIC)
+            self._send(conn, CC_ERROR, f"invalid key {key!r}".encode())
             return
         try:
             got = self.store.get(key)
         except (CacheCorrupt, CacheMismatch) as e:
             # quarantined server-side; the client sees a miss and
             # compiles — the corrupt artifact is never served.
-            send_frame(conn, CC_MISS,
+            self._send(conn, CC_MISS,
                        json.dumps({"claimed": False,
-                                   "corrupt": str(e)}).encode(),
-                       magic=CC_MAGIC)
+                                   "corrupt": str(e)}).encode())
             return
         if got is None:
             claimed = key in self._live_claims()
-            send_frame(conn, CC_MISS,
-                       json.dumps({"claimed": claimed}).encode(),
-                       magic=CC_MAGIC)
+            self._send(conn, CC_MISS,
+                       json.dumps({"claimed": claimed}).encode())
             return
         payload, meta = got
         self.hits_c.add()
         self.bytes_c.add(len(payload))
-        send_frame(conn, CC_HIT, _pack_entry(meta, payload), magic=CC_MAGIC)
+        self._send(conn, CC_HIT, _pack_entry(meta, payload))
 
     def _op_claim(self, conn: socket.socket, key: str) -> None:
         if not valid_key(key):
-            send_frame(conn, CC_ERROR, f"invalid key {key!r}".encode(),
-                       magic=CC_MAGIC)
+            self._send(conn, CC_ERROR, f"invalid key {key!r}".encode())
             return
         if self.store.has(key):
             # published while the client was dialing: answer as a GET —
@@ -347,18 +373,17 @@ class ArtifactServer:
                 self.gets_c.add()
                 self.hits_c.add()
                 self.bytes_c.add(len(payload))
-                send_frame(conn, CC_HIT, _pack_entry(meta, payload),
-                           magic=CC_MAGIC)
+                self._send(conn, CC_HIT, _pack_entry(meta, payload))
                 return
         now = self.clock()
         with self._lock:
             expiry = self._claims.get(key, 0.0)
             if expiry > now:
-                send_frame(conn, CC_BUSY, b"", magic=CC_MAGIC)
+                self._send(conn, CC_BUSY, b"")
                 return
             self._claims[key] = now + self.claim_ttl_s
         self.claims_c.add()
-        send_frame(conn, CC_GRANTED, b"", magic=CC_MAGIC)
+        self._send(conn, CC_GRANTED, b"")
 
     def _op_release(self, conn: socket.socket, key: str) -> None:
         """A granted claimer whose compile (or publish) failed gives
@@ -366,31 +391,27 @@ class ArtifactServer:
         publish that will never come — without this, a single failed
         compile on the claim owner holds every peer until claim_ttl_s."""
         if not valid_key(key):
-            send_frame(conn, CC_ERROR, f"invalid key {key!r}".encode(),
-                       magic=CC_MAGIC)
+            self._send(conn, CC_ERROR, f"invalid key {key!r}".encode())
             return
         with self._lock:
             self._claims.pop(key, None)
-        send_frame(conn, CC_OK, json.dumps({"released": key}).encode(),
-                   magic=CC_MAGIC)
+        self._send(conn, CC_OK, json.dumps({"released": key}).encode())
 
     def _op_put(self, conn: socket.socket, blob) -> None:
         try:
             meta, payload = _unpack_entry(blob)
         except ServiceError as e:
-            send_frame(conn, CC_ERROR, str(e).encode(), magic=CC_MAGIC)
+            self._send(conn, CC_ERROR, str(e).encode())
             return
         key = str(meta.get("key") or "")
         if not valid_key(key):
-            send_frame(conn, CC_ERROR, f"invalid key {key!r}".encode(),
-                       magic=CC_MAGIC)
+            self._send(conn, CC_ERROR, f"invalid key {key!r}".encode())
             return
         self.store.put(key, payload, meta)
         with self._lock:
             self._claims.pop(key, None)
         self.puts_c.add()
-        send_frame(conn, CC_OK, json.dumps({"stored": key}).encode(),
-                   magic=CC_MAGIC)
+        self._send(conn, CC_OK, json.dumps({"stored": key}).encode())
 
 
 # -- the client -------------------------------------------------------------
@@ -404,27 +425,37 @@ class ArtifactClient:
 
     def __init__(self, addr: str, *, device_kind: str = "",
                  jax_version: str = "", connect_timeout_s: float = 5.0,
-                 recv_timeout_s: float = 60.0):
+                 recv_timeout_s: float = 60.0,
+                 op_deadline_s: float | None = None,
+                 net_metrics: NetMetrics | None = None):
         self.addr = addr
         self.device_kind = device_kind
         self.jax_version = jax_version
         self.connect_timeout_s = connect_timeout_s
         self.recv_timeout_s = recv_timeout_s
+        # One op = dial + handshake + request + response, end to end
+        # (ISSUE 15).  recv_timeout_s alone was per-chunk — a trickling
+        # server delivering an artifact a byte per timeout never failed.
+        self.op_deadline_s = (float(op_deadline_s) if op_deadline_s
+                              else recv_timeout_s)
+        self.net_metrics = net_metrics
 
-    def _dial(self) -> socket.socket:
+    def _dial(self, deadline: Deadline) -> socket.socket:
         host, _, port = self.addr.rpartition(":")
         sock = None
         try:
             sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-            sock.settimeout(self.connect_timeout_s)
+            sock.settimeout(deadline.timeout(cap=self.connect_timeout_s,
+                                             what="connect"))
             sock.connect((host or "127.0.0.1", int(port)))
             sock.settimeout(self.recv_timeout_s)
             hello = {"v": CC_PROTOCOL_VERSION,
                      "device_kind": self.device_kind,
                      "jax_version": self.jax_version}
             send_frame(sock, CC_HELLO, json.dumps(hello).encode(),
-                       magic=CC_MAGIC)
-            kind, payload = recv_frame(sock, magic=CC_MAGIC)
+                       magic=CC_MAGIC, deadline=deadline)
+            kind, payload = recv_frame(sock, magic=CC_MAGIC,
+                                       deadline=deadline)
             if kind == CC_ERROR:
                 raise ServiceError(
                     f"artifact server {self.addr} refused: "
@@ -438,6 +469,11 @@ class ArtifactClient:
                     sock.close()
                 except OSError:
                     pass
+            if isinstance(e, DeadlineExceeded):
+                if self.net_metrics is not None:
+                    self.net_metrics.deadline_exceeded_c.add()
+                raise ServiceError(
+                    f"artifact server {self.addr}: {e}") from None
             raise ServiceError(
                 f"connect to artifact server {self.addr}: {e}") from None
         except ServiceError:
@@ -449,10 +485,18 @@ class ArtifactClient:
             raise
 
     def _op(self, kind: bytes, payload: bytes) -> tuple[bytes, bytearray]:
-        sock = self._dial()
+        deadline = Deadline(self.op_deadline_s, label="compilecache op")
+        sock = self._dial(deadline)
         try:
-            send_frame(sock, kind, payload, magic=CC_MAGIC)
-            resp, body = recv_frame(sock, magic=CC_MAGIC)
+            send_frame(sock, kind, payload, magic=CC_MAGIC,
+                       deadline=deadline)
+            resp, body = recv_frame(sock, magic=CC_MAGIC, deadline=deadline)
+        except DeadlineExceeded as e:
+            # gray peer (stalled mid-response / trickling payload):
+            # counted, then degraded exactly like a dead one
+            if self.net_metrics is not None:
+                self.net_metrics.deadline_exceeded_c.add()
+            raise ServiceError(f"artifact op to {self.addr}: {e}") from None
         except OSError as e:
             raise ServiceError(f"artifact op to {self.addr}: {e}") from None
         finally:
@@ -541,6 +585,8 @@ class CompileCacheClient:
                  registry=None, tracer=None, probe=None,
                  wait_s: float = 600.0, poll_s: float = 0.25,
                  connect_timeout_s: float = 5.0,
+                 op_deadline_s: float | None = None,
+                 retry: RetryPolicy | None = None,
                  clock: Callable[[], float] = time.monotonic,
                  sleep: Callable[[float], None] = time.sleep):
         self.store = store
@@ -552,6 +598,7 @@ class CompileCacheClient:
         self.wait_s = wait_s
         self.poll_s = poll_s
         self.connect_timeout_s = connect_timeout_s
+        self.op_deadline_s = op_deadline_s
         self.clock = clock
         self.sleep = sleep
         self.last_outcome: str | None = None
@@ -560,6 +607,14 @@ class CompileCacheClient:
 
             registry = MetricRegistry()
         self.registry = registry
+        self.net_metrics = NetMetrics(registry, "compilecache")
+        # The shared jittered-backoff policy (ISSUE 15) behind both
+        # wait-for-the-claim-owner poll loops (fleet and local-store) —
+        # poll_s stays the floor so the busy-wait tests' fake clocks
+        # keep their cadence, jitter spreads a whole cold fleet's polls.
+        self.retry = retry if retry is not None else RetryPolicy(
+            base_s=poll_s, multiplier=1.5, max_s=max(poll_s * 8, poll_s),
+            jitter=0.25, seed=0, clock=clock, sleep=sleep)
         self.store_hits_c = registry.counter(
             "compilecache_store_hits_total",
             "programs served from the local artifact store")
@@ -582,7 +637,9 @@ class CompileCacheClient:
     def _clients(self) -> list[ArtifactClient]:
         return [ArtifactClient(a, device_kind=self.device_kind,
                                jax_version=self.jax_version,
-                               connect_timeout_s=self.connect_timeout_s)
+                               connect_timeout_s=self.connect_timeout_s,
+                               op_deadline_s=self.op_deadline_s,
+                               net_metrics=self.net_metrics)
                 for a in self.addrs]
 
     def _mark(self, outcome: str) -> None:
@@ -707,10 +764,14 @@ class CompileCacheClient:
             # claimer whose compile failed RELEASEs (and a dead one
             # expires at claim_ttl_s), and the first waiter to notice
             # becomes the fleet's compiler instead of stalling out its
-            # whole wait budget.
-            deadline = self.clock() + self.wait_s
-            while self.clock() < deadline:
-                self.sleep(self.poll_s)
+            # whole wait budget.  The cadence is the shared RetryPolicy
+            # (ISSUE 15): jittered backoff, so a cold fleet's waiters
+            # do not hammer the server in lockstep.
+            deadline = Deadline(self.wait_s, clock=self.clock,
+                                label="compile wait")
+            for _ in self.retry.attempts(deadline=deadline,
+                                         metrics=self.net_metrics,
+                                         sleep_first=True):
                 got = self._fetch(clients, key, deserialize_fn)
                 if got is not None:
                     return got
@@ -740,9 +801,11 @@ class CompileCacheClient:
             # same machine" and N local ranks sharing one store dir
             claimed = self.store.claim(key)
             if not claimed:
-                deadline = self.clock() + self.wait_s
-                while self.clock() < deadline:
-                    self.sleep(self.poll_s)
+                deadline = Deadline(self.wait_s, clock=self.clock,
+                                    label="local claim wait")
+                for _ in self.retry.attempts(deadline=deadline,
+                                             metrics=self.net_metrics,
+                                             sleep_first=True):
                     try:
                         got = self.store.get(key)
                     except (CacheCorrupt, CacheMismatch):

@@ -58,11 +58,23 @@ from typing import Callable, Iterator, Sequence
 
 import numpy as np
 
+from tpucfn.net.deadline import (
+    Deadline,
+    DeadlineExceeded,
+    NetMetrics,
+    RetryPolicy,
+    sendall_deadline,
+)
+
 # -- env contract (fanned out by the launcher, ISSUE 11) --------------------
 
 ROLE_ENV = "TPUCFN_ROLE"                # "trainer" | "input"
 INPUT_ADDRS_ENV = "TPUCFN_INPUT_ADDRS"  # comma list of host:port
 INPUT_PORT_ENV = "TPUCFN_INPUT_PORT"    # this input host's bind port
+# End-to-end per-frame deadline for the trainer-side client (ISSUE 15):
+# how long one complete batch frame may take — including a trickling
+# host's dribble — before the stream fails over / degrades to local.
+INPUT_OP_DEADLINE_ENV = "TPUCFN_INPUT_OP_DEADLINE_S"
 # launcher default base port: input host h binds DEFAULT_INPUT_PORT + h
 # (ids are fleet-unique, so one machine hosting the whole test gang
 # still gets distinct ports)
@@ -144,37 +156,66 @@ def decode_batch(payload: bytes | bytearray) -> dict[str, np.ndarray]:
 
 
 def send_frame(sock: socket.socket, kind: bytes, payload: bytes, *,
-               magic: bytes = MAGIC) -> None:
+               magic: bytes = MAGIC,
+               deadline: Deadline | None = None) -> None:
     """Length-prefixed framing.  ``magic`` distinguishes the planes that
     share this idiom (input batches here; compiled-artifact frames in
     :mod:`tpucfn.compilecache.service`) so a client dialed at the wrong
-    port fails the handshake loudly instead of mis-parsing payloads."""
-    sock.sendall(_HEADER.pack(magic, kind, len(payload)))
+    port fails the handshake loudly instead of mis-parsing payloads.
+
+    ``deadline`` bounds the WHOLE frame end to end (ISSUE 15): without
+    it, a stalled or trickling receiver pins ``sendall`` for as long as
+    the socket timeout keeps resetting — with it the send is chunked
+    and every chunk draws from the one shrinking budget, raising
+    :class:`~tpucfn.net.deadline.DeadlineExceeded` on expiry."""
+    if deadline is None:
+        sock.sendall(_HEADER.pack(magic, kind, len(payload)))
+        if payload:
+            sock.sendall(payload)
+        return
+    sendall_deadline(sock, _HEADER.pack(magic, kind, len(payload)), deadline)
     if payload:
-        sock.sendall(payload)
+        sendall_deadline(sock, payload, deadline)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+def _recv_exact(sock: socket.socket, n: int,
+                deadline: Deadline | None = None) -> bytearray:
+    """Read exactly ``n`` bytes.  With a ``deadline``, every chunk's
+    socket timeout is the deadline's REMAINDER — the gray-failure fix
+    (ISSUE 15): the per-chunk form lets a trickling peer deliver one
+    byte per timeout and never expire, because each ``recv`` resets the
+    clock; composing the chunks over one end-to-end budget means the
+    whole read finishes or fails inside the bound."""
     buf = bytearray(n)
     view = memoryview(buf)
     got = 0
     while got < n:
-        r = sock.recv_into(view[got:], n - got)
+        if deadline is not None:
+            sock.settimeout(deadline.timeout(what="recv"))
+        try:
+            r = sock.recv_into(view[got:], n - got)
+        except socket.timeout:
+            if deadline is not None:
+                raise DeadlineExceeded(
+                    f"recv deadline exceeded after {got}/{n} bytes"
+                ) from None
+            raise
         if r == 0:
             raise ServiceError("input stream closed mid-frame")
         got += r
     return buf
 
 
-def recv_frame(sock: socket.socket, *,
-               magic: bytes = MAGIC) -> tuple[bytes, bytearray]:
-    head = _recv_exact(sock, _HEADER.size)
+def recv_frame(sock: socket.socket, *, magic: bytes = MAGIC,
+               deadline: Deadline | None = None) -> tuple[bytes, bytearray]:
+    head = _recv_exact(sock, _HEADER.size, deadline)
     got_magic, kind, length = _HEADER.unpack(bytes(head))
     if got_magic != magic:
         raise ServiceError(f"bad frame magic {got_magic!r}")
     if length > MAX_FRAME_BYTES:
         raise ServiceError(f"frame length {length} exceeds sanity bound")
-    return kind, (_recv_exact(sock, length) if length else bytearray())
+    return kind, (_recv_exact(sock, length, deadline) if length
+                  else bytearray())
 
 
 # -- the service (input-host side) ------------------------------------------
@@ -207,6 +248,8 @@ class InputService:
                  mp_workers: int = 0,
                  registry=None,
                  sndbuf_bytes: int | None = None,
+                 send_deadline_s: float = 120.0,
+                 hello_timeout_s: float = 30.0,
                  **ds_kwargs):
         if num_trainers < 1:
             raise ValueError(f"num_trainers must be >= 1, got {num_trainers}")
@@ -225,6 +268,18 @@ class InputService:
         # windows to several MB — cap it when the bound must be real
         # (None keeps OS auto-tuning: right for high-BDP fleet links).
         self.sndbuf_bytes = sndbuf_bytes
+        # Per-FRAME send deadline (ISSUE 15 satellite): the old shape —
+        # one generous per-connection timeout — let a stalled or
+        # blackholed trainer pin this stream's producer thread (and its
+        # full queue_batches of encoded batches) for the whole window,
+        # because sendall under a plain socket timeout resets per
+        # drained chunk.  One frame now has send_deadline_s end to end;
+        # expiry counts input_send_stalls_total and drops the stream
+        # like any disconnect.  Must comfortably exceed the trainers'
+        # worst-case step time (a full prefetch chain stops reading
+        # while a step runs) — it bounds the half-dead, not the slow.
+        self.send_deadline_s = float(send_deadline_s)
+        self.hello_timeout_s = float(hello_timeout_s)
         self.ds_kwargs = dict(ds_kwargs)
         if self.mp_workers > 0 and self.ds_kwargs.get("num_workers"):
             # Two decode axes at once is a config error, not a silent
@@ -268,6 +323,10 @@ class InputService:
         self.stream_errors_c = registry.counter(
             "input_stream_errors_total",
             "streams that ended in a handshake refusal or transport error")
+        self.send_stalls_c = registry.counter(
+            "input_send_stalls_total",
+            "streams dropped because one frame's send deadline expired "
+            "(stalled/blackholed trainer — producer and queue released)")
         registry.computed_gauge(
             "input_active_streams", lambda: float(len(self._live_streams())),
             "trainer streams currently connected")
@@ -319,10 +378,12 @@ class InputService:
                 continue
             except OSError:
                 return  # listening socket closed
-            # A generous per-socket timeout, NOT the backpressure bound
-            # (sendall blocking on a busy trainer is the design): it
-            # reaps streams whose trainer vanished without a FIN.
-            conn.settimeout(300.0)
+            # Guards only the HELLO read (clients handshake the moment
+            # they connect); the send path is bounded per-frame by
+            # send_deadline_s, which retired the old generous
+            # per-connection timeout that let one stalled trainer pin a
+            # producer thread for 5 minutes (ISSUE 15 satellite).
+            conn.settimeout(self.hello_timeout_s)
             if self.sndbuf_bytes is not None:
                 conn.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF,
                                 self.sndbuf_bytes)
@@ -507,7 +568,7 @@ class _Stream:
             refusal = self._validate(hello, trainer)
             if refusal:
                 svc.stream_errors_c.add()
-                send_frame(self.conn, FRAME_ERROR, refusal.encode())
+                self._send(FRAME_ERROR, refusal.encode())
                 return
             self.trainer = trainer
             # The service's configured bound is the default whenever the
@@ -528,14 +589,22 @@ class _Stream:
                 if not ok:
                     return
                 if item is None:
-                    send_frame(self.conn, FRAME_END, b"")
+                    self._send(FRAME_END, b"")
                     return
                 if isinstance(item, tuple):  # ("error", reason)
-                    send_frame(self.conn, FRAME_ERROR, item[1].encode())
+                    self._send(FRAME_ERROR, item[1].encode())
                     return
-                send_frame(self.conn, FRAME_BATCH, item)
+                self._send(FRAME_BATCH, item)
                 svc.batches_c.add()
                 svc.bytes_c.add(len(item))
+        except DeadlineExceeded:
+            # One frame exceeded its end-to-end send deadline: the
+            # trainer is stalled or blackholed, not merely busy — drop
+            # the stream like any disconnect (the finally releases the
+            # producer and its queued batches NOW, not after a 5-minute
+            # window).  Not a stream "error": a reconnecting trainer
+            # resumes from its cursor, a dead one degrades to local.
+            svc.send_stalls_c.add()
         except (OSError, ServiceError, json.JSONDecodeError, ValueError) as e:
             # A trainer on an UNBOUNDED stream ends it by disconnecting
             # (the shipped integration's normal exit) — that is not a
@@ -557,6 +626,17 @@ class _Stream:
             self.done.set()
             with svc._lock:
                 svc._last_activity = time.monotonic()
+
+    def _send(self, kind: bytes, payload: bytes) -> None:
+        """One frame under its own end-to-end deadline (ISSUE 15
+        satellite: the bound on how long a gray trainer can pin this
+        stream).  0 disables the bound — the sibling-knob convention
+        (``--serve-for 0``, ``duration_s=0``) — rather than minting an
+        already-expired deadline that drops every stream at frame 1."""
+        s = self.service.send_deadline_s
+        send_frame(self.conn, kind, payload,
+                   deadline=(Deadline(s, label="input send")
+                             if s > 0 else None))
 
     def _validate(self, hello: dict, trainer: int) -> str | None:
         """The determinism contract's cheap half: a trainer whose
@@ -608,8 +688,18 @@ class ServiceBatchStream:
                  num_epochs: int | None = None,
                  connect_timeout_s: float = 5.0,
                  recv_timeout_s: float = 120.0,
+                 op_deadline_s: float | None = None,
                  rcvbuf_bytes: int | None = None,
-                 mp_workers: int | None = None):
+                 mp_workers: int | None = None,
+                 net_metrics: NetMetrics | None = None):
+        # End-to-end bound for receiving ONE complete frame (ISSUE 15):
+        # recv_timeout_s alone is per-CHUNK, which a trickling input
+        # host resets forever — op_deadline_s is the budget the chunks
+        # share.  Defaults to recv_timeout_s, so the worst case becomes
+        # "one timeout total" instead of "one timeout per byte".
+        self.op_deadline_s = (float(op_deadline_s) if op_deadline_s
+                              else recv_timeout_s)
+        self.net_metrics = net_metrics
         host, _, port = addr.rpartition(":")
         self._sock = None  # socket() itself can fail (fd exhaustion):
         # every construction failure must be a ServiceError, or the
@@ -642,8 +732,9 @@ class ServiceBatchStream:
             # can refuse a stream the degrade handoff couldn't reproduce
             hello["mp_workers"] = int(mp_workers)
         try:
-            send_frame(self._sock, FRAME_HELLO,
-                       json.dumps(hello).encode())
+            send_frame(self._sock, FRAME_HELLO, json.dumps(hello).encode(),
+                       deadline=Deadline(self.op_deadline_s,
+                                         label="input hello"))
         except OSError as e:
             self.close()
             raise ServiceError(f"handshake to {addr}: {e}") from None
@@ -656,7 +747,17 @@ class ServiceBatchStream:
         if self._ended:
             raise StopIteration
         try:
-            kind, payload = recv_frame(self._sock)
+            kind, payload = recv_frame(
+                self._sock,
+                deadline=Deadline(self.op_deadline_s, label="input batch"))
+        except DeadlineExceeded as e:
+            # The gray case the deadline exists for: the host is up but
+            # trickling/stalled — counted apart from plain transport
+            # errors, then degraded through the exact same path.
+            if self.net_metrics is not None:
+                self.net_metrics.deadline_exceeded_c.add()
+            self.close()
+            raise ServiceError(f"stream from {self.addr}: {e}") from None
         except (OSError, ServiceError) as e:
             self.close()
             raise ServiceError(f"stream from {self.addr}: {e}") from None
@@ -713,13 +814,18 @@ class ResilientBatchStream:
                  connect_timeout_s: float = 5.0,
                  connect_retry_s: float = 20.0,
                  recv_timeout_s: float = 120.0,
+                 op_deadline_s: float | None = None,
                  rcvbuf_bytes: int | None = None,
                  mp_workers: int | None = None,
+                 registry=None,
+                 retry: RetryPolicy | None = None,
                  on_degrade: Callable[[str], None] | None = None):
         if not addrs:
             raise ValueError("no input-host addresses (use the local "
                              "loader directly instead)")
         self.trainer = int(trainer)
+        self.net_metrics = (NetMetrics(registry, "input")
+                            if registry is not None else None)
         # rotate so trainer i's primary is addrs[i % n]
         n = len(addrs)
         self._addrs = [addrs[(self.trainer + k) % n] for k in range(n)]
@@ -727,17 +833,31 @@ class ResilientBatchStream:
                         seed=seed, num_epochs=num_epochs,
                         connect_timeout_s=connect_timeout_s,
                         recv_timeout_s=recv_timeout_s,
+                        op_deadline_s=op_deadline_s,
                         rcvbuf_bytes=rcvbuf_bytes,
-                        mp_workers=mp_workers)
+                        mp_workers=mp_workers,
+                        net_metrics=self.net_metrics)
         self.local_factory = local_factory
         self.on_degrade = on_degrade
         self.connect_retry_s = connect_retry_s
+        # The shared jittered-backoff policy (ISSUE 15) drives the
+        # startup connect-retry window, replacing this class's
+        # hand-rolled fixed 0.25 s loop; seeded per trainer so a
+        # whole booting fleet does not knock in lockstep.
+        self.retry = retry if retry is not None else RetryPolicy(
+            base_s=0.25, multiplier=2.0, max_s=2.0, jitter=0.25,
+            seed=self.trainer)
         self.cursor = 0  # batches already yielded
         self.degraded = False
         self._local: Iterator[dict] | None = None
         self._stream: ServiceBatchStream | None = None
         self._tried = 0  # next index into _addrs to try
         self._t0 = time.monotonic()
+        # the most recent stream-level failure: connect attempts can
+        # SUCCEED right up to the degrade (a gray host accepts and
+        # swallows), so without this the degrade reason would report
+        # the uninformative ctor-side default
+        self._last_error: str | None = None
 
     def _degrade(self, reason: str) -> None:
         self.degraded = True
@@ -749,7 +869,16 @@ class ResilientBatchStream:
                 pass
 
     def _next_stream(self) -> ServiceBatchStream | None:
-        last = "all input hosts exhausted"
+        last = self._last_error or "all input hosts exhausted"
+        # The startup window is anchored at stream CONSTRUCTION (fleet
+        # roles boot with skew), so the deadline is absolute, not
+        # per-round; once any batch has flowed (cursor > 0) the window
+        # is closed and a failure degrades after one pass.
+        window = Deadline.at(self._t0 + self.connect_retry_s,
+                             label="input connect window")
+        rounds = self.retry.attempts(deadline=window,
+                                     metrics=self.net_metrics,
+                                     sleep_first=True)
         while True:
             while self._tried < len(self._addrs):
                 addr = self._addrs[self._tried]
@@ -759,12 +888,11 @@ class ResilientBatchStream:
                         addr, self.trainer, start_batch=self.cursor,
                         **self._kw)
                 except ServiceError as e:
-                    last = str(e)
-            if (self.cursor == 0
-                    and time.monotonic() - self._t0 < self.connect_retry_s):
+                    last = self._last_error = str(e)
+            if self.cursor == 0 and next(rounds, None) is not None:
                 # startup skew, not death: nobody has served a batch
-                # yet, so keep knocking until the window expires
-                time.sleep(0.25)
+                # yet, so keep knocking (jittered backoff) until the
+                # window expires
                 self._tried = 0
                 continue
             self._degrade(last)
@@ -787,7 +915,8 @@ class ResilientBatchStream:
                 batch = next(self._stream)
             except StopIteration:
                 raise
-            except ServiceError:
+            except ServiceError as e:
+                self._last_error = str(e)
                 self._stream = None
                 continue  # failover (remaining addrs) or degrade
             self.cursor += 1
@@ -1018,6 +1147,8 @@ def service_or_local_batches(ds, *, num_epochs: int | None = None,
         seed=getattr(ds, "seed", None),
         num_epochs=num_epochs, on_degrade=on_degrade,
         rcvbuf_bytes=int(e.get("TPUCFN_INPUT_RCVBUF", "0") or 0) or None,
+        op_deadline_s=float(e.get(INPUT_OP_DEADLINE_ENV, "0") or 0) or None,
+        registry=registry,
         mp_workers=0)  # the fallback IS ds.batches(): plain loader order
     return AdaptivePrefetcher(stream, registry=registry,
                               max_bytes=max_bytes)

@@ -35,13 +35,21 @@ ACTIONS = ("kill", "hang", "delay_heartbeats", "corrupt_ckpt",
            "kill_replica", "freeze_replica", "slow_replica",
            # crash-safety op (ISSUE 12): SIGKILL the supervisor itself —
            # the fleet must survive its watchman dying (`host` unused)
-           "kill_coordinator")
+           "kill_coordinator",
+           # network gray-failure ops (ISSUE 15): injected through
+           # tpucfn.net.proxy.ChaosProxy instances registered on the
+           # target — `host` (optional) is a PROXY index, not a fleet
+           # member; unpinned means every registered proxy
+           "net_latency", "net_throttle", "net_stall", "net_partition",
+           "net_tear", "net_rst", "net_clear")
 
 # Actions that do not target a fleet member: an unpinned `host` must
 # NOT draw a victim from the seeded RNG for them, or the spec's other
 # events would resolve different victims depending on whether one of
 # these precedes them.
-_HOSTLESS_ACTIONS = ("corrupt_ckpt", "kill_coordinator")
+_HOSTLESS_ACTIONS = ("corrupt_ckpt", "kill_coordinator",
+                     "net_latency", "net_throttle", "net_stall",
+                     "net_partition", "net_tear", "net_rst", "net_clear")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -72,7 +80,10 @@ class ChaosEvent:
     host: int | None = None
     duration_s: float = 0.0  # hang / delay_heartbeats / preempt lead / freeze
     step: int | None = None  # corrupt_ckpt: target step (None = latest)
-    delay_s: float = 0.0     # slow_replica: per-step injected latency
+    delay_s: float = 0.0     # slow_replica / net_latency: injected latency
+    rate_bps: float = 0.0    # net_throttle: forwarding rate (trickle)
+    direction: str = "both"  # net_*: "up" | "down" | "both"
+    after_bytes: int | None = None  # net_tear/net_stall arming offset
 
     def __post_init__(self):
         if self.action not in ACTIONS:
@@ -80,11 +91,24 @@ class ChaosEvent:
                 f"unknown chaos action {self.action!r}; one of {ACTIONS}")
         if self.at_s is None and self.at_step is None:
             raise ValueError("chaos event needs at_s and/or at_step")
+        if self.direction not in ("up", "down", "both"):
+            raise ValueError(
+                f"bad direction {self.direction!r}; one of up/down/both")
+        # net_* parameter validation happens HERE, at spec construction
+        # — a bad launch-level spec must fail at parse time (rc 2), not
+        # unwind the live coordinator's supervision loop (and kill the
+        # gang) when the event fires minutes into the run.
+        if self.action == "net_latency" and self.delay_s <= 0:
+            raise ValueError("net_latency needs delay_s > 0")
+        if self.action == "net_throttle" and self.rate_bps <= 0:
+            raise ValueError("net_throttle needs rate_bps > 0")
 
     def to_json(self) -> dict:
         return {k: v for k, v in dataclasses.asdict(self).items()
                 if v is not None
-                and not (k in ("duration_s", "delay_s") and v == 0.0)}
+                and not (k in ("duration_s", "delay_s", "rate_bps")
+                         and v == 0.0)
+                and not (k == "direction" and v == "both")}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -160,6 +184,19 @@ class ChaosTarget:
         """Add ``delay_s`` of latency to every serve step for
         ``duration_s`` seconds (0 = indefinitely) — the straggler
         class, the hedge path's reason to exist."""
+        raise NotImplementedError
+
+    # -- network gray-failure ops (ISSUE 15) --------------------------------
+
+    def net_fault(self, proxy: int | None, kind: str, *,
+                  duration_s: float, delay_s: float, rate_bps: float,
+                  direction: str, after_bytes: int | None) -> None:
+        """Inject one network fault (``kind`` is the short fault name —
+        ``latency``/``throttle``/``stall``/``partition``/``tear``/
+        ``rst``/``clear``) into the :class:`~tpucfn.net.proxy.
+        ChaosProxy` at index ``proxy`` — or into EVERY registered proxy
+        when unpinned.  Network faults are hostless by design: they
+        target a transport plane, not a fleet member."""
         raise NotImplementedError
 
     # -- crash-safety op (ISSUE 12) -----------------------------------------
@@ -281,6 +318,12 @@ class ChaosEngine:
                 self.target.slow_replica(host, ev.delay_s, ev.duration_s)
             elif ev.action == "kill_coordinator":
                 self.target.kill_coordinator()
+            elif ev.action.startswith("net_"):
+                self.target.net_fault(
+                    host, ev.action[len("net_"):],
+                    duration_s=ev.duration_s, delay_s=ev.delay_s,
+                    rate_bps=ev.rate_bps, direction=ev.direction,
+                    after_bytes=ev.after_bytes)
             elif ev.action == "corrupt_ckpt":
                 self.target.corrupt_latest_checkpoint(self.rng, step=ev.step)
             self.fired.append(rec)

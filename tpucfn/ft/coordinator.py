@@ -89,9 +89,11 @@ from tpucfn.ft.journal import (
     JournalWriter,
     PendingIntent,
     clear_rc_dir,
+    compact_journal,
     crash_point,
     journal_path,
     pid_alive,
+    pid_start_time,
     read_rc,
     repair_torn_tail,
     replay_journal,
@@ -162,6 +164,8 @@ class GangCoordinator(ChaosTarget):
         max_input_restarts: int = 1,
         adopt: bool | str = "auto",
         adopt_spawn_grace_s: float = ADOPT_SPAWN_GRACE_S,
+        net_proxies: Sequence | None = None,
+        journal_compact_records: int = 4096,
     ):
         """Graceful-degradation knobs (ISSUE 7): ``drain_grace_s`` caps
         how long a preemption drain waits for clean exits when the
@@ -217,6 +221,15 @@ class GangCoordinator(ChaosTarget):
         # (require it when a journal exists), or False (always fresh).
         self.adopt = adopt
         self.adopt_spawn_grace_s = adopt_spawn_grace_s
+        # Network fault-injection plane (ISSUE 15): ChaosProxy instances
+        # (tpucfn.net.proxy) fronting fleet-plane ports, the targets of
+        # the net_* chaos ACTIONS — so launch-level chaos specs schedule
+        # gray network failures exactly like kills.
+        self.net_proxies = list(net_proxies or ())
+        # Journal compaction threshold (ISSUE 15 satellite): at
+        # adoption, a journal longer than this folds into one snapshot
+        # record so replay stays O(recent) on week-long runs.
+        self.journal_compact_records = journal_compact_records
         self._journal: JournalWriter | None = None
         self._adopted = False
         self._adopt_failures: list[Failure] = []
@@ -340,6 +353,18 @@ class GangCoordinator(ChaosTarget):
                 "chaos events with only an at_step trigger need a "
                 "HeartbeatMonitor attached (fleet step comes from "
                 "heartbeats)")
+        if (self.chaos is not None and not self.net_proxies
+                and any(e.action.startswith("net_")
+                        for e in self.chaos.spec.events)):
+            # Same discipline as the monitor check above: a net_* event
+            # with nowhere to land must refuse at CONSTRUCTION — firing
+            # raises mid-supervision, which tears down the gang (and the
+            # journaled chaos_fired would make an adopted run silently
+            # skip the event forever).
+            raise ValueError(
+                "chaos net_* events need net_proxies registered on the "
+                "coordinator (tpucfn launch --chaos-proxy LISTEN:HOST:"
+                "PORT, or pass ChaosProxy instances)")
         if self.ft_dir is not None:
             self.ft_dir.mkdir(parents=True, exist_ok=True)
 
@@ -389,6 +414,37 @@ class GangCoordinator(ChaosTarget):
         victim = corrupt_latest_checkpoint(self.ckpt_dir, rng, step=step)
         self._event("chaos_ckpt_corrupted",
                     path=None if victim is None else str(victim))
+
+    def net_fault(self, proxy: int | None, kind: str, *,
+                  duration_s: float, delay_s: float, rate_bps: float,
+                  direction: str, after_bytes: int | None) -> None:
+        """Chaos op (ISSUE 15): inject a network gray failure through
+        the registered :class:`~tpucfn.net.proxy.ChaosProxy` instances
+        — unpinned hits every proxy, a pinned ``host`` is a proxy
+        index.  The firing is journaled by ``_on_chaos_fire`` like any
+        other chaos op, so adopted runs never re-fire it."""
+        if not self.net_proxies:
+            raise ValueError(
+                "chaos net_* ops need net_proxies registered on the "
+                "coordinator (tpucfn launch --chaos-proxy, or pass "
+                "ChaosProxy instances)")
+        if proxy is not None and not 0 <= proxy < len(self.net_proxies):
+            raise ValueError(
+                f"net fault proxy index {proxy} out of range for "
+                f"{len(self.net_proxies)} registered proxies")
+        targets = ([self.net_proxies[proxy]] if proxy is not None
+                   else self.net_proxies)
+        for p in targets:
+            if kind == "clear":
+                p.clear()
+            else:
+                p.inject(kind, duration_s=duration_s, delay_s=delay_s,
+                         rate_bps=rate_bps, direction=direction,
+                         after_bytes=after_bytes)
+        self._event("chaos_net_fault", fault=kind, proxy=proxy,
+                    duration_s=duration_s, delay_s=delay_s,
+                    rate_bps=rate_bps, direction=direction,
+                    after_bytes=after_bytes)
 
     def kill_coordinator(self) -> None:
         """Chaos op (ISSUE 12): SIGKILL ourselves mid-supervision.  The
@@ -554,8 +610,13 @@ class GangCoordinator(ChaosTarget):
         crash_point("during_spawn", self.ft_dir)
         procs = self.launcher.launch(self.argv, kill_host_after=inject)
         self._procs = dict(zip(self.host_ids, procs))
+        # pids AND their kernel start times: the (pid, starttime) pair
+        # is the identity adoption trusts across a machine reboot — a
+        # recycled pid alone would adopt (and later kill) a stranger.
         self._j("gang_launched", first=first,
-                pids={str(h): p.pid for h, p in self._procs.items()})
+                pids={str(h): p.pid for h, p in self._procs.items()},
+                starts={str(h): pid_start_time(p.pid)
+                        for h, p in self._procs.items()})
         self._finished.clear()
         self.straggler_guard.reset_all()
         self._suppressed_hangs.clear()
@@ -577,7 +638,8 @@ class GangCoordinator(ChaosTarget):
         self._j("launching", hosts=[host_id])
         self._procs[host_id] = self.launcher.launch_host(self.argv, host_id)
         self._j("solo_launched", host=host_id,
-                pid=self._procs[host_id].pid)
+                pid=self._procs[host_id].pid,
+                start=pid_start_time(self._procs[host_id].pid))
         self._finished.pop(host_id, None)
         self._suppressed_hangs.discard(host_id)
         self.straggler_guard.reset(host_id)
@@ -770,7 +832,7 @@ class GangCoordinator(ChaosTarget):
         if not jp.exists():
             return False
         t0 = self.clock()
-        st, _records, torn = replay_journal(jp)
+        st, records, torn = replay_journal(jp)
         # Replay time is real restart downtime (ISSUE 13 satellite):
         # measured here, attributed through the recovered /
         # goodput_incident detail so `tpucfn obs goodput` can name the
@@ -778,10 +840,10 @@ class GangCoordinator(ChaosTarget):
         self._journal_replay_ms = round((self.clock() - t0) * 1e3, 3)
         if not st.started or st.done_rc is not None:
             return False
-        self._adopt_fleet(st, torn)
+        self._adopt_fleet(st, torn, n_records=len(records))
         return True
 
-    def _adopt_fleet(self, st, torn: bool) -> None:
+    def _adopt_fleet(self, st, torn: bool, *, n_records: int = 0) -> None:
         """Attach to the fleet a dead coordinator left running: restore
         the durable state (budget, incident counter, shrinks, ckpt
         blacklist, input restarts), re-attach to live children by pid
@@ -798,6 +860,18 @@ class GangCoordinator(ChaosTarget):
             # garbled line that is no longer final, which the NEXT
             # replay would refuse as corruption.  Drop the tail first.
             repair_torn_tail(journal_path(self.ft_dir))
+        # Compaction (ISSUE 15 satellite): a week of incidents replays
+        # O(run lifetime) — past the threshold, fold the state we just
+        # replayed into one snapshot record so the NEXT adoption (and
+        # every tool reading the journal) stays O(recent).
+        compacted = False
+        if self.journal_compact_records:
+            # the (state, count) we JUST replayed — compaction must not
+            # pay the O(N) parse a second time on the biggest journals
+            compacted = compact_journal(
+                journal_path(self.ft_dir),
+                max_records=self.journal_compact_records,
+                replayed=(st, n_records))
         self._journal = JournalWriter(journal_path(self.ft_dir),
                                       start_seq=st.seq)
         self._incident = st.incident
@@ -859,7 +933,12 @@ class GangCoordinator(ChaosTarget):
                 if self.monitor is not None:
                     self.monitor.retire_host(host)
                 continue
-            cands = []
+            # candidates are (pid, journaled start time | None): the
+            # start time is the recycling guard (ISSUE 15 satellite) —
+            # across a machine reboot the same pid number names a
+            # stranger, and the stranger must read as a dead rank, not
+            # a live one we would adopt and later SIGKILL.
+            cands: list[tuple[int, int | None]] = []
             if host in st.procs:
                 # A spawn-window host's st.procs pid IS the dead
                 # predecessor being replaced (`launching` postdates
@@ -867,21 +946,25 @@ class GangCoordinator(ChaosTarget):
                 # onto an unrelated process we would adopt and later
                 # kill.  The grace loop above already distrusts it.
                 if host not in st.launching:
-                    cands.append(st.procs[host])
+                    cands.append((st.procs[host],
+                                  st.proc_starts.get(host)))
             hb_pid = (beats.get(host) or {}).get("pid")
-            if isinstance(hb_pid, int) and hb_pid not in cands \
+            if isinstance(hb_pid, int) \
+                    and hb_pid not in [p for p, _ in cands] \
                     and not (host in st.launching
                              and hb_pid == stale.get(host)):
-                cands.append(hb_pid)
-            live = next((p for p in cands if pid_alive(p)), None)
+                cands.append((hb_pid, None))
+            live = next(((p, s) for p, s in cands
+                         if self._cand_alive(p, s)), None)
             if live is not None:
                 self._procs[host] = AdoptedProcess(
-                    live, host_id=host, ft_dir=self.ft_dir)
+                    live[0], host_id=host, ft_dir=self.ft_dir,
+                    start_time=live[1])
                 adopted_hosts.append(host)
                 if self.monitor is not None:
                     self.monitor.activate_host(host)
             else:
-                dead.append((host, cands))
+                dead.append((host, [p for p, _ in cands]))
         # Resolve the unwatched deaths.  The supervise reaper may still
         # be racing us to land their rc files (it reaps our
         # predecessor's orphans only when it re-enters waitpid after
@@ -923,7 +1006,8 @@ class GangCoordinator(ChaosTarget):
         self._j("adopted", hosts=adopted_hosts,
                 dead=[f.host_id for f in pending_failures],
                 pending=None if st.pending is None else st.pending.incident,
-                replay_ms=self._journal_replay_ms)
+                replay_ms=self._journal_replay_ms,
+                compacted=compacted)
         self._event("coordinator_adopted", hosts=adopted_hosts,
                     dead=[f.host_id for f in pending_failures],
                     budget_used=self.policy.budget.used,
@@ -943,6 +1027,21 @@ class GangCoordinator(ChaosTarget):
             pending_failures = [f for f in pending_failures
                                 if f.host_id not in completed]
         self._adopt_failures = pending_failures
+
+    @staticmethod
+    def _cand_alive(pid: int, expect_start: int | None) -> bool:
+        """Is this candidate pid the journaled incarnation?  Alive AND
+        — when the journal recorded a start time — bearing the same
+        kernel start time; a live pid with a DIFFERENT start time is a
+        recycled number on an unrelated process (machine rebooted, or
+        a long downtime), and the rank it claimed is dead-unwatched."""
+        if not pid_alive(pid):
+            return False
+        if expect_start is not None:
+            cur = pid_start_time(pid)
+            if cur is not None and cur != expect_start:
+                return False
+        return True
 
     def _complete_pending(self, p: PendingIntent, t0: float) -> set[int]:
         """Finish a restart intent whose commit never landed — exactly

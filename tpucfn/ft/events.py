@@ -53,6 +53,9 @@ EVENT_KINDS = (
     "chaos_preempt_notice",
     "chaos_ckpt_corrupted",
     "host_lost",
+    # network fault injection (ISSUE 15): a net_* chaos op landed on
+    # the registered ChaosProxy instances
+    "chaos_net_fault",
 )
 
 

@@ -69,8 +69,15 @@ JOURNAL_KINDS = (
     "straggler_probation",  # guard fired for a host (eviction inbound)
     "chaos_fired",      # a scripted chaos event fired: index into the spec
     "adopted",          # a restarted coordinator attached to this journal
+    "snapshot",         # compaction: a full CoordinatorState, journal's head
     "done",             # the run ended: rc
 )
+
+# Compaction threshold (ISSUE 15 satellite): at adoption, a journal
+# longer than this folds its replayed state into one checksummed
+# `snapshot` record, so week-long runs replay O(recent) instead of
+# O(run lifetime).
+JOURNAL_COMPACT_RECORDS = 4096
 
 CRASH_AT_ENV = "TPUCFN_CRASH_AT"
 
@@ -258,6 +265,12 @@ class CoordinatorState:
     # before relaunching over them.
     launching: set[int] = dataclasses.field(default_factory=set)
     procs: dict[int, int] = dataclasses.field(default_factory=dict)
+    # Per-host kernel start time of the journaled pid (ISSUE 15
+    # satellite, closing the PR 12 cross-reboot hazard): a (pid,
+    # starttime) pair survives pid recycling — an adopter that finds
+    # the pid alive but with a DIFFERENT start time is looking at an
+    # unrelated process, and the rank must read as dead-unwatched.
+    proc_starts: dict[int, int] = dataclasses.field(default_factory=dict)
     finished: dict[int, int] = dataclasses.field(default_factory=dict)
     pending: PendingIntent | None = None
     done_rc: int | None = None
@@ -271,6 +284,17 @@ class CoordinatorState:
 
     def apply(self, rec: dict) -> None:
         seq = int(rec.get("seq", 0))
+        if rec.get("kind") == "snapshot":
+            # Compaction head (ISSUE 15 satellite): the folded state of
+            # every record it replaced.  Only valid as the FIRST record
+            # — a snapshot mid-stream means someone spliced journals.
+            if self.seq != 0 or self.started:
+                raise JournalError(
+                    "journal snapshot record is not the first record — "
+                    "refusing a spliced journal")
+            self.restore(rec.get("state") or {})
+            self.seq = seq
+            return
         if seq != self.seq + 1:
             raise JournalError(
                 f"journal sequence gap: record seq {seq} after {self.seq} — "
@@ -286,6 +310,10 @@ class CoordinatorState:
         elif k == "gang_launched":
             self.procs = {int(h): int(p)
                           for h, p in (rec.get("pids") or {}).items()}
+            self.proc_starts = {
+                int(h): int(s)
+                for h, s in (rec.get("starts") or {}).items()
+                if s is not None}
             self.launching.clear()
             if self.pending is not None:
                 # A whole-gang launch completes ANY pending act — even a
@@ -297,6 +325,10 @@ class CoordinatorState:
                 self.pending.launched = True
         elif k == "solo_launched":
             self.procs[int(rec["host"])] = int(rec["pid"])
+            if rec.get("start") is not None:
+                self.proc_starts[int(rec["host"])] = int(rec["start"])
+            else:
+                self.proc_starts.pop(int(rec["host"]), None)
             self.launching.discard(int(rec["host"]))
             self.finished.pop(int(rec["host"]), None)
             if self.pending is not None \
@@ -307,6 +339,7 @@ class CoordinatorState:
         elif k == "host_exit":
             h = int(rec["host"])
             self.procs.pop(h, None)
+            self.proc_starts.pop(h, None)
             self.launching.discard(h)
             self.finished[h] = int(rec.get("rc") or 0)
         elif k == "incident_open":
@@ -330,6 +363,7 @@ class CoordinatorState:
         elif k == "input_degraded":
             h = int(rec["host"])
             self.procs.pop(h, None)
+            self.proc_starts.pop(h, None)
             self.finished.setdefault(h, 0)
         elif k == "input_restarted":
             self.input_restarts[int(rec["host"])] = int(
@@ -345,6 +379,121 @@ class CoordinatorState:
         # "drain_armed" mutates nothing replayable: the drain file on
         # disk is the durable artifact, and the pending intent already
         # carries the drain_restart action.
+
+    # -- snapshot (de)serialization (ISSUE 15 compaction satellite) --------
+
+    def to_json(self) -> dict:
+        p = self.pending
+        return {
+            "seq": self.seq,
+            "started": self.started,
+            "argv": self.argv,
+            "max_restarts": self.max_restarts,
+            "budget_used": self.budget_used,
+            "incident": self.incident,
+            "launching": sorted(self.launching),
+            "procs": {str(h): p_ for h, p_ in self.procs.items()},
+            "proc_starts": {str(h): s for h, s in self.proc_starts.items()},
+            "finished": {str(h): rc for h, rc in self.finished.items()},
+            "pending": None if p is None else {
+                "incident": p.incident, "action": p.action,
+                "hosts": list(p.hosts), "seq": p.seq,
+                "planned": p.planned, "launched": p.launched,
+                "solo_done": sorted(p._solo_done)},
+            "done_rc": self.done_rc,
+            "shrinks": [list(s) for s in self.shrinks],
+            "input_restarts": {str(h): n
+                               for h, n in self.input_restarts.items()},
+            "ckpt_blacklist": sorted(self.ckpt_blacklist),
+            "ckpt_retries": self.ckpt_retries,
+            "probation": sorted(self.probation),
+            "chaos_fired": sorted(self.chaos_fired),
+            "adoptions": self.adoptions,
+        }
+
+    def restore(self, state: dict) -> None:
+        self.started = bool(state.get("started", False))
+        self.argv = state.get("argv")
+        self.max_restarts = state.get("max_restarts")
+        self.budget_used = int(state.get("budget_used", 0))
+        self.incident = int(state.get("incident", 0))
+        self.launching = {int(h) for h in state.get("launching") or ()}
+        self.procs = {int(h): int(p)
+                      for h, p in (state.get("procs") or {}).items()}
+        self.proc_starts = {
+            int(h): int(s)
+            for h, s in (state.get("proc_starts") or {}).items()}
+        self.finished = {int(h): int(rc)
+                         for h, rc in (state.get("finished") or {}).items()}
+        p = state.get("pending")
+        self.pending = None if p is None else PendingIntent(
+            incident=int(p.get("incident", 0)),
+            action=str(p.get("action", "")),
+            hosts=tuple(int(h) for h in p.get("hosts") or ()),
+            seq=int(p.get("seq", 0)),
+            planned=bool(p.get("planned", False)),
+            launched=bool(p.get("launched", False)),
+            _solo_done={int(h) for h in p.get("solo_done") or ()})
+        self.done_rc = state.get("done_rc")
+        self.shrinks = [[int(h) for h in s]
+                        for s in state.get("shrinks") or ()]
+        self.input_restarts = {
+            int(h): int(n)
+            for h, n in (state.get("input_restarts") or {}).items()}
+        self.ckpt_blacklist = {int(s)
+                               for s in state.get("ckpt_blacklist") or ()}
+        self.ckpt_retries = int(state.get("ckpt_retries", 0))
+        self.probation = {int(h) for h in state.get("probation") or ()}
+        self.chaos_fired = {int(i) for i in state.get("chaos_fired") or ()}
+        self.adoptions = int(state.get("adoptions", 0))
+
+
+def compact_journal(path: str | Path, *,
+                    max_records: int = JOURNAL_COMPACT_RECORDS,
+                    replayed: tuple[CoordinatorState, int] | None = None
+                    ) -> bool:
+    """Fold a long journal into one checksummed ``snapshot`` record so
+    replay stays O(recent) on week-long runs (ISSUE 15 satellite).
+
+    Run at adoption (after :func:`repair_torn_tail`) or at any quiet
+    moment: when the record count exceeds ``max_records``, the replayed
+    :class:`CoordinatorState` is written as a single ``snapshot``
+    record (same seq — appends continue contiguously) via
+    tmp-fsync-rename, so a crash mid-compaction leaves either the old
+    or the new journal, never neither.  The pre-compaction bytes move
+    to ``journal-compacted.jsonl`` for forensics (one generation kept).
+    A finished (``done``) journal is rotation's business, not ours; a
+    corrupt journal raises exactly like replay.  ``replayed`` is the
+    caller's already-built ``(state, record_count)`` — adoption just
+    replayed the whole journal, and re-parsing it here would double
+    the O(N) cost exactly when the journal is at its largest.  Returns
+    True when bytes were folded."""
+    p = Path(path)
+    if not p.exists():
+        return False
+    if replayed is not None:
+        st, n_records = replayed
+    else:
+        st, records, _torn = replay_journal(p)
+        n_records = len(records)
+    if n_records <= max_records or not st.started \
+            or st.done_rc is not None:
+        return False
+    rec = {"seq": st.seq, "ts": time.time(), "kind": "snapshot",
+           "state": st.to_json()}
+    tmp = p.with_name("journal.compact.tmp")
+    with open(tmp, "w") as f:
+        f.write(encode_record(rec))
+        f.flush()
+        os.fsync(f.fileno())
+    try:
+        # forensics first (best-effort copy — losing it costs history,
+        # not correctness), then the atomic swap
+        p.with_name("journal-compacted.jsonl").write_bytes(p.read_bytes())
+    except OSError:
+        pass
+    tmp.replace(p)
+    return True
 
 
 def replay_journal(path: str | Path
@@ -406,9 +555,9 @@ def crash_point(label: str, marker_dir: str | Path | None = None) -> None:
 
 def pid_alive(pid: int) -> bool:
     """Best-effort liveness for a process we are not the parent of.
-    A recycled pid can alias a dead child to alive — the heartbeat
-    classifier is the backstop there (a silent recycled pid goes DEAD
-    and the normal HANG path takes over)."""
+    A recycled pid can alias a dead child to alive — pair with
+    :func:`pid_start_time` (the journaled identity) where a false
+    positive would be adopted-and-later-killed, not merely observed."""
     if pid <= 0:
         return False
     try:
@@ -418,6 +567,29 @@ def pid_alive(pid: int) -> bool:
     except PermissionError:
         return True
     return True
+
+
+def pid_start_time(pid: int) -> int | None:
+    """The kernel start time of ``pid`` (clock ticks since boot,
+    ``/proc/<pid>/stat`` field 22).  The (pid, starttime) pair is a
+    process identity pid recycling cannot forge: across a machine
+    reboot — or just a long downtime — the same pid number names a
+    DIFFERENT process, and an adopter trusting the pid alone would
+    attach to (and later SIGKILL) an unrelated victim.  ``None`` when
+    unreadable (no /proc, process gone): identity checking degrades to
+    the plain pid, never blocks adoption on a platform quirk."""
+    try:
+        data = Path(f"/proc/{pid}/stat").read_bytes()
+    except OSError:
+        return None
+    # comm (field 2) is parenthesized and may itself contain spaces or
+    # parens — parse from the LAST ')'; starttime is field 22, i.e.
+    # index 19 of the post-comm tail (which starts at field 3).
+    tail = data.rsplit(b")", 1)[-1].split()
+    try:
+        return int(tail[19])
+    except (IndexError, ValueError):
+        return None
 
 
 def rc_dir(ft_dir: str | Path) -> Path:
@@ -477,17 +649,37 @@ class AdoptedProcess:
 
     def __init__(self, pid: int, *, host_id: int | None = None,
                  ft_dir: str | Path | None = None, rc_grace_s: float = 2.0,
+                 start_time: int | None = None,
                  clock: Callable[[], float] = time.monotonic):
+        # ``start_time`` is the JOURNALED (pid, starttime) identity
+        # (ISSUE 15 satellite): when given, a live pid whose current
+        # start time disagrees is a RECYCLED pid — an unrelated process
+        # this handle must treat as the dead rank it replaced, and must
+        # never signal.
         self.pid = int(pid)
         self.host_id = host_id
         self.ft_dir = ft_dir
         self.rc_grace_s = float(rc_grace_s)
+        self.start_time = start_time
         self.clock = clock
         self.returncode: int | None = None
         self._sent: int | None = None  # last signal we delivered
         self._dead_at: float | None = None
 
+    def _alive(self) -> bool:
+        if not pid_alive(self.pid):
+            return False
+        if self.start_time is not None:
+            cur = pid_start_time(self.pid)
+            if cur is not None and cur != self.start_time:
+                return False  # recycled pid: an unrelated live process
+        return True
+
     def _signal(self, sig: int) -> None:
+        if self.start_time is not None and not self._alive():
+            # never signal a recycled pid — the number now names an
+            # innocent process that is not ours to kill
+            return
         try:
             os.kill(self.pid, sig)
             self._sent = sig
@@ -503,7 +695,7 @@ class AdoptedProcess:
     def poll(self) -> int | None:
         if self.returncode is not None:
             return self.returncode
-        if pid_alive(self.pid):
+        if self._alive():
             return None
         rc = None if self.ft_dir is None else read_rc(self.ft_dir, self.pid)
         if rc is None:
