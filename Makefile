@@ -3,7 +3,10 @@
 # ~2 s and is jax-free).  `make verify` is the full tier-1 recipe from
 # ROADMAP.md with the static gate in front.
 
-.PHONY: check tier1 verify
+# `set -o pipefail` in the tier1 recipe needs bash, not POSIX sh.
+SHELL := /bin/bash
+
+.PHONY: check tier1 verify bench-smoke
 
 # Static analysis over the files changed vs origin/main (the whole
 # package is still parsed, so cross-module rules keep context).  Falls
@@ -25,3 +28,11 @@ tier1:
 		| tee /tmp/_t1.log
 
 verify: check tier1
+
+# Flagship perf drill on the synthetic input-bound workload (ISSUE 18):
+# a real launch fan-out — 1 input host + trainer + compile-artifact
+# server — rc-gated on served-step and warm-TTFS ratios.  CPU-only,
+# ~1 min; `--repeat 3` is the acceptance run.
+bench-smoke:
+	timeout -k 10 600 env JAX_PLATFORMS=cpu \
+		python benches/flagship_bench.py --quick

@@ -76,6 +76,36 @@ def _peak_hbm_gbs(device_kind: str) -> float | None:
     return None
 
 
+def _bench_max_age_s() -> float:
+    """Replay/refresh staleness horizon (TPUCFN_BENCH_MAX_AGE_S, default
+    one day).  A recorded row older than this is emitted with
+    ``stale: true`` AND a fallback note naming the nonzero
+    ``vs_baseline`` it carries — previously the refresh path checked
+    only the commit stamp, so an aged row serviced from the queue could
+    silently pose as current."""
+    try:
+        return float(os.environ.get("TPUCFN_BENCH_MAX_AGE_S", "86400"))
+    except ValueError:
+        return 86400.0
+
+
+def _staleness(row_ts: float | None, row_commit: str | None,
+               now_commit: str | None) -> tuple[int, bool, str]:
+    """Shared replay/refresh staleness rule: (age_s, stale, reason).
+    Stale when the row is older than the max-age horizon, predates
+    commit stamping (provenance unknowable — VERDICT r4 weak #3), or
+    was captured on a different commit than this invocation."""
+    max_age = _bench_max_age_s()
+    age_s = round(time.time() - (row_ts if row_ts else time.time()))
+    if age_s > max_age:
+        return age_s, True, f"age {age_s}s exceeds TPUCFN_BENCH_MAX_AGE_S={max_age:.0f}"
+    if row_commit is None:
+        return age_s, True, "row predates commit stamping"
+    if now_commit and row_commit != now_commit:
+        return age_s, True, f"commit moved {row_commit}->{now_commit}"
+    return age_s, False, ""
+
+
 def _git_commit() -> str | None:
     """Current repo commit (short) — stamped into recorded rows so the
     replay tier can flag results from older code (ADVICE r3)."""
@@ -284,24 +314,27 @@ def orchestrate() -> int:
             if fresh is not None:
                 result = fresh["result"]
                 # Fresh in time, but the resident client may be running
-                # OLDER code than this invocation: the same commit rule
-                # as the replay tier applies (a mismatch or an unstamped
-                # row is stale even if serviced seconds ago) — and a
-                # stale refresh is published at the SAME tier as a
-                # stale replay, 'tpu-recorded', not as a live 'tpu' row
-                # with a buried stale flag (ADVICE r5).
+                # OLDER code than this invocation: the same staleness
+                # rule as the replay tier applies (max-age horizon,
+                # commit mismatch, unstamped row) — and a stale refresh
+                # is published at the SAME tier as a stale replay,
+                # 'tpu-recorded', not as a live 'tpu' row with a buried
+                # stale flag (ADVICE r5).
                 now_commit = _git_commit()
                 fresh_commit = fresh.get("git_commit")
-                stale = bool(fresh_commit is None
-                             or (now_commit and fresh_commit != now_commit))
+                age_s, stale, why = _staleness(
+                    fresh.get("ts"), fresh_commit, now_commit)
                 mode = "tpu-recorded" if stale else "tpu"
                 if stale:
                     notes.append(
-                        "refresh row git_commit missing/mismatched — "
-                        "demoted to tpu-recorded")
+                        f"refresh row stale ({why}) — demoted to "
+                        f"tpu-recorded; its vs_baseline "
+                        f"{result.get('vs_baseline')} reflects an old "
+                        "capture, not current code")
                 result.setdefault("detail", {})["recorded"] = {
                     "phase": fresh.get("phase"), "utc": fresh.get("utc"),
-                    "age_s": round(time.time() - fresh.get("ts", time.time())),
+                    "age_s": age_s,
+                    "max_age_s": _bench_max_age_s(),
                     "git_commit": fresh_commit,
                     "current_commit": now_commit,
                     "stale": stale,
@@ -332,20 +365,22 @@ def orchestrate() -> int:
                 # Staleness provenance (ADVICE r3): a replay must say how
                 # old it is and whether the code has moved since capture,
                 # so an aged recording cannot silently pose as current.
-                age_s = round(time.time() - rec.get("ts", time.time()))
                 now_commit = _git_commit()
                 rec_commit = rec.get("git_commit")
+                age_s, stale, why = _staleness(
+                    rec.get("ts"), rec_commit, now_commit)
+                if stale:
+                    notes.append(
+                        f"recorded row stale ({why}) — its vs_baseline "
+                        f"{result.get('vs_baseline')} reflects an old "
+                        "capture, not current code")
                 result.setdefault("detail", {})["recorded"] = {
                     "phase": rec.get("phase"), "utc": rec.get("utc"),
                     "age_s": age_s,
+                    "max_age_s": _bench_max_age_s(),
                     "git_commit": rec_commit,
                     "current_commit": now_commit,
-                    # A row with no recorded commit predates commit
-                    # stamping: its provenance is unknowable, so it is
-                    # stale by definition (VERDICT r4 weak #3).
-                    "stale": bool(age_s > 86400 or rec_commit is None
-                                  or (now_commit
-                                      and rec_commit != now_commit)),
+                    "stale": stale,
                     "source": "onchip/megabench_results.jsonl (single-client "
                               "on-chip suite; see PARITY.md round-3 status)"}
             else:
@@ -377,9 +412,12 @@ def orchestrate() -> int:
 # --------------------------------------------------------------------------
 
 
-def _measure_trainer(trainer, state, batch, *, steps, warmup):
+def _measure_trainer(trainer, state, batch, *, steps, warmup, ledger=None):
     """Shared measurement scaffold: compile step, XLA cost analysis,
-    warmup, timed async chain. Returns (state, dict)."""
+    warmup, timed async chain. Returns (state, dict).  ``ledger`` (a
+    GoodputLedger or None) gets the compile and timed-step durations so
+    the bench row can carry the same bucket shares the live fleet
+    reports."""
     import time as _time
 
     import jax
@@ -388,6 +426,8 @@ def _measure_trainer(trainer, state, batch, *, steps, warmup):
     state, metrics = trainer.step(state, batch)
     float(metrics["loss"])  # value fetch forces a true device sync
     compile_s = _time.perf_counter() - t0
+    if ledger is not None:
+        ledger.account("compile", compile_s)
 
     flops_per_dev_step = None
     bytes_per_dev_step = None
@@ -427,6 +467,8 @@ def _measure_trainer(trainer, state, batch, *, steps, warmup):
             state, metrics = trainer.step(state, batch)
         final_loss = float(metrics["loss"])
         mean_step = (_time.perf_counter() - t0) / steps
+    if ledger is not None:
+        ledger.account("step", mean_step * steps, step=steps)
 
     device = jax.devices()[0]
     peak = _peak_tflops(device.device_kind)
@@ -476,13 +518,23 @@ class _ToFloat:
 
 
 def _measure_input_overlap(trainer, state, mesh, *, image_hw, classes,
-                           global_batch, steps, prestaged_step_s):
+                           global_batch, steps, prestaged_step_s,
+                           ledger=None):
     """VERDICT r2 item 6's third leg: drive the SAME train step from the
     real input pipeline (tpurecord shards → ShardedDataset streaming →
     JPEG decode + crop transform → prefetch_to_mesh) and compare the
     steady-state step time against the pre-staged batch. If prefetch
     overlaps compute, the two match; a gap means training is
-    input-bound."""
+    input-bound.
+
+    ISSUE 18 fourth leg: the same steps fed by the disaggregated input
+    plane (``served_step_s``) — against a real fleet of input hosts
+    when the launcher fanned out ``TPUCFN_INPUT_ADDRS``, or an
+    in-process InputService over the same shards otherwise
+    (``TPUCFN_BENCH_INPUT_SERVE=0`` skips).  Per-step time spent
+    waiting on ``next(it)`` is accounted to the goodput ledger as
+    ``data_wait`` so the emitted bucket shares name input-boundness the
+    same way the live fleet's goodput report does."""
     import time as _time
 
     import numpy as np
@@ -532,27 +584,100 @@ def _measure_input_overlap(trainer, state, mesh, *, image_hw, classes,
                 cache_in_memory=False, process_index=0, process_count=1,
                 transform=transform, num_workers=nw)
             it = prefetch_to_mesh(ds.batches(None), mesh)
-        # Warm compile + drain the prefetch queue's head start (depth=2):
-        # timing must start from STEADY state, or the first few steps
-        # consume pre-staged batches and understate loader latency.
-        state2, metrics = trainer.step(state, next(it))
-        for _ in range(3):
-            state2, metrics = trainer.step(state2, next(it))
-        float(metrics["loss"])
-        t0 = _time.perf_counter()
-        for _ in range(steps):
-            state2, metrics = trainer.step(state2, next(it))
-        float(metrics["loss"])
-        loader_step_s = (_time.perf_counter() - t0) / steps
-        return {
+        def drive(st, it):
+            # Warm compile + drain the prefetch queue's head start
+            # (depth=2): timing must start from STEADY state, or the
+            # first few steps consume pre-staged batches and understate
+            # loader latency.  Host-side wait in next(it) is the
+            # data_wait bucket; the residual of the timed region is
+            # charged to step (the enqueue chain is async — per-step
+            # device time is not observable without breaking the
+            # pipeline, and the residual is exactly what the wall
+            # decomposition needs).
+            st, metrics = trainer.step(st, next(it))
+            for _ in range(3):
+                st, metrics = trainer.step(st, next(it))
+            float(metrics["loss"])
+            wait_s = 0.0
+            t0 = _time.perf_counter()
+            for _ in range(steps):
+                tw = _time.perf_counter()
+                b = next(it)
+                wait_s += _time.perf_counter() - tw
+                st, metrics = trainer.step(st, b)
+            float(metrics["loss"])
+            total = _time.perf_counter() - t0
+            if ledger is not None:
+                ledger.account("data_wait", wait_s)
+                ledger.account("step", max(0.0, total - wait_s))
+            # returns the final state too: with donate_state the input
+            # buffers are consumed, so the next leg must start from the
+            # state this one produced, not re-use a donated one.
+            return st, total / steps, wait_s / total if total else 0.0
+
+        state, loader_step_s, loader_wait_share = drive(state, it)
+
+        out = {
             "loader_step_s": round(loader_step_s, 5),
             "prestaged_step_s": round(prestaged_step_s, 5),
+            "loader_wait_share": round(loader_wait_share, 4),
             "loader_workers": nw,
             "host_cores": os.cpu_count(),
             # ε = 15% + 2ms: scheduling jitter, not a second input budget
             "input_bound": bool(
                 loader_step_s > prestaged_step_s * 1.15 + 0.002),
         }
+
+        # served leg: identical steps through the disaggregated input
+        # plane.  TPUCFN_INPUT_ADDRS (launcher fan-out) wins; otherwise
+        # an in-process InputService over the SAME shards stands in —
+        # the served stream is bit-identical to the local order either
+        # way, so served_step_s isolates transport+overlap cost.
+        addrs = os.environ.get("TPUCFN_INPUT_ADDRS")
+        if addrs or os.environ.get("TPUCFN_BENCH_INPUT_SERVE", "1") != "0":
+            svc = None
+            stream = None
+            try:
+                from tpucfn.data.service import (
+                    AdaptivePrefetcher, InputService, ServiceBatchStream,
+                    service_or_local_batches)
+
+                ds2 = ShardedDataset(
+                    shards, batch_size_per_process=global_batch, seed=0,
+                    cache_in_memory=False, process_index=0,
+                    process_count=1, transform=transform, num_workers=0)
+                if addrs:
+                    stream = service_or_local_batches(ds2)
+                    source = "input-hosts"
+                else:
+                    sw = int(os.environ.get("TPUCFN_BENCH_SERVE_WORKERS",
+                                            str(max(2, (os.cpu_count()
+                                                        or 2) // 2))))
+                    svc = InputService(
+                        shards, num_trainers=1,
+                        batch_size_per_process=global_batch, seed=0,
+                        transform=transform, num_workers=sw,
+                        queue_batches=4, host="127.0.0.1").start()
+                    stream = AdaptivePrefetcher(ServiceBatchStream(
+                        svc.address, 0, process_count=1,
+                        batch_size=global_batch, seed=0))
+                    source = "in-process"
+                it2 = prefetch_to_mesh(iter(stream), mesh)
+                state, served_step_s, served_wait_share = drive(state, it2)
+                out["served_step_s"] = round(served_step_s, 5)
+                out["served_wait_share"] = round(served_wait_share, 4)
+                out["served_source"] = source
+            except Exception as e:  # noqa: BLE001 — partial row beats none
+                out["served_error"] = repr(e)
+            finally:
+                for closer in (stream, svc):
+                    close = getattr(closer, "close", None)
+                    if close is not None:
+                        try:
+                            close()
+                        except Exception:  # noqa: BLE001 — teardown
+                            pass
+        return out
     except Exception as e:  # noqa: BLE001 — the bench must still emit JSON
         return {"error": repr(e)}
     finally:
@@ -904,6 +1029,15 @@ def worker() -> int:
 
     enable_compile_cache()
 
+    # Fleet artifact plane (ISSUE 13 → 18): when the launcher fanned out
+    # TPUCFN_COMPILE_CACHE_ADDRS/_DIR, install the process-default
+    # compile-cache client so Trainer's jit goes lower → key →
+    # local-store / fleet-fetch / compile+publish.  Unset ⇒ None and the
+    # step path is byte-identical (pinned by test_compilecache).
+    from tpucfn.compilecache import configure_from_env
+
+    cc_client = configure_from_env()
+
     import jax.numpy as jnp
     import numpy as np
     import optax
@@ -927,6 +1061,20 @@ def worker() -> int:
     if which == "unet":
         return _worker_unet(tiny)
     n_dev = jax.device_count()
+
+    # Bench-local goodput ledger (ISSUE 18): the row carries the SAME
+    # bucket decomposition the live fleet's goodput report uses —
+    # compile / compile_cached / compile_fetched / step / data_wait plus
+    # the idle residual — so "what fraction of wall is the input plane"
+    # reads identically offline and in production.
+    import pathlib as _pl
+    import shutil as _sh
+    import tempfile as _tf
+
+    from tpucfn.obs.goodput import GoodputLedger, fleet_window_observation
+
+    gp_dir = _pl.Path(_tf.mkdtemp(prefix="tpucfn-bench-goodput-"))
+    ledger = GoodputLedger(gp_dir, 0, role="bench")
 
     # --- "create-stack" leg of time-to-first-step (BASELINE metric 2).
     # The control plane here is the in-process fake (this environment has
@@ -987,7 +1135,7 @@ def worker() -> int:
     })
 
     state, m = _measure_trainer(trainer, state, batch, steps=steps,
-                                warmup=warmup)
+                                warmup=warmup, ledger=ledger)
     if os.environ.get("TPUCFN_BENCH_WARM_TTFS", "1") == "1":
         # Warm-start time-to-first-step (BASELINE metric 2; default-on
         # since ISSUE 13 so the trajectory tracks cold AND warm): drop
@@ -997,10 +1145,21 @@ def worker() -> int:
         # the same pod pays; `benches/compile_bench.py` measures the
         # fleet artifact plane's cross-process half of the same story.
         jax.clear_caches()
+        # With a clear jit cache, the next step re-enters Trainer.step's
+        # _maybe_warm — against the persistent XLA cache AND (when
+        # configure_from_env installed a client above) the fleet
+        # artifact plane, whose outcome names the goodput bucket.
+        trainer._jit_step = None
         t0 = time.perf_counter()
         state, metrics = trainer.step(state, batch)
         float(metrics["loss"])
         warm_s = time.perf_counter() - t0
+        outcome = cc_client.last_outcome if cc_client is not None else None
+        ledger.account({"fetch": "compile_fetched",
+                        "compile": "compile"}.get(outcome, "compile_cached"),
+                       warm_s)
+        if outcome is not None:
+            m["compile_cache_outcome"] = outcome
         m["compile_warm_s"] = round(warm_s, 2)
         m["warm_time_to_first_step_s"] = round(
             provision_s + init_s + warm_s, 2)
@@ -1010,7 +1169,22 @@ def worker() -> int:
         m["overlap"] = _measure_input_overlap(
             trainer, state, mesh, image_hw=image_hw, classes=classes,
             global_batch=global_batch, steps=steps,
-            prestaged_step_s=m["mean_step_s"])
+            prestaged_step_s=m["mean_step_s"], ledger=ledger)
+    ledger.close()
+    gp = fleet_window_observation(gp_dir)
+    _sh.rmtree(gp_dir, ignore_errors=True)
+    if gp is not None:
+        shares = {k: float(v) for k, v in gp["shares"].items()}
+        bad = {k: v for k, v in shares.items() if not 0.0 <= v <= 1.0}
+        if bad:
+            # rc-gate: a malformed decomposition must fail the worker,
+            # not ship a row whose columns cannot be trusted.
+            raise RuntimeError(f"goodput shares out of [0, 1]: {bad}")
+        m["goodput"] = {
+            "wall_s": round(gp["wall_s"], 3),
+            "goodput_ratio": round(gp["goodput_ratio"], 4),
+            "shares": {k: round(v, 4) for k, v in sorted(shares.items())},
+        }
     ips_chip = global_batch / m["mean_step_s"] / n_dev
     print(json.dumps({
         "metric": "resnet50_imagenet_train_images_per_sec_per_chip"

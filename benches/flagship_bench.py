@@ -1,0 +1,375 @@
+#!/usr/bin/env python
+"""Flagship perf drill (ISSUE 18 acceptance): the input-host AND
+warm-start planes under one real launch fan-out, rc-gated, ONE JSON
+line out in the standard BENCH row schema.
+
+The claim being cashed: the two PR 11/13 planes compose on the
+flagship path.  One `tpucfn launch`-shaped fleet — 1 input host
+running the real ``tpucfn data serve`` CLI + 1 trainer + the jax-free
+compile-artifact server — runs a synthetic INPUT-BOUND workload twice:
+
+* **cold** — the trainer compiles a residual-MLP grad program (the
+  compile_bench program: a real multi-second XLA:CPU compile) and
+  publishes its serialized executable to the artifact server; its data
+  legs measure ``prestaged_step_s`` (every batch in RAM — the floor),
+  ``loader_step_s`` (local decode serializes with compute — the
+  recorded stall in miniature) and ``served_step_s`` (fed by the input
+  host through ``service_or_local_batches``).
+* **warm** — a second fleet incarnation with a FRESH local store: its
+  time-to-first-step must come from a fleet **fetch**, not a compile.
+
+Gates (all must hold, three consecutive runs green by construction —
+``--repeat N`` reruns the whole drill):
+
+* ``served_step_s  <= 1.5 x prestaged_step_s`` (the PR 11 bound, now
+  on the flagship path),
+* ``warm ttfs      <= 0.35 x cold ttfs`` (the PR 13 bound, through a
+  real launch fan-out),
+* goodput bucket shares present in the emitted row, each in [0, 1],
+  with ``data_wait`` strictly lower served than local.
+
+Trainer children are this same file (``TPUCFN_FLAGSHIP_CHILD=1``), so
+every measured number crosses real process boundaries: separate
+interpreters, batches over TCP, artifacts through the server.
+
+Usage: JAX_PLATFORMS=cpu python benches/flagship_bench.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+# -- the trainer child ------------------------------------------------------
+
+class _SleepDecode:
+    """Value-preserving synthetic decode cost: the local path pays it
+    per example, the served stream skips it (the input host streams
+    ready batches) — so the two paths yield bit-identical values while
+    only the LOCAL one is input-bound."""
+
+    def __init__(self, seconds: float):
+        self.seconds = seconds
+
+    def __call__(self, ex, rs):
+        if self.seconds > 0:
+            time.sleep(self.seconds)
+        return ex
+
+
+def child() -> int:
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpucfn.compilecache import configure_from_env
+    from tpucfn.compilecache.jit import maybe_warm
+    from tpucfn.data.pipeline import ShardedDataset
+    from tpucfn.data.service import service_or_local_batches
+    from tpucfn.ft import HeartbeatWriter
+    from tpucfn.obs.goodput import GoodputLedger
+
+    host = int(os.environ.get("TPUCFN_HOST_ID", "0"))
+    run_dir = Path(os.environ["TPUCFN_FLAGSHIP_RUN_DIR"])
+    shards_dir = Path(os.environ["TPUCFN_FLAGSHIP_SHARDS"])
+    layers = int(os.environ["TPUCFN_FLAGSHIP_LAYERS"])
+    width = int(os.environ["TPUCFN_FLAGSHIP_WIDTH"])
+    batch = int(os.environ["TPUCFN_FLAGSHIP_BATCH"])
+    batches = int(os.environ["TPUCFN_FLAGSHIP_BATCHES"])
+    compute_s = float(os.environ["TPUCFN_FLAGSHIP_COMPUTE_S"])
+    decode_s = float(os.environ["TPUCFN_FLAGSHIP_DECODE_S"])
+
+    hb = None
+    ft_dir = os.environ.get("TPUCFN_FT_DIR", "").strip()
+    if ft_dir:
+        hb = HeartbeatWriter(
+            ft_dir, host_id=host, role="trainer",
+            interval_s=float(
+                os.environ.get("TPUCFN_FT_HEARTBEAT_S", "0.2") or 0.2)
+        ).start()
+    ledger = GoodputLedger(run_dir / "goodput", host_id=host, role="bench")
+
+    try:
+        # -- warm-start leg: the compile_bench program through the ----
+        # -- launcher-fanned artifact plane ---------------------------
+        client = configure_from_env()
+
+        def loss(params, x):
+            h = x
+            for w, b in params:
+                h = jnp.tanh(h @ w + b) + 0.1 * h
+            return (h ** 2).mean()
+
+        rs = np.random.RandomState(0)
+        params = [(rs.randn(width, width).astype(np.float32) * 0.1,
+                   np.zeros(width, np.float32)) for _ in range(layers)]
+        x = rs.randn(8, width).astype(np.float32)
+
+        t0 = time.perf_counter()  # jax imported, program built: the clock
+        step_fn = maybe_warm(jax.jit(jax.grad(loss)), label="flagship")
+        out = step_fn(params, x)
+        jax.block_until_ready(out)
+        ttfs_s = time.perf_counter() - t0
+        outcome = client.last_outcome if client is not None else None
+        # "store" published a FRESH compile; only "fetch" skipped one
+        ledger.account(
+            "compile_fetched" if outcome == "fetch" else "compile", ttfs_s)
+        digest = float(sum(float(jnp.sum(w)) for w, _ in out))
+
+        # -- data legs: prestaged floor, local loader, served ---------
+        shards = sorted(shards_dir.glob("*.tpurec"))
+        tf = _SleepDecode(decode_s)
+        warmup = min(3, max(0, batches - 1))
+
+        def ds():
+            return ShardedDataset(
+                shards, batch_size_per_process=batch, seed=0,
+                cache_in_memory=False, process_index=0, process_count=1,
+                transform=tf)
+
+        def drive(it, account: bool) -> float:
+            steps = []
+            for i in range(batches):
+                t0 = time.perf_counter()
+                b = next(it)
+                t_wait = time.perf_counter() - t0
+                time.sleep(compute_s)
+                steps.append(time.perf_counter() - t0)
+                if account and i >= warmup:
+                    ledger.account("data_wait", t_wait)
+                    ledger.account("step", steps[-1] - t_wait)
+                if hb is not None:
+                    hb.update_step(i)
+            s = steps[warmup:]
+            return sum(s) / len(s)
+
+        staged = list(ds().epoch(0))[:batches]
+        t0 = time.perf_counter()
+        for _ in staged:
+            time.sleep(compute_s)
+        prestaged_step_s = (time.perf_counter() - t0) / len(staged)
+
+        loader_step_s = drive(iter(ds().batches(None)), account=False)
+
+        served = service_or_local_batches(ds(), num_epochs=1)
+        try:
+            served_step_s = drive(iter(served), account=True)
+        finally:
+            close = getattr(served, "close", None)
+            if close is not None:
+                close()
+
+        (run_dir / f"result-host{host:03d}.json").write_text(json.dumps({
+            "ttfs_s": round(ttfs_s, 4),
+            "outcome": outcome,
+            "digest": digest,
+            "prestaged_step_s": round(prestaged_step_s, 5),
+            "loader_step_s": round(loader_step_s, 5),
+            "served_step_s": round(served_step_s, 5),
+            "used_service": bool(
+                (os.environ.get("TPUCFN_INPUT_ADDRS") or "").strip()),
+        }))
+    finally:
+        if hb is not None:
+            hb.stop()
+        ledger.close()
+    return 0
+
+
+# -- the orchestrator -------------------------------------------------------
+
+def _write_shards(tmp: Path, n: int) -> Path:
+    import numpy as np
+
+    from tpucfn.data import write_dataset_shards
+
+    rs = np.random.RandomState(1)
+    d = tmp / "shards"
+    d.mkdir()
+    write_dataset_shards(
+        ({"x": rs.randn(64).astype(np.float32)} for _ in range(n)),
+        d, num_shards=4)
+    return d
+
+
+def _launch(tmp: Path, run_dir: Path, shards: Path, args,
+            *, cc_addrs: str, cc_dir: Path, input_port: int) -> dict:
+    """One fleet incarnation: 1 trainer + 1 input host under the real
+    Launcher/GangCoordinator, compile-cache address fanned out.
+    Returns the trainer's result row."""
+    from tpucfn.bootstrap import EnvContract
+    from tpucfn.ft import (GangCoordinator, GangRestart, HeartbeatMonitor,
+                           MonitorConfig, RestartBudget)
+    from tpucfn.launch import Launcher, LocalTransport
+
+    run_dir.mkdir(parents=True, exist_ok=True)
+    n = 2  # 1 trainer + 1 input host
+    hostfile = run_dir / "hostfile"
+    hostfile.write_text("".join("127.0.0.1:0\n" for _ in range(n)))
+    contract = EnvContract(
+        workers_path=str(hostfile), workers_count=n, worker_chip_count=1,
+        coordinator="127.0.0.1:1234", host_id=0, storage=str(run_dir),
+        generation=1)
+    ft_dir = run_dir / "ft"
+    serve_argv = [sys.executable, "-m", "tpucfn.cli", "data", "serve",
+                  "--shards", str(shards), "--batch-size", str(args.batch),
+                  "--seed", "0", "--num-epochs", "1",
+                  "--host", "127.0.0.1", "--idle-exit", "2.0"]
+    launcher = Launcher(
+        contract, LocalTransport(),
+        ft_dir=str(ft_dir), ft_heartbeat_s=0.2,
+        input_hosts=1, input_port=input_port, input_argv=serve_argv,
+        compile_cache_addrs=[cc_addrs],
+        extra_env={
+            "TPUCFN_FLAGSHIP_CHILD": "1",
+            "TPUCFN_FLAGSHIP_RUN_DIR": str(run_dir),
+            "TPUCFN_FLAGSHIP_SHARDS": str(shards),
+            "TPUCFN_FLAGSHIP_LAYERS": str(args.layers),
+            "TPUCFN_FLAGSHIP_WIDTH": str(args.width),
+            "TPUCFN_FLAGSHIP_BATCH": str(args.batch),
+            "TPUCFN_FLAGSHIP_BATCHES": str(args.batches),
+            "TPUCFN_FLAGSHIP_COMPUTE_S": str(args.compute_ms / 1e3),
+            "TPUCFN_FLAGSHIP_DECODE_S": str(args.decode_ms / 1e3),
+            "TPUCFN_COMPILE_CACHE_DIR": str(cc_dir),
+            "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
+        })
+    monitor = HeartbeatMonitor(
+        ft_dir, expected_hosts=n,
+        config=MonitorConfig(interval_s=0.2, startup_grace_s=120.0))
+    coord = GangCoordinator(
+        launcher, [sys.executable, str(Path(__file__).resolve())],
+        policy=GangRestart(RestartBudget(0)), monitor=monitor,
+        ft_dir=ft_dir, poll_interval=0.05, term_grace_s=5.0)
+    rc = coord.run()
+    if rc != 0:
+        raise RuntimeError(f"fleet incarnation failed rc={rc} "
+                           f"(see {ft_dir}/events.jsonl)")
+    return json.loads((run_dir / "result-host000.json").read_text())
+
+
+def _drill(args, round_idx: int) -> dict:
+    from tpucfn.compilecache.service import ArtifactServer
+    from tpucfn.obs.goodput import fleet_window_observation
+
+    tmp = Path(tempfile.mkdtemp(prefix=f"tpucfn-flagship-r{round_idx}-"))
+    try:
+        shards = _write_shards(tmp, args.batches * args.batch)
+        srv = ArtifactServer(tmp / "server-store", host="127.0.0.1").start()
+        try:
+            cold = _launch(tmp, tmp / "cold", shards, args,
+                           cc_addrs=srv.address, cc_dir=tmp / "store-cold",
+                           input_port=args.input_port)
+            warm = _launch(tmp, tmp / "warm", shards, args,
+                           cc_addrs=srv.address, cc_dir=tmp / "store-warm",
+                           input_port=args.input_port + 10)
+        finally:
+            srv.close()
+
+        ratio_ttfs = (warm["ttfs_s"] / cold["ttfs_s"]
+                      if cold["ttfs_s"] else 1.0)
+        ratio_served = (cold["served_step_s"] / cold["prestaged_step_s"]
+                        if cold["prestaged_step_s"] else 0.0)
+        gp = fleet_window_observation(tmp / "cold" / "goodput")
+        shares = ({k: round(float(v), 4)
+                   for k, v in sorted(gp["shares"].items())}
+                  if gp else None)
+        ok_shares = bool(
+            shares is not None
+            and all(0.0 <= v <= 1.0 for v in shares.values())
+            and "data_wait" in shares and "idle" in shares)
+        ok = (cold["used_service"] and warm["used_service"]
+              and ratio_served <= args.served_ratio
+              and cold["loader_step_s"]
+              > cold["prestaged_step_s"] * 1.15  # the workload IS bound
+              and warm["outcome"] == "fetch"  # fleet plane, not a recompile
+              and warm["digest"] == cold["digest"]
+              and ratio_ttfs <= args.warm_ratio
+              and ok_shares)
+        return {
+            "ok": ok,
+            "cold_time_to_first_step_s": cold["ttfs_s"],
+            "warm_time_to_first_step_s": warm["ttfs_s"],
+            "warm_cold_ttfs_ratio": round(ratio_ttfs, 4),
+            "cold_outcome": cold["outcome"],
+            "warm_outcome": warm["outcome"],
+            "digest_bit_identical": warm["digest"] == cold["digest"],
+            "prestaged_step_s": cold["prestaged_step_s"],
+            "loader_step_s": cold["loader_step_s"],
+            "served_step_s": cold["served_step_s"],
+            "served_prestaged_ratio": round(ratio_served, 4),
+            "goodput_shares": shares,
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main() -> int:
+    if os.environ.get("TPUCFN_FLAGSHIP_CHILD") == "1":
+        return child()
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--layers", type=int, default=48,
+                   help="grad-program depth — sizes the cold compile")
+    p.add_argument("--width", type=int, default=128)
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--batches", type=int, default=24)
+    p.add_argument("--compute-ms", type=float, default=50.0)
+    p.add_argument("--decode-ms", type=float, default=6.0,
+                   help="synthetic per-example decode cost (local path "
+                        "only — the input host streams ready batches)")
+    p.add_argument("--served-ratio", type=float, default=1.5,
+                   help="gate: served step <= this x prestaged")
+    p.add_argument("--warm-ratio", type=float, default=0.35,
+                   help="gate: warm ttfs <= this x cold ttfs")
+    p.add_argument("--input-port", type=int, default=9350)
+    p.add_argument("--repeat", type=int, default=1,
+                   help="run the whole drill N times; every round must "
+                        "gate green (the 3x-consecutive acceptance)")
+    p.add_argument("--quick", action="store_true",
+                   help="smaller program + fewer batches (make "
+                        "bench-smoke): same gates, faster wall")
+    args = p.parse_args()
+    if args.quick:
+        args.layers, args.batches = 24, 12
+
+    rounds = []
+    for i in range(args.repeat):
+        r = _drill(args, i)
+        print(f"# flagship round {i}: ok={r['ok']} "
+              f"ttfs {r['cold_time_to_first_step_s']}s -> "
+              f"{r['warm_time_to_first_step_s']}s "
+              f"(ratio {r['warm_cold_ttfs_ratio']}, gate {args.warm_ratio}) "
+              f"served/prestaged {r['served_prestaged_ratio']} "
+              f"(gate {args.served_ratio})", file=sys.stderr)
+        rounds.append(r)
+    ok = all(r["ok"] for r in rounds)
+    row = {
+        "metric": "flagship_served_step_vs_prestaged",
+        "value": rounds[-1]["served_prestaged_ratio"],
+        "unit": "served/prestaged step time",
+        "vs_baseline": 0.0,
+        "detail": {
+            "baseline_note": "no composed input+warm-start path existed "
+                             "before ISSUE 18; the gates are the bound",
+            "ok": ok,
+            "rounds": len(rounds),
+            **rounds[-1],
+        },
+    }
+    print(json.dumps(row))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,128 @@
+"""Deterministic resumable toy trainer for the provisioner-policy e2e
+drill (ISSUE 18).
+
+Same trajectory contract as ``input_e2e_worker`` (``w ← 0.9·w +
+mean(batch.x)`` appended to a per-host JSONL, value-preserving sleep
+decode on the LOCAL path only) plus the two behaviors a policy-driven
+grow needs from a trainer:
+
+* **drain-aware** — polls ``drain_requested(ft_dir, step)`` at every
+  step boundary and exits rc 0 when the coordinator's provision-grow
+  drain converges on it;
+* **resumable** — persists ``{step, w}`` after every step and, on
+  relaunch, skips the already-consumed prefix of the (deterministic)
+  batch stream before continuing — so one mid-run drain→relaunch
+  produces a trajectory BIT-IDENTICAL to an uninterrupted reference.
+
+Before the grow the worker loads locally (paying the decode serially —
+the data-starved shape the policy must notice); after it,
+``TPUCFN_INPUT_ADDRS`` is fanned out and the same stream arrives
+pre-decoded from the input host, collapsing the ``data_wait`` share.
+"""
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+from tpucfn.data.pipeline import ShardedDataset  # noqa: E402
+from tpucfn.data.service import service_or_local_batches  # noqa: E402
+from tpucfn.ft import HeartbeatWriter  # noqa: E402
+from tpucfn.ft.preempt import drain_requested  # noqa: E402
+from tpucfn.obs.goodput import GoodputLedger  # noqa: E402
+
+
+class _SleepDecode:
+    """Value-preserving synthetic decode cost (consumes no RNG, so the
+    served stream — which skips it — stays bit-identical)."""
+
+    def __init__(self, seconds: float):
+        self.seconds = seconds
+
+    def __call__(self, ex, rs):
+        if self.seconds > 0:
+            time.sleep(self.seconds)
+        return ex
+
+
+def main() -> int:
+    host = int(os.environ.get("TPUCFN_HOST_ID", "0"))
+    trainers = int(os.environ["TPUCFN_WORKERS_COUNT"])
+    run_dir = Path(os.environ["PROV_E2E_RUN_DIR"])
+    shards_dir = Path(os.environ["PROV_E2E_SHARDS"])
+    batch = int(os.environ.get("PROV_E2E_BATCH", "8"))
+    seed = int(os.environ.get("PROV_E2E_SEED", "0"))
+    step_sleep = float(os.environ.get("PROV_E2E_STEP_SLEEP", "0.03"))
+    decode_sleep = float(os.environ.get("PROV_E2E_DECODE_SLEEP", "0.008"))
+    ft_dir = os.environ.get("TPUCFN_FT_DIR", "").strip()
+
+    hb = None
+    if ft_dir:
+        hb = HeartbeatWriter(
+            ft_dir, host_id=host, role="trainer",
+            interval_s=float(
+                os.environ.get("TPUCFN_FT_HEARTBEAT_S", "0.2") or 0.2)
+        ).start()
+    ledger = GoodputLedger(run_dir / "goodput", host_id=host,
+                           role="trainer")
+    run_dir.mkdir(parents=True, exist_ok=True)
+
+    # resume point: the deterministic stream is re-derived from (seed,
+    # shards, batch) and the consumed prefix skipped, so the fold
+    # continues exactly where the drained incarnation stopped
+    state_path = run_dir / f"state-host{host:03d}.json"
+    step, w = 0, 10.0
+    if state_path.exists():
+        st = json.loads(state_path.read_text())
+        step, w = int(st["step"]), float(st["w"])
+
+    ds = ShardedDataset(
+        sorted(shards_dir.glob("*.tpurec")),
+        batch_size_per_process=batch, seed=seed,
+        process_index=host, process_count=trainers,
+        transform=_SleepDecode(decode_sleep))
+    stream = service_or_local_batches(ds, num_epochs=1)
+    losses = run_dir / f"losses-host{host:03d}.jsonl"
+    try:
+        for _ in range(step):  # consumed prefix (cheap: pre-decoded)
+            if next(stream, None) is None:
+                return 0
+        with open(losses, "a") as f:
+            while True:
+                t0_wait = time.monotonic()
+                b = next(stream, None)
+                t_wait = time.monotonic() - t0_wait
+                if b is None:
+                    break
+                step += 1
+                if t_wait >= 1e-4:
+                    ledger.account("data_wait", t_wait, step=step)
+                t0_step = time.monotonic()
+                w = 0.9 * w + float(np.mean(b["x"]))
+                f.write(json.dumps({"step": step, "w": w}) + "\n")
+                f.flush()
+                state_path.write_text(json.dumps({"step": step, "w": w}))
+                if hb is not None:
+                    hb.update_step(step)
+                time.sleep(step_sleep)
+                ledger.account("step", time.monotonic() - t0_step,
+                               step=step)
+                if ft_dir and drain_requested(ft_dir, step):
+                    break  # clean exit at the boundary; resumed later
+    finally:
+        close_stream = getattr(stream, "close", None)
+        if close_stream is not None:
+            close_stream()
+        if hb is not None:
+            hb.stop()
+        ledger.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

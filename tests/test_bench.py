@@ -38,6 +38,24 @@ def test_bench_emits_contract_json_line():
     # MFU machinery ran (flops measured; mfu itself is None off-TPU)
     assert d["flops_per_dev_step_g"] is not None
     assert d["mfu"] is None
+    # ISSUE 18 first-class columns: warm TTFS, the served input leg, and
+    # the goodput bucket decomposition ride every emitted row.
+    assert isinstance(d["warm_time_to_first_step_s"], (int, float))
+    assert d["warm_time_to_first_step_s"] > 0
+    ov = d["overlap"]
+    for k in ("loader_step_s", "served_step_s"):
+        assert isinstance(ov[k], (int, float)) and ov[k] > 0, (k, ov)
+    assert ov["served_source"] in ("in-process", "input-hosts"), ov
+    gp = d["goodput"]
+    assert gp["wall_s"] > 0
+    assert 0.0 <= gp["goodput_ratio"] <= 1.0
+    shares = gp["shares"]
+    for k in ("step", "compile", "data_wait", "idle"):
+        assert k in shares, shares
+    assert all(0.0 <= v <= 1.0 for v in shares.values()), shares
+    # the decomposition covers the wall: shares (idle filler included)
+    # sum to 1 within rounding noise
+    assert abs(sum(shares.values()) - 1.0) < 0.02, shares
 
 
 def test_bench_llama_preset():
@@ -119,6 +137,43 @@ def test_bench_null_commit_recording_is_stale(tmp_path):
     assert rec["detail"]["backend_mode"] == "tpu-recorded"
     assert rec["detail"]["recorded"]["git_commit"] is None
     assert rec["detail"]["recorded"]["stale"] is True
+
+
+def test_bench_stale_age_guard(tmp_path):
+    """A recorded row OLDER than TPUCFN_BENCH_MAX_AGE_S must be emitted
+    with ``stale: true`` and a nonzero ``vs_baseline`` caveat note —
+    never silently reported as current (ISSUE 18 satellite)."""
+    import time as _time
+
+    row = {
+        "phase": "resnet_full", "ts": _time.time() - 3600, "utc": "old",
+        "git_commit": "deadbeef",  # stamped — age alone must trip it
+        "result": {"metric": "m", "value": 2.0, "unit": "u",
+                   "vs_baseline": 7.5, "detail": {"platform": "tpu"}}}
+    path = tmp_path / "recorded.jsonl"
+    path.write_text(json.dumps(row) + "\n")
+    r = _run_bench({
+        "PALLAS_AXON_POOL_IPS": "203.0.113.1",
+        "TPUCFN_BENCH_RECORDED_PATH": str(path),
+        "TPUCFN_BENCH_MAX_AGE_S": "600",  # 1h-old row >> 10min horizon
+        "TPUCFN_BENCH_PROBE_BUDGET_S": "1",
+        "TPUCFN_BENCH_PROBE_TIMEOUT_S": "5",
+        "TPUCFN_BENCH_PROBE_INTERVAL_S": "1",
+        "TPUCFN_BENCH_REFRESH_PATH": str(tmp_path / "req.json"),
+        "TPUCFN_BENCH_REFRESH_WAIT_S": "1",
+    })
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    d = rec["detail"]
+    assert d["backend_mode"] == "tpu-recorded"
+    assert d["recorded"]["stale"] is True
+    assert d["recorded"]["age_s"] >= 3000
+    assert d["recorded"]["max_age_s"] == 600.0
+    stale_notes = [n for n in d["fallback_notes"] if "stale" in n]
+    assert stale_notes, d["fallback_notes"]
+    # the caveat names the vs_baseline so a reader can't mistake the
+    # old capture for current code
+    assert "7.5" in stale_notes[0] and "vs_baseline" in stale_notes[0], \
+        stale_notes
 
 
 def test_bench_refresh_handshake(tmp_path):

@@ -188,6 +188,31 @@ def test_input_hosts_role_env_fanout(tmp_path):
     assert i_env["TPUCFN_WORKERS_COUNT"] == "2"
 
 
+def test_input_advertise_host_overrides_hostfile(tmp_path):
+    """A LocalTransport fleet's hostfile may carry the control plane's
+    synthetic addresses (10.0.0.x) — undialable on loopback, so the
+    advertised input endpoints must be overridable (ISSUE 18; same
+    failure class as --compile-cache-advertise)."""
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text(
+        "".join(f"10.0.0.{i + 1}:8471\n" for i in range(4)))
+    contract = EnvContract(
+        workers_path=str(hostfile), workers_count=4, worker_chip_count=1,
+        coordinator="10.0.0.1:8476", host_id=0, storage=str(tmp_path),
+        generation=1)
+    plain = Launcher(contract, LocalTransport(),
+                     input_hosts=2, input_port=9100)
+    assert plain.host_env(0)["TPUCFN_INPUT_ADDRS"] == \
+        "10.0.0.3:9102,10.0.0.4:9103"
+    launcher = Launcher(contract, LocalTransport(),
+                        input_hosts=2, input_port=9100,
+                        input_advertise_host="127.0.0.1")
+    env = launcher.host_env(0)
+    assert env["TPUCFN_INPUT_ADDRS"] == "127.0.0.1:9102,127.0.0.1:9103"
+    # the input host still binds its own per-host port, unaffected
+    assert launcher.host_env(3)["TPUCFN_INPUT_PORT"] == "9103"
+
+
 def test_input_hosts_zero_keeps_env_byte_identical(tmp_path):
     """input_hosts=0 (every existing caller) must not grow the env —
     the role vars appear only when the input plane is on."""

@@ -1,0 +1,191 @@
+"""Unit pins for the goodput-driven provisioner policy (ISSUE 18):
+every PolicyDecision branch under a fake clock, plus the decision
+table's totality and the fake-ledger observation path the coordinator
+feeds it from."""
+
+import json
+
+import pytest
+
+from tpucfn.obs.goodput import fleet_window_observation
+from tpucfn.provision import (
+    PROVISION_DECISION_TABLE,
+    FleetObservation,
+    GoodputSignal,
+    PolicyAction,
+    PolicyConfig,
+    PolicyDecision,
+    ProvisionPolicy,
+    provision_policy_from_name,
+)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _obs(data_wait=0.0, compile_=0.0, step=None, wall=10.0, hosts=1):
+    if step is None:
+        step = max(0.0, 1.0 - data_wait - compile_)
+    return FleetObservation(
+        wall_s=wall, goodput_ratio=step,
+        shares={"step": step, "data_wait": data_wait, "compile": compile_},
+        num_hosts=hosts)
+
+
+def _policy(clock, **over):
+    cfg = PolicyConfig(**{**dict(
+        grow_threshold=0.25, shrink_threshold=0.02, min_window_s=1.0,
+        cooldown_s=30.0, max_input_hosts=1, chronic_windows=3,
+        spinup_s=5.0, cold_ttfs_s=60.0, warm_ttfs_frac=0.35,
+        horizon_s=600.0), **over})
+    return ProvisionPolicy(cfg, clock=clock)
+
+
+def test_decision_table_is_total():
+    # every signal has a row; every row's action is a PolicyAction
+    assert set(PROVISION_DECISION_TABLE) == set(GoodputSignal)
+    assert all(isinstance(a, PolicyAction)
+               for a in PROVISION_DECISION_TABLE.values())
+
+
+def test_actuation_latency_is_fetch_warm_model():
+    cfg = PolicyConfig(spinup_s=5.0, cold_ttfs_s=60.0, warm_ttfs_frac=0.35)
+    # fan-out spin-up + the trainers' FETCH-warm relaunch TTFS (ISSUE
+    # 13's 0.35x bound), not a full cold compile
+    assert cfg.actuation_latency_s() == pytest.approx(5.0 + 0.35 * 60.0)
+
+
+def test_hold_without_observation_and_short_window():
+    p = _policy(FakeClock())
+    d = p.decide(None, input_hosts=0)
+    assert d.action is PolicyAction.HOLD
+    assert "no goodput window" in d.reason
+    d = p.decide(_obs(data_wait=0.9, wall=0.5), input_hosts=0)
+    assert d.action is PolicyAction.HOLD
+    assert "too short" in d.reason
+
+
+def test_healthy_holds():
+    p = _policy(FakeClock())
+    d = p.decide(_obs(data_wait=0.1), input_hosts=0)
+    assert d.action is PolicyAction.HOLD
+    assert d.signal is GoodputSignal.HEALTHY
+
+
+def test_data_starved_grows_with_cost_model():
+    p = _policy(FakeClock())
+    d = p.decide(_obs(data_wait=0.6), input_hosts=0)
+    assert d.action is PolicyAction.GROW_INPUT_HOSTS
+    assert d.signal is GoodputSignal.DATA_STARVED
+    assert d.actuation_latency_s == pytest.approx(26.0)
+    # reclaimable share credited only above the shrink floor
+    assert d.projected_savings_s == pytest.approx((0.6 - 0.02) * 600.0)
+    assert d.projected_savings_s > d.actuation_latency_s
+
+
+def test_grow_blocked_when_savings_do_not_amortize():
+    # short horizon: 0.3 share * 60s = 18s savings < 26s actuation
+    p = _policy(FakeClock(), horizon_s=60.0)
+    d = p.decide(_obs(data_wait=0.3), input_hosts=0)
+    assert d.action is PolicyAction.HOLD
+    assert d.signal is GoodputSignal.DATA_STARVED
+    assert "does not amortize" in d.reason
+    assert d.projected_savings_s < d.actuation_latency_s
+
+
+def test_cooldown_gates_then_expires():
+    clock = FakeClock()
+    p = _policy(clock, cooldown_s=30.0)
+    assert p.decide(_obs(data_wait=0.6),
+                    input_hosts=0).action is PolicyAction.GROW_INPUT_HOSTS
+    clock.advance(5.0)  # another starved window mid-cooldown: HOLD
+    d = p.decide(_obs(data_wait=0.6), input_hosts=0)
+    assert d.action is PolicyAction.HOLD
+    assert "cooling down" in d.reason
+    clock.advance(30.0)  # cooldown expired: actuation allowed again
+    assert p.decide(_obs(data_wait=0.6),
+                    input_hosts=0).action is PolicyAction.GROW_INPUT_HOSTS
+
+
+def test_data_rich_shrinks():
+    p = _policy(FakeClock())
+    d = p.decide(_obs(data_wait=0.001), input_hosts=1)
+    assert d.action is PolicyAction.SHRINK_INPUT_HOSTS
+    assert d.signal is GoodputSignal.DATA_RICH
+    assert "idle freight" in d.reason
+    # no input plane up -> nothing to shrink; that's just healthy
+    p2 = _policy(FakeClock())
+    assert p2.decide(_obs(data_wait=0.001),
+                     input_hosts=0).signal is GoodputSignal.HEALTHY
+
+
+def test_chronic_starvation_flags_after_n_windows_at_ceiling():
+    p = _policy(FakeClock(), chronic_windows=3)
+    # starved WITH the input plane at its ceiling: evidence accumulates
+    for _ in range(2):
+        d = p.decide(_obs(data_wait=0.6), input_hosts=1)
+        assert d.action is PolicyAction.HOLD  # still accumulating
+    d = p.decide(_obs(data_wait=0.6), input_hosts=1)
+    assert d.action is PolicyAction.FLAG_STARVED
+    assert d.signal is GoodputSignal.CHRONIC_STARVATION
+    assert "reserved capacity" in d.reason
+    # a healthy window resets the chronic counter
+    p.decide(_obs(data_wait=0.05), input_hosts=1)
+    assert p.decide(_obs(data_wait=0.6),
+                    input_hosts=1).action is PolicyAction.HOLD
+
+
+def test_compile_bound_holds_on_purpose():
+    p = _policy(FakeClock())
+    d = p.decide(_obs(data_wait=0.05, compile_=0.7), input_hosts=0)
+    assert d.action is PolicyAction.HOLD
+    assert d.signal is GoodputSignal.COMPILE_BOUND
+
+
+def test_policy_from_name():
+    p = provision_policy_from_name("goodput", PolicyConfig(horizon_s=1.0))
+    assert isinstance(p, ProvisionPolicy)
+    assert p.config.horizon_s == 1.0
+    with pytest.raises(ValueError, match="unknown provision policy"):
+        provision_policy_from_name("nope")
+
+
+def test_decision_is_frozen_record():
+    d = PolicyDecision(PolicyAction.HOLD, GoodputSignal.HEALTHY, reason="x")
+    with pytest.raises(Exception):
+        d.action = PolicyAction.GROW_INPUT_HOSTS
+
+
+def test_fake_ledger_window_drives_grow(tmp_path):
+    """The coordinator's exact observation path: goodput JSONL on disk
+    -> fleet_window_observation -> FleetObservation -> decide."""
+    gp = tmp_path / "goodput"
+    gp.mkdir()
+    recs = [
+        {"kind": "window", "host": 0, "role": "trainer", "t": 100.0},
+        {"kind": "phase", "bucket": "data_wait", "dur_s": 6.0,
+         "host": 0, "t": 106.0},
+        {"kind": "phase", "bucket": "step", "dur_s": 4.0,
+         "host": 0, "t": 110.0},
+    ]
+    (gp / "goodput-host000.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in recs))
+    raw = fleet_window_observation(gp)
+    assert raw is not None
+    obs = FleetObservation(
+        wall_s=raw["wall_s"], goodput_ratio=raw["goodput_ratio"],
+        shares=raw["shares"], num_hosts=raw["num_hosts"])
+    assert obs.num_hosts == 1
+    assert obs.data_wait_share == pytest.approx(0.6, abs=0.05)
+    d = _policy(FakeClock()).decide(obs, input_hosts=0)
+    assert d.action is PolicyAction.GROW_INPUT_HOSTS
+    # ...and a since_t filter past the records yields no window at all
+    assert fleet_window_observation(gp, since_t=200.0) is None

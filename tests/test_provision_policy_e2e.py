@@ -1,0 +1,194 @@
+"""ISSUE 18 acceptance drill: the goodput-driven provisioner policy
+loop, end to end under the real launch fan-out.
+
+Two runs over the same shards, same seed:
+
+* **reference** — no policy, no input plane: trainer loads locally,
+  paying the synthetic decode serially with compute (the data-starved
+  shape).  Also the bit-identical ground truth.
+* **policy** — `tpucfn launch --provision-policy goodput`-shaped fleet:
+  one input host RESERVED but deferred, the coordinator running the
+  policy tick against the live goodput ledger.  The policy must observe
+  the ``data_wait`` share over threshold, emit a grow decision
+  (journaled + metered), drain the trainer to a step boundary, activate
+  the input plane, and relaunch — after which the measured ``data_wait``
+  share STRICTLY drops and the trajectory still equals the reference
+  bit for bit (the drain→resume consumed every batch exactly once).
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tpucfn.bootstrap import EnvContract
+from tpucfn.data import write_dataset_shards
+from tpucfn.ft import (
+    GangCoordinator,
+    GangRestart,
+    HeartbeatMonitor,
+    MonitorConfig,
+    RestartBudget,
+)
+from tpucfn.launch import Launcher, LocalTransport
+from tpucfn.provision import PolicyConfig, ProvisionPolicy
+
+pytestmark = pytest.mark.slow
+
+WORKER = Path(__file__).resolve().parent / "provision_e2e_worker.py"
+
+BATCH = 8
+SEED = 7
+EXAMPLES, SHARDS = 480, 4
+STEPS = EXAMPLES // BATCH  # 60
+
+
+def _write_shards(tmp_path) -> Path:
+    d = tmp_path / "shards"
+    d.mkdir()
+    rs = np.random.RandomState(2)
+    write_dataset_shards(
+        ({"x": rs.randn(512).astype(np.float32)} for _ in range(EXAMPLES)),
+        d, num_shards=SHARDS)
+    return d
+
+
+def _contract(tmp_path, n) -> EnvContract:
+    hostfile = tmp_path / f"hostfile{n}"
+    hostfile.write_text("".join("127.0.0.1:0\n" for _ in range(n)))
+    return EnvContract(
+        workers_path=str(hostfile), workers_count=n, worker_chip_count=1,
+        coordinator="127.0.0.1:1234", host_id=0, storage=str(tmp_path),
+        generation=1)
+
+
+def _worker_env(run_dir: Path, shards: Path) -> dict[str, str]:
+    return {
+        "PROV_E2E_RUN_DIR": str(run_dir),
+        "PROV_E2E_SHARDS": str(shards),
+        "PROV_E2E_BATCH": str(BATCH),
+        "PROV_E2E_SEED": str(SEED),
+        "PROV_E2E_STEP_SLEEP": "0.03",
+        "PROV_E2E_DECODE_SLEEP": "0.008",
+    }
+
+
+def _serve_argv(shards: Path) -> list[str]:
+    return [sys.executable, "-m", "tpucfn.cli", "data", "serve",
+            "--shards", str(shards), "--batch-size", str(BATCH),
+            "--seed", str(SEED), "--num-epochs", "1",
+            "--host", "127.0.0.1", "--idle-exit", "2.0"]
+
+
+def _run(tmp_path, shards, run_dir, *, policy: bool,
+         input_port: int) -> GangCoordinator:
+    run_dir.mkdir(parents=True, exist_ok=True)
+    n = 2 if policy else 1  # trainer (+ reserved input host)
+    ft_dir = run_dir / "ft"
+    launcher = Launcher(
+        _contract(tmp_path, n), LocalTransport(),
+        ft_dir=str(ft_dir), ft_heartbeat_s=0.2,
+        input_hosts=1 if policy else 0,
+        input_port=input_port,
+        input_argv=_serve_argv(shards) if policy else None,
+        defer_input_plane=policy,
+        extra_env=_worker_env(run_dir, shards))
+    monitor = HeartbeatMonitor(
+        ft_dir, expected_hosts=n,
+        config=MonitorConfig(interval_s=0.2, startup_grace_s=120.0))
+    provision_policy = None
+    if policy:
+        # Small windows + short actuation model so the loop closes in
+        # test time; LONG cooldown so the one grow is the only actuation
+        # (no post-grow shrink oscillation inside the run).
+        provision_policy = ProvisionPolicy(PolicyConfig(
+            grow_threshold=0.25, shrink_threshold=0.02,
+            min_window_s=0.4, cooldown_s=300.0,
+            spinup_s=0.1, cold_ttfs_s=1.0, horizon_s=600.0))
+    coord = GangCoordinator(
+        launcher, [sys.executable, str(WORKER)],
+        policy=GangRestart(RestartBudget(0)), monitor=monitor,
+        ft_dir=ft_dir, poll_interval=0.02, term_grace_s=2.0,
+        provision_policy=provision_policy,
+        goodput_dir=run_dir / "goodput" if policy else None,
+        provision_interval_s=0.4)
+    assert coord.run() == 0
+    return coord
+
+
+def _trajectory(run_dir: Path) -> list[str]:
+    p = run_dir / "losses-host000.jsonl"
+    lines = [ln for ln in p.read_text().splitlines() if ln.strip()]
+    assert len(lines) == STEPS, len(lines)
+    return lines
+
+
+def _events(run_dir: Path) -> list[dict]:
+    p = run_dir / "ft" / "events.jsonl"
+    return [json.loads(s) for s in p.read_text().splitlines() if s.strip()]
+
+
+def _phase_records(run_dir: Path) -> list[dict]:
+    recs = []
+    for p in sorted((run_dir / "goodput").glob("goodput-host*.jsonl")):
+        for ln in p.read_text().splitlines():
+            if not ln.strip():
+                continue
+            r = json.loads(ln)
+            if r.get("kind") == "phase":
+                recs.append(r)
+    return recs
+
+
+def _data_wait_share(recs: list[dict]) -> float:
+    tot = sum(r["dur_s"] for r in recs)
+    assert tot > 0
+    return sum(r["dur_s"] for r in recs
+               if r["bucket"] == "data_wait") / tot
+
+
+def test_provision_policy_grow_e2e(tmp_path):
+    shards = _write_shards(tmp_path)
+
+    # -- reference: no policy, local loading, the ground truth -----------
+    ref_dir = tmp_path / "ref"
+    _run(tmp_path, shards, ref_dir, policy=False, input_port=9370)
+    ref = _trajectory(ref_dir)
+    ref_share = _data_wait_share(_phase_records(ref_dir))
+    assert ref_share > 0.25, ref_share  # the workload IS starved
+
+    # -- policy: deferred input plane, goodput-driven grow ---------------
+    pol_dir = tmp_path / "policy"
+    coord = _run(tmp_path, shards, pol_dir, policy=True, input_port=9380)
+
+    # the decision was journaled and metered
+    events = _events(pol_dir)
+    decisions = [e for e in events if e["kind"] == "provision_decision"]
+    assert decisions and decisions[0]["action"] == "grow_input_hosts", \
+        decisions
+    assert decisions[0]["data_wait_share"] > 0.25, decisions[0]
+    actuated = [e for e in events if e["kind"] == "provision_actuated"]
+    assert actuated and actuated[0]["action"] == "grow_input_hosts", \
+        actuated
+    v = coord.registry.varz()["metrics"]
+    assert v["provision_grow_total"] == 1
+    assert v["provision_decisions_total"] == 1
+    # a PLANNED restart: the gang-restart budget is untouched
+    assert coord.policy.budget.used == 0
+
+    # after actuation the measured data_wait share strictly drops
+    t_grow = actuated[0]["ts"]
+    recs = _phase_records(pol_dir)
+    pre = [r for r in recs if r["t"] < t_grow]
+    post = [r for r in recs if r["t"] >= t_grow]
+    assert pre and post, (len(pre), len(post))
+    pre_share = _data_wait_share(pre)
+    post_share = _data_wait_share(post)
+    assert pre_share > 0.25, pre_share
+    assert post_share < pre_share, (post_share, pre_share)
+
+    # and the trajectory is bit-identical to the no-policy reference:
+    # the drain→resume consumed every batch exactly once
+    assert _trajectory(pol_dir) == ref

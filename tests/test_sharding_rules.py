@@ -101,3 +101,46 @@ def test_shard_batch_places_on_batch_axes(mesh8):
     # 4-way batch split (data=2 * fsdp=2): each device holds 4 rows.
     assert out["x"].addressable_shards[0].data.shape == (4, 4)
     assert isinstance(out["y"], jax.Array)
+
+
+def test_shard_batch_device_layout_pins_to_copy_path(mesh8):
+    """The zero-copy device-layout placement (ISSUE 18 satellite) must
+    be indistinguishable downstream from shard_batch: same sharding,
+    same per-device layout, bit-identical values."""
+    from tpucfn.parallel.sharding import shard_batch_device_layout
+
+    rs = np.random.RandomState(0)
+    batch = {"x": rs.randn(16, 4).astype(np.float32),
+             "y": rs.randint(0, 10, (16,)).astype(np.int32)}
+    ref = shard_batch(mesh8, batch)
+    out = shard_batch_device_layout(mesh8, batch)
+    for k in batch:
+        assert out[k].sharding == ref[k].sharding, k
+        assert out[k].shape == ref[k].shape, k
+        np.testing.assert_array_equal(np.asarray(out[k]),
+                                      np.asarray(ref[k]))
+        # per-device placement identical, shard for shard
+        for a, b in zip(out[k].addressable_shards,
+                        ref[k].addressable_shards):
+            assert a.device == b.device
+            np.testing.assert_array_equal(np.asarray(a.data),
+                                          np.asarray(b.data))
+
+
+def test_prefetch_to_mesh_device_sharded_flag(mesh_dp8, monkeypatch):
+    """prefetch_to_mesh under TPUCFN_INPUT_DEVICE_SHARDED=1 yields the
+    same arrays the default path does (the flag is a layout opt-in,
+    never a semantic change); default-off keeps the plain path."""
+    from tpucfn.data.pipeline import prefetch_to_mesh
+
+    rs = np.random.RandomState(1)
+    host_batches = [{"x": rs.randn(8, 4).astype(np.float32)}
+                    for _ in range(3)]
+    plain = list(prefetch_to_mesh(iter(host_batches), mesh_dp8))
+    monkeypatch.setenv("TPUCFN_INPUT_DEVICE_SHARDED", "1")
+    layout = list(prefetch_to_mesh(iter(host_batches), mesh_dp8))
+    assert len(plain) == len(layout) == 3
+    for p, q in zip(plain, layout):
+        assert q["x"].sharding == p["x"].sharding
+        np.testing.assert_array_equal(np.asarray(q["x"]),
+                                      np.asarray(p["x"]))

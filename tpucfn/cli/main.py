@@ -174,6 +174,24 @@ def cmd_launch(args) -> int:
         import shlex
 
         input_argv = shlex.split(args.input_cmd)
+    # Provisioner policy loop (ISSUE 18): all usage validation first —
+    # the controller observes the goodput ledgers and actuates through
+    # the coordinator, so both planes must exist.
+    if args.provision_policy and not args.ft:
+        print("error: --provision-policy needs --ft (the controller "
+              "actuates through the gang coordinator's planned-restart "
+              "machinery)", file=sys.stderr)
+        return 2
+    if args.provision_policy and not args.input_hosts:
+        print("error: --provision-policy needs --input-hosts N (growing "
+              "the input plane is the one actuator it owns; with no "
+              "input hosts there is nothing to provision)", file=sys.stderr)
+        return 2
+    if args.defer_input_plane and not args.input_hosts:
+        print("error: --defer-input-plane needs --input-hosts N (it "
+              "reserves those hosts for the provisioner instead of "
+              "spawning them at launch)", file=sys.stderr)
+        return 2
     # All usage validation happens BEFORE any server binds: an error
     # early-return below must not leak a bound artifact-server port
     # (its close() lives in the later try/finally).
@@ -298,7 +316,15 @@ def cmd_launch(args) -> int:
                         input_hosts=args.input_hosts,
                         input_port=args.input_port or None,
                         input_argv=input_argv,
-                        compile_cache_addrs=cc_addrs)
+                        # Local fleets run every host on loopback but the
+                        # fake control plane's hostfile says 10.0.0.x —
+                        # advertising those would make every trainer burn
+                        # the connect-retry window and degrade to local.
+                        input_advertise_host=("127.0.0.1"
+                                              if args.transport != "ssh"
+                                              else None),
+                        compile_cache_addrs=cc_addrs,
+                        defer_input_plane=args.defer_input_plane)
     from tpucfn.launch import run_with_restarts
 
     obs_srv = None
@@ -399,6 +425,26 @@ def cmd_launch(args) -> int:
                     _reacquire_cache["t"] = now
                 return addr in _reacquire_cache["healthy"]
 
+            provision_policy = None
+            goodput_dir = None
+            if args.provision_policy:
+                from tpucfn.provision import (PolicyConfig,
+                                              provision_policy_from_name)
+
+                # Must be the SAME dir the trainers' GoodputLedger
+                # writes into (examples/common.py: run_dir/goodput) —
+                # the controller reads what the fleet reports.
+                goodput_dir = (Path(args.provision_goodput_dir)
+                               if args.provision_goodput_dir
+                               else _run_dir(args, args.name) / "goodput")
+                provision_policy = provision_policy_from_name(
+                    args.provision_policy,
+                    PolicyConfig(
+                        grow_threshold=args.provision_grow_threshold,
+                        shrink_threshold=args.provision_shrink_threshold,
+                        cooldown_s=args.provision_cooldown,
+                        max_input_hosts=args.input_hosts))
+
             coordinator = GangCoordinator(
                 launcher, argv,
                 policy=policy_from_name(args.ft_policy, budget),
@@ -416,7 +462,10 @@ def cmd_launch(args) -> int:
                 adopt=(True if args.adopt
                        else False if args.no_adopt else "auto"),
                 chaos=chaos_spec,
-                net_proxies=net_proxies)
+                net_proxies=net_proxies,
+                provision_policy=provision_policy,
+                goodput_dir=goodput_dir,
+                provision_interval_s=args.provision_interval)
             coord_ref["coord"] = coordinator
             rc = coordinator.run()
         else:
@@ -1857,6 +1906,38 @@ def build_parser() -> argparse.ArgumentParser:
                         "the coordinator host — correct when tpucfn "
                         "launch runs ON host 0; set this when launching "
                         "from elsewhere, the server runs in THIS process)")
+    l.add_argument("--provision-policy", choices=["goodput"],
+                   help="goodput-driven provisioner loop (needs --ft and "
+                        "--input-hosts): the coordinator reads the fleet "
+                        "goodput ledgers each interval and actuates — "
+                        "data_wait share over threshold grows the input "
+                        "plane (planned drain-relaunch), chronic "
+                        "starvation at ceiling is flagged, a starved-"
+                        "free fleet shrinks it back")
+    l.add_argument("--provision-interval", type=float, default=5.0,
+                   metavar="SECONDS",
+                   help="how often the provisioner policy observes the "
+                        "goodput ledgers")
+    l.add_argument("--provision-grow-threshold", type=float, default=0.25,
+                   metavar="SHARE",
+                   help="data_wait share of wall above which the policy "
+                        "grows the input plane")
+    l.add_argument("--provision-shrink-threshold", type=float, default=0.02,
+                   metavar="SHARE",
+                   help="data_wait share below which a served fleet "
+                        "releases its input hosts")
+    l.add_argument("--provision-cooldown", type=float, default=30.0,
+                   metavar="SECONDS",
+                   help="minimum time between provisioner actuations")
+    l.add_argument("--provision-goodput-dir", metavar="DIR",
+                   help="where the fleet's goodput ledgers land (must "
+                        "match the trainers' run dir goodput/; default: "
+                        "the cluster state dir goodput/)")
+    l.add_argument("--defer-input-plane", action="store_true",
+                   help="reserve the --input-hosts slots instead of "
+                        "spawning them at launch: trainers start on "
+                        "local loading and the provisioner activates the "
+                        "input plane when goodput says it pays")
     l.add_argument("--chaos", metavar="SPEC",
                    help="deterministic fault injection (needs --ft): a "
                         "ChaosSpec JSON file (or inline JSON) replayed "

@@ -11,6 +11,7 @@ keeping the TPU fed from host memory without a host↔device sync bubble
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from pathlib import Path
@@ -425,16 +426,27 @@ def prefetch_to_mesh(
 
     A daemon thread stays ``depth`` global batches ahead; the consumer
     always finds its next batch already resident on the mesh.
-    """
-    from tpucfn.parallel.sharding import shard_batch
 
+    ``TPUCFN_INPUT_DEVICE_SHARDED=1`` opts into the device-layout
+    placement (ISSUE 18 satellite): served rows go to their devices as
+    numpy views, skipping the trainer-side staging copy.  Default off —
+    the plain path is byte-identical to before the flag existed.
+    """
+    from tpucfn.parallel.sharding import (
+        shard_batch,
+        shard_batch_device_layout,
+    )
+
+    place = (shard_batch_device_layout
+             if os.environ.get("TPUCFN_INPUT_DEVICE_SHARDED") == "1"
+             else shard_batch)
     q: queue.Queue = queue.Queue(maxsize=depth)
     _END = object()
 
     def producer():
         try:
             for host_batch in it:
-                q.put(shard_batch(mesh, host_batch, extra_axes))
+                q.put(place(mesh, host_batch, extra_axes))
         except Exception as e:  # surface pipeline errors to the consumer
             q.put(e)
             return

@@ -166,6 +166,9 @@ class GangCoordinator(ChaosTarget):
         adopt_spawn_grace_s: float = ADOPT_SPAWN_GRACE_S,
         net_proxies: Sequence | None = None,
         journal_compact_records: int = 4096,
+        provision_policy=None,
+        goodput_dir: str | Path | None = None,
+        provision_interval_s: float = 5.0,
     ):
         """Graceful-degradation knobs (ISSUE 7): ``drain_grace_s`` caps
         how long a preemption drain waits for clean exits when the
@@ -213,6 +216,27 @@ class GangCoordinator(ChaosTarget):
         self.restart_input_hosts = restart_input_hosts
         self.max_input_restarts = max_input_restarts
         self._input_restarts: dict[int, int] = {}
+        # Provisioner policy loop (ISSUE 18): a ProvisionPolicy
+        # (tpucfn.provision.policy) observing the fleet's goodput
+        # ledgers and actuating topology through existing primitives —
+        # grow = activate the launcher's deferred input plane via a
+        # planned drain-relaunch, shrink = stop input hosts (trainers
+        # degrade to local at the exact batch cursor), chronic
+        # starvation = flag only.  Validation at construction, same as
+        # the chaos/net checks below: a policy with no ledger to read
+        # would silently never decide anything.
+        self.provision_policy = provision_policy
+        self.goodput_dir = Path(goodput_dir) if goodput_dir is not None \
+            else None
+        self.provision_interval_s = float(provision_interval_s)
+        self._next_provision = 0.0
+        self._provision_since_t: float | None = None
+        self._provision_flagged = False
+        if provision_policy is not None and self.goodput_dir is None:
+            raise ValueError(
+                "provision_policy needs goodput_dir — the policy reads "
+                "the fleet goodput ledgers (GoodputLedger files) to "
+                "classify the run; without them it can never decide")
         # Crash-safety (ISSUE 12): a write-ahead journal under
         # <ft_dir>/journal/ records every state transition BEFORE the
         # action runs; a restarted coordinator replays it and ADOPTS
@@ -312,6 +336,31 @@ class GangCoordinator(ChaosTarget):
         self.coord_pending_g = r.gauge(
             "coordinator_pending_intent",
             "1 while a journaled restart intent awaits its commit")
+        # Provisioner policy surface (ISSUE 18)
+        self.provision_decisions_c = r.counter(
+            "provision_decisions_total",
+            "provisioner decisions acted on (grow/shrink/flag)")
+        self.provision_grow_c = r.counter(
+            "provision_grow_total",
+            "input-plane grow actuations (deferred hosts activated)")
+        self.provision_shrink_c = r.counter(
+            "provision_shrink_total",
+            "input-plane shrink actuations (input hosts released)")
+        self.provision_flagged_g = r.gauge(
+            "provision_flagged",
+            "1 while the fleet is flagged chronically starved")
+        self.provision_data_wait_share_g = r.gauge(
+            "provision_data_wait_share",
+            "fleet data_wait share in the last policy window")
+        self.provision_goodput_ratio_g = r.gauge(
+            "provision_goodput_ratio",
+            "fleet step share (goodput) in the last policy window")
+        self.provision_actuation_s = r.summary(
+            "provision_actuation_seconds",
+            "decision → actuated latency of provisioner actuations")
+        self.provision_input_hosts_g = r.gauge(
+            "provision_input_hosts",
+            "input hosts currently active (reserved-but-deferred excluded)")
 
         hosts = self.launcher.contract.hosts()[
             : self.launcher.contract.workers_count]
@@ -606,10 +655,18 @@ class GangCoordinator(ChaosTarget):
         # would otherwise leave ranks NO journal record and an adoption
         # would relaunch over them.  The `launching` record makes the
         # window visible; adoption gives those hosts a heartbeat grace.
-        self._j("launching", hosts=list(self.host_ids), first=first)
+        # Deferred input hosts (ISSUE 18) are reserved in the topology
+        # but not spawned until the provisioner activates them — every
+        # bookkeeping structure below must cover LAUNCHED hosts only, or
+        # the monitor would condemn (and adoption would mourn) ranks
+        # that were never supposed to exist yet.
+        deferred = set(getattr(self.launcher, "deferred_input_host_ids",
+                               ()) or ())
+        launched = [h for h in self.host_ids if h not in deferred]
+        self._j("launching", hosts=launched, first=first)
         crash_point("during_spawn", self.ft_dir)
         procs = self.launcher.launch(self.argv, kill_host_after=inject)
-        self._procs = dict(zip(self.host_ids, procs))
+        self._procs = dict(zip(launched, procs))
         # pids AND their kernel start times: the (pid, starttime) pair
         # is the identity adoption trusts across a machine reboot — a
         # recycled pid alone would adopt (and later kill) a stranger.
@@ -625,10 +682,12 @@ class GangCoordinator(ChaosTarget):
         self.hosts_g.set(len(procs))
         if self.monitor is not None:
             self.monitor.restart_grace()
-            for h in self.host_ids:
+            for h in launched:
                 self.monitor.activate_host(h)
             blind = self.clock() + self.monitor.config.grace_s
-            self._blind_until = {h: blind for h in self.host_ids}
+            self._blind_until = {h: blind for h in launched}
+        self.provision_input_hosts_g.set(
+            sum(1 for h in self.input_host_ids if h not in deferred))
         self._event("launch", first=first, hosts=len(procs),
                     pids=[p.pid for p in procs])
 
@@ -801,6 +860,7 @@ class GangCoordinator(ChaosTarget):
                         self._j("done", rc=rc)
                         self._event("done", rc=rc)
                         return rc
+                    self._provision_tick(now)
                     continue
                 rc = self._handle_incident(failures)
                 if rc is not None:
@@ -928,7 +988,14 @@ class GangCoordinator(ChaosTarget):
                     break
                 self.sleep(0.1)
                 beats = read_heartbeats(self.ft_dir)
+        deferred = set(getattr(self.launcher, "deferred_input_host_ids",
+                               ()) or ())
         for host in self.host_ids:
+            if host in deferred and host not in st.procs:
+                # Reserved-but-never-activated input host (ISSUE 18):
+                # no incarnation ever existed; mourning it as a crash
+                # would degrade an input plane that was never up.
+                continue
             if host in self._finished:
                 if self.monitor is not None:
                     self.monitor.retire_host(host)
@@ -1070,6 +1137,12 @@ class GangCoordinator(ChaosTarget):
                 self._stop_hosts(list(self._procs))
                 if self.ft_dir is not None:
                     clear_drain(self.ft_dir)
+                if action == "provision_grow":
+                    # The predecessor died between its grow intent and
+                    # the relaunch: the activation must still happen or
+                    # the completed relaunch would re-defer the input
+                    # plane the decision already paid for.
+                    self.launcher.activate_input_plane()
                 self._launch_gang(first=False)
                 if action == Action.DRAIN_RESTART.value:
                     self.ft_preempt_drains_c.add()
@@ -1156,6 +1229,163 @@ class GangCoordinator(ChaosTarget):
                 self.monitor.retire_host(h)
             self._event("host_exit", host=h, rc=0,
                         note="input host stopped after trainers finished")
+
+    # -- provisioner policy loop (ISSUE 18) --------------------------------
+
+    def _provision_tick(self, now: float) -> None:
+        """One observe→decide→actuate cycle of the provisioner policy,
+        throttled to ``provision_interval_s`` and run only from the
+        no-failure branch of the supervision loop (an incident in
+        flight owns the fleet; resizing under it would race the
+        restart).
+
+        The observation window is filtered by wall-clock ``t`` (the
+        clock ledger records carry) from the last actuation forward —
+        NOT this coordinator's injectable monotonic clock — so a grow
+        is judged by post-grow evidence only."""
+        if self.provision_policy is None or self.goodput_dir is None:
+            return
+        if now < self._next_provision:
+            return
+        self._next_provision = now + self.provision_interval_s
+        from tpucfn.obs.goodput import fleet_window_observation
+        from tpucfn.provision.policy import FleetObservation, PolicyAction
+
+        raw = fleet_window_observation(self.goodput_dir,
+                                       since_t=self._provision_since_t)
+        obs = None
+        if raw is not None:
+            obs = FleetObservation(
+                wall_s=raw["wall_s"], goodput_ratio=raw["goodput_ratio"],
+                shares=raw["shares"], num_hosts=raw["num_hosts"])
+            self.provision_data_wait_share_g.set(
+                round(obs.data_wait_share, 6))
+            self.provision_goodput_ratio_g.set(
+                round(obs.goodput_ratio, 6))
+        deferred = set(getattr(self.launcher, "deferred_input_host_ids",
+                               ()) or ())
+        active_inputs = sum(1 for h in self.input_host_ids
+                            if h not in deferred)
+        self.provision_input_hosts_g.set(active_inputs)
+        decision = self.provision_policy.decide(
+            obs, input_hosts=active_inputs, now=now)
+        if decision.action is PolicyAction.HOLD:
+            return
+        self.provision_decisions_c.add()
+        self._j("provision_decision", action=decision.action.value,
+                signal=decision.signal.value,
+                data_wait_share=round(decision.data_wait_share, 6))
+        self._event("provision_decision", action=decision.action.value,
+                    signal=decision.signal.value, reason=decision.reason,
+                    data_wait_share=round(decision.data_wait_share, 6),
+                    goodput_ratio=round(decision.goodput_ratio, 6),
+                    input_hosts=active_inputs)
+        if decision.action is PolicyAction.GROW_INPUT_HOSTS:
+            self._provision_grow(decision, sorted(deferred))
+        elif decision.action is PolicyAction.SHRINK_INPUT_HOSTS:
+            self._provision_shrink(decision)
+        elif decision.action is PolicyAction.FLAG_STARVED:
+            self.provision_flagged_g.set(1)
+            if not self._provision_flagged:
+                # one event per chronic episode; the gauge stays up
+                self._provision_flagged = True
+                self._event(
+                    "provision_flagged", reason=decision.reason,
+                    data_wait_share=round(decision.data_wait_share, 6))
+
+    def _provision_grow(self, decision, deferred: list[int]) -> None:
+        """Actuate a grow decision: drain the trainers to one step
+        boundary (the force-save lands there; the relaunch re-executes
+        nothing), activate the launcher's reserved input plane, and
+        relaunch the gang — trainers now see TPUCFN_INPUT_ADDRS and
+        stream served batches.  A PLANNED restart: zero budget, and the
+        latency is the real-world measurement of the policy's
+        actuation-latency model (fetch-warm relaunch, ISSUE 13)."""
+        if not deferred:
+            return  # nothing reserved to activate
+        t0 = self.clock()
+        self._incident += 1
+        incident = self._incident
+        self._j("restart_intent", incident=incident,
+                action="provision_grow", hosts=[],
+                budget_used=self.policy.budget.used, planned=True)
+        self.coord_pending_g.set(1)
+        crash_point("after_intent", self.ft_dir)
+        target = None
+        if self._last_fleet_step is not None:
+            target = self._last_fleet_step + self.drain_step_margin
+        drain_file = None
+        if self.ft_dir is not None:
+            drain_file = request_drain(self.ft_dir, step=target)
+            self._j("drain_armed", incident=incident, step=target)
+        self._event("drain", incident=incident, hosts=deferred,
+                    step=target, grace_s=round(self.drain_grace_s, 3),
+                    file=None if drain_file is None else str(drain_file))
+        if drain_file is not None:
+            deadline = self.clock() + self.drain_grace_s
+            while (any(p.poll() is None for p in self._procs.values())
+                   and self.clock() < deadline):
+                self.sleep(self.poll_interval)
+        leftovers = [p for p in self._procs.values() if p.poll() is None]
+        if leftovers:
+            self.launcher.stop_all(leftovers, grace_s=self.term_grace_s,
+                                   poll_interval=self.poll_interval)
+        self._procs.clear()
+        if self.ft_dir is not None:
+            clear_drain(self.ft_dir)
+        self.launcher.activate_input_plane()
+        self._launch_gang(first=False)
+        crash_point("before_commit", self.ft_dir)
+        self._j("restart_commit", incident=incident,
+                action="provision_grow")
+        self.coord_pending_g.set(0)
+        latency = self.clock() - t0
+        self.provision_grow_c.add()
+        self.ft_planned_restarts_c.add()
+        self.ft_planned_mttr_s.observe(latency)
+        self.provision_actuation_s.observe(latency)
+        # Judge the grow by post-grow evidence only.
+        self._provision_since_t = time.time()
+        self._event("provision_actuated", incident=incident,
+                    action="grow_input_hosts", hosts=deferred,
+                    latency_s=round(latency, 4),
+                    model_latency_s=round(decision.actuation_latency_s, 4))
+        self._event("recovered", incident=incident,
+                    action="provision_grow", planned=True,
+                    mttr_s=round(latency, 4))
+        self._event("goodput_incident", incident=incident,
+                    action="provision_grow", planned=True,
+                    downtime_s=round(latency, 4),
+                    detection_s=round(self.provision_interval_s, 4),
+                    fleet_step=self._last_fleet_step)
+
+    def _provision_shrink(self, decision) -> None:
+        """Actuate a shrink decision: stop the live input hosts.  No
+        trainer restart — the resilient service streams (ISSUE 11)
+        degrade to local loading at the exact batch cursor, so the
+        trajectory is untouched; only the input topology changes.  The
+        hosts go back to reserved-but-deferred, so a later starvation
+        verdict can grow them again."""
+        live = sorted(h for h in self._procs if h in self.input_host_ids)
+        if not live:
+            return
+        t0 = self.clock()
+        self._j("provision_shrink", hosts=live)
+        self._stop_hosts(live)
+        for h in live:
+            self._j("host_exit", host=h, rc=0)
+            self._finished.setdefault(h, 0)
+            if self.monitor is not None:
+                self.monitor.retire_host(h)
+        if hasattr(self.launcher, "defer_input_plane"):
+            self.launcher.defer_input_plane = True
+        latency = self.clock() - t0
+        self.provision_shrink_c.add()
+        self.provision_actuation_s.observe(latency)
+        self.provision_input_hosts_g.set(0)
+        self._provision_since_t = time.time()
+        self._event("provision_actuated", action="shrink_input_hosts",
+                    hosts=live, latency_s=round(latency, 4))
 
     def _handle_incident(self, failures: list[Failure]) -> int | None:
         """One detect→decide→act→recovered cycle; returns the run's exit

@@ -56,6 +56,14 @@ EVENT_KINDS = (
     # network fault injection (ISSUE 15): a net_* chaos op landed on
     # the registered ChaosProxy instances
     "chaos_net_fault",
+    # provisioner policy loop (ISSUE 18): the goodput-driven controller
+    # decided (signal + action from the decision table), actuated
+    # (grow = planned drain-relaunch with the input plane activated,
+    # shrink = input hosts released), or flagged chronic starvation
+    # (observation-only — the operator owns accelerator topology)
+    "provision_decision",
+    "provision_actuated",
+    "provision_flagged",
 )
 
 

@@ -66,6 +66,8 @@ JOURNAL_KINDS = (
     "ckpt_retry",       # corruption retry: bad_step, blacklist
     "input_degraded",   # input host left the table (no incident)
     "input_restarted",  # input host solo-relaunched: host, restarts
+    "provision_decision",  # policy verdict on a goodput window (ISSUE 18)
+    "provision_shrink",    # input hosts stopped back to reserved
     "straggler_probation",  # guard fired for a host (eviction inbound)
     "chaos_fired",      # a scripted chaos event fired: index into the spec
     "adopted",          # a restarted coordinator attached to this journal

@@ -101,6 +101,23 @@ class Launcher:
     input_hosts: int = 0
     input_port: int | None = None
     input_argv: list[str] | None = dataclasses.field(default=None)
+    # TPUCFN_INPUT_ADDRS advertises the hostfile addresses by default.
+    # Those are only dialable when the fleet really runs on them — a
+    # LocalTransport fleet runs every "host" on loopback while the fake
+    # control plane hands out synthetic 10.0.0.x addresses, so trainers
+    # would burn the full connect-retry window and silently degrade to
+    # local loading (same failure class as --compile-cache-advertise,
+    # ISSUE 13).  Set to the host trainers should dial instead.
+    input_advertise_host: str | None = None
+    # Provisioner policy loop (ISSUE 18): reserve the input hosts in the
+    # topology but do NOT spawn or advertise them yet.  Trainers still
+    # see TPUCFN_ROLE/TPUCFN_WORKERS_COUNT (the per-trainer shard split
+    # must be identical before and after activation — that is what keeps
+    # the trajectory bit-identical across a policy-driven grow), but
+    # TPUCFN_INPUT_ADDRS stays absent so service_or_local_batches keeps
+    # loading locally.  activate_input_plane() flips the switch; the
+    # next (re)launch spawns the input hosts with the full served env.
+    defer_input_plane: bool = False
     # Fleet warm start (ISSUE 13): every host learns where the compiled-
     # artifact servers are (TPUCFN_COMPILE_CACHE_ADDRS, same pattern as
     # TPUCFN_INPUT_ADDRS) — trainers/serve replicas consult them before
@@ -121,6 +138,17 @@ class Launcher:
     @property
     def input_host_ids(self) -> list[int]:
         return list(range(self.trainer_count, self.contract.workers_count))
+
+    @property
+    def deferred_input_host_ids(self) -> list[int]:
+        """Input hosts reserved but not yet activated (ISSUE 18)."""
+        return self.input_host_ids if self.defer_input_plane else []
+
+    def activate_input_plane(self) -> None:
+        """Provisioner actuation: the next (re)launch spawns the
+        reserved input hosts and fans TPUCFN_INPUT_ADDRS out to the
+        trainers.  Idempotent; a no-op when nothing was deferred."""
+        self.defer_input_plane = False
 
     def _input_base_port(self) -> int:
         if self.input_port is not None:
@@ -150,11 +178,13 @@ class Launcher:
             # the rendezvous (and every per-trainer shard split) is over
             # trainer ranks only
             env["TPUCFN_WORKERS_COUNT"] = str(self.trainer_count)
-            env["TPUCFN_INPUT_ADDRS"] = ",".join(
-                f"{hosts[h].rsplit(':', 1)[0]}:{base + h}"
-                for h in self.input_host_ids)
-            if host_id in self.input_host_ids:
-                env["TPUCFN_INPUT_PORT"] = str(base + host_id)
+            if not self.defer_input_plane:
+                env["TPUCFN_INPUT_ADDRS"] = ",".join(
+                    f"{self.input_advertise_host or hosts[h].rsplit(':', 1)[0]}"
+                    f":{base + h}"
+                    for h in self.input_host_ids)
+                if host_id in self.input_host_ids:
+                    env["TPUCFN_INPUT_PORT"] = str(base + host_id)
         if self.compile_cache_addrs:
             from tpucfn.compilecache.service import COMPILE_CACHE_ADDRS_ENV
 
@@ -195,8 +225,11 @@ class Launcher:
                 f"kill_host_after host_id {kill_host_after[0]} out of range "
                 f"for {len(hosts)} launched hosts"
             )
+        deferred = set(self.deferred_input_host_ids)
         procs = []
         for host_id, host in enumerate(hosts):
+            if host_id in deferred:
+                continue  # reserved for the provisioner; not spawned yet
             procs.append(self.transport.run(
                 host, self._argv_for_host(argv, host_id),
                 self.host_env(host_id)))

@@ -603,6 +603,70 @@ def goodput_report(goodput_dir: str | Path,
     return merge_goodput(by_host, events, skipped_lines=skipped)
 
 
+def fleet_window_observation(goodput_dir: str | Path, *,
+                             since_t: float | None = None) -> dict | None:
+    """Live windowed view of the fleet ledgers for the provisioner
+    policy loop (ISSUE 18): bucket *shares* of wall since ``since_t``
+    (wall clock, the same clock ledger records carry in ``t``).
+
+    Unlike :func:`merge_goodput` — the end-of-run postmortem — this is
+    read mid-run, repeatedly, over ledgers still being appended to, and
+    the caller cares about the RECENT window only: a policy must not
+    keep acting on starvation that an earlier actuation already fixed.
+    Filtering by ``t`` (not by incarnation) is what makes "the window
+    since my last actuation" expressible.
+
+    Per host: phase records with finite ``t >= since_t``; the host wall
+    is the ``t``-span of its in-window records; ``idle`` is the
+    residual.  Shares are averaged across hosts (the same merge rule as
+    :func:`merge_goodput`).  Returns ``None`` when no host has a
+    usable window (empty dir, all records filtered, zero wall) — the
+    policy treats that as "no evidence", never as "healthy".
+    """
+    by_host, _ = read_goodput_dir(goodput_dir)
+    per_host: list[dict] = []
+    for records in by_host.values():
+        lo = hi = None
+        buckets = {b: 0.0 for b in RECORDED_BUCKETS}
+        for rec in records:
+            t = rec.get("t")
+            if not isinstance(t, (int, float)) or not math.isfinite(t):
+                continue
+            if since_t is not None and t < since_t:
+                continue
+            lo = t if lo is None else min(lo, t)
+            hi = t if hi is None else max(hi, t)
+            if rec.get("kind") != "phase":
+                continue
+            dur = rec.get("dur_s")
+            bucket = rec.get("bucket")
+            if (isinstance(dur, (int, float)) and math.isfinite(dur)
+                    and dur >= 0 and bucket in buckets):
+                buckets[bucket] += dur
+        if lo is None or hi is None:
+            continue
+        wall = hi - lo
+        if wall <= 0:
+            continue
+        shares = {b: min(1.0, v / wall) for b, v in buckets.items()}
+        shares["idle"] = max(0.0, 1.0 - sum(shares.values()))
+        per_host.append({"wall_s": wall, "shares": shares})
+    if not per_host:
+        return None
+    n = len(per_host)
+    share_names = set()
+    for h in per_host:
+        share_names.update(h["shares"])
+    shares = {b: sum(h["shares"].get(b, 0.0) for h in per_host) / n
+              for b in sorted(share_names)}
+    return {
+        "wall_s": sum(h["wall_s"] for h in per_host) / n,
+        "shares": shares,
+        "goodput_ratio": shares.get("step", 0.0),
+        "num_hosts": n,
+    }
+
+
 def append_goodput_ledger(path: str | Path, report: dict, *,
                           run_dir: str = "", extra: dict | None = None
                           ) -> Path:

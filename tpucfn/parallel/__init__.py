@@ -6,6 +6,7 @@ from tpucfn.parallel.sharding import (  # noqa: F401
     named_sharding_tree,
     partition_spec_tree,
     shard_batch,
+    shard_batch_device_layout,
 )
 from tpucfn.parallel.presets import (  # noqa: F401
     PRESETS,

@@ -5,6 +5,16 @@ from tpucfn.provision.control_plane import (  # noqa: F401
     HostRecord,
     ClusterRecord,
 )
+from tpucfn.provision.policy import (  # noqa: F401
+    PROVISION_DECISION_TABLE,
+    FleetObservation,
+    GoodputSignal,
+    PolicyAction,
+    PolicyConfig,
+    PolicyDecision,
+    ProvisionPolicy,
+    provision_policy_from_name,
+)
 from tpucfn.provision.provisioner import Provisioner  # noqa: F401
 from tpucfn.provision.gcp import (  # noqa: F401
     AuthError,
