@@ -6,7 +6,7 @@
 # `set -o pipefail` in the tier1 recipe needs bash, not POSIX sh.
 SHELL := /bin/bash
 
-.PHONY: check tier1 verify bench-smoke
+.PHONY: check tier1 verify bench-smoke bench-rl
 
 # Static analysis over the files changed vs origin/main (the whole
 # package is still parsed, so cross-module rules keep context).  Falls
@@ -36,3 +36,10 @@ verify: check tier1
 bench-smoke:
 	timeout -k 10 600 env JAX_PLATFORMS=cpu \
 		python benches/flagship_bench.py --quick
+
+# Podracer RL plane (ISSUE 19): co-located act->learn->refresh vs the
+# host-roundtrip reference on the same mesh — rc-gated on the
+# co-location ratio and the d2d refresh latency budget.  CPU-only, ~30s.
+bench-rl:
+	timeout -k 10 300 env JAX_PLATFORMS=cpu \
+		python benches/rl_bench.py --quick

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Anakin-style RL on one mesh (the Podracer layout, arXiv:2104.06272).
+
+The tpucfn RL plane end-to-end in one script: jitted actors roll out a
+pure-jax vectorized env (bandit or gridworld) on the SAME mesh as the
+Trainer-backed A2C learner, trajectory slabs flow through the on-device
+replay queue, and the actors pick up new params as a device-to-device
+copy every iteration.  Checkpoints snapshot the whole stack (learner
+state + env state + queue ring + iteration), so an interrupted run —
+``--stop-after``, a preemption drain, or a chaos kill under ``tpucfn
+launch`` — resumes bit-identically.
+
+Flags mirror the training examples (``--steps`` is the iteration
+budget); the same loop also ships as ``tpucfn rl train``.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--run-dir", default="/tmp/tpucfn-rl",
+                   help="checkpoints and per-iteration rows land here")
+    p.add_argument("--steps", type=int, default=100,
+                   help="act→learn→refresh iterations to run")
+    p.add_argument("--env", choices=["bandit", "gridworld"],
+                   default="bandit")
+    p.add_argument("--num-envs", type=int, default=8,
+                   help="vectorized env copies = learner batch size")
+    p.add_argument("--unroll", type=int, default=16,
+                   help="env steps per jitted rollout")
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--lr", type=float, default=1e-2)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--ckpt-every", type=int, default=25)
+    p.add_argument("--stop-after", type=int, default=0,
+                   help="simulated interruption: halt at iteration N "
+                        "without changing the budget; rerunning resumes")
+    p.add_argument("--fresh", action="store_true",
+                   help="ignore existing checkpoints in --run-dir")
+    args = p.parse_args()
+
+    from tpucfn.launch import initialize_runtime
+
+    initialize_runtime()
+
+    from tpucfn.rl.loop import RLConfig, run_rl_loop
+
+    run_rl_loop(RLConfig(
+        run_dir=args.run_dir, env=args.env, num_envs=args.num_envs,
+        unroll=args.unroll, iters=args.steps, hidden=args.hidden,
+        lr=args.lr, seed=args.seed, ckpt_every=args.ckpt_every,
+        log_every=args.log_every, stop_after=args.stop_after,
+        fresh=args.fresh))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

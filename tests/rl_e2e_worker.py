@@ -1,0 +1,46 @@
+"""One rank of the RL chaos drill: the REAL Podracer loop, not a toy.
+
+``test_rl_e2e.py`` fans this out through the Launcher/GangCoordinator
+exactly like a production ``tpucfn launch -- tpucfn rl train`` gang.
+Everything that matters — heartbeats, checkpoint save/restore, the
+``rl_run_start``/``rl_resumed`` events, goodput ledger rows, the
+per-iteration JSONL trajectory — comes from ``run_rl_loop`` itself;
+this file only pins the CPU platform (each rank runs its own
+8-fake-device jax runtime) and maps the drill's env vars onto RLConfig.
+"""
+
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# Pin platform BEFORE jax initializes: the drill's ranks must ignore any
+# site-installed accelerator plugin and present 8 fake CPU devices.
+os.environ["JAX_PLATFORMS"] = "cpu"
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from tpucfn.rl.loop import RLConfig, run_rl_loop  # noqa: E402
+
+
+def main() -> int:
+    run_rl_loop(RLConfig(
+        run_dir=os.environ["RL_E2E_RUN_DIR"],
+        env=os.environ.get("RL_E2E_ENV", "gridworld"),
+        unroll=int(os.environ.get("RL_E2E_UNROLL", "8")),
+        iters=int(os.environ["RL_E2E_ITERS"]),
+        ckpt_every=int(os.environ.get("RL_E2E_CKPT_EVERY", "5")),
+        log_every=1000,
+        iter_sleep_s=float(os.environ.get("RL_E2E_ITER_SLEEP", "0.05"))))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -288,6 +288,26 @@ time.sleep(30)  # stay alive so pgrep keeps matching while bench polls
     assert any("demoted" in n for n in d["fallback_notes"])
 
 
+def test_staleness_age_boundary_exact_limit_is_fresh(monkeypatch):
+    """The max-age guard is STRICTLY greater-than: a row aged exactly
+    ``TPUCFN_BENCH_MAX_AGE_S`` is still fresh; one second past is stale.
+    Pinned at the unit level (the e2e tests above use ts=1.0, which
+    never exercises the boundary) so a future ``>=`` refactor can't
+    silently shrink the refresh-handshake window by one tick."""
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    monkeypatch.setenv("TPUCFN_BENCH_MAX_AGE_S", "100")
+    monkeypatch.setattr(bench.time, "time", lambda: 1000.0)
+    assert bench._staleness(900.0, "abc1234", "abc1234") == (100, False, "")
+    age_s, stale, why = bench._staleness(899.0, "abc1234", "abc1234")
+    assert (age_s, stale) == (101, True)
+    assert "TPUCFN_BENCH_MAX_AGE_S" in why
+    # the commit checks still apply to a row inside the age horizon
+    assert bench._staleness(900.0, None, "abc1234")[1] is True
+    assert bench._staleness(900.0, "abc1234", "f00baa1")[1] is True
+
+
 def test_serve_bench_row_carries_prefix_and_batch_stats():
     """ISSUE 3 CI satellite: the serve_bench BENCH row must carry the
     shared-prefix block (hit rate, prefill calls per request, TTFT, the

@@ -129,6 +129,28 @@ def test_llama_packed_example(tmp_path):
              "--packed", "--num-examples", "64"))
 
 
+@pytest.mark.slow
+def test_anakin_rl_example(tmp_path):
+    """Podracer RL loop through the example surface: actors + learner on
+    the fake 8-device mesh, on-device replay, final line like the train
+    examples."""
+    _ok(_run("anakin_rl.py", tmp_path))
+
+
+@pytest.mark.slow
+def test_anakin_rl_gridworld_resume_example(tmp_path):
+    """--stop-after interrupts, the rerun resumes from the snapshot and
+    still lands on the same budget."""
+    r0 = _run("anakin_rl.py", tmp_path, "--env", "gridworld",
+              "--unroll", "8", "--ckpt-every", "2", "--stop-after", "2")
+    assert r0.returncode == 0, f"stdout:\n{r0.stdout}\nstderr:\n{r0.stderr}"
+    assert "final: step=2" in r0.stdout
+    r = _run("anakin_rl.py", tmp_path, "--env", "gridworld", "--unroll", "8",
+             "--ckpt-every", "2")
+    _ok(r)
+    assert "rl resumed from iteration 2" in r.stdout
+
+
 def test_imagenet_multiprocess_loader_example(tmp_path):
     """--loader-workers -2: spawn decode workers feed the train loop."""
     _ok(_run("imagenet_resnet50.py", tmp_path, "--network", "resnet18",

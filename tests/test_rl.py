@@ -1,0 +1,287 @@
+"""RL plane (tpucfn.rl): envs, on-device replay, actor/learner, and the
+loop's determinism contract — same seed ⇒ bit-identical episode returns
+and learner losses across runs AND across an interrupt/resume boundary.
+The subprocess chaos-kill variant lives in test_rl_e2e.py.
+"""
+
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpucfn.mesh import MeshSpec, build_mesh
+from tpucfn.rl import (
+    Actor,
+    ReplayQueue,
+    RLConfig,
+    RLLearner,
+    RLObs,
+    make_env,
+    run_rl_loop,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_mesh(MeshSpec.for_devices(jax.device_count()))
+
+
+# -- envs -------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", ["bandit", "gridworld"])
+def test_env_contract(name):
+    env = make_env(name, num_envs=8)
+    state, obs = env.reset(jax.random.key(0))
+    assert obs.shape == (8, env.obs_dim)
+    action = jnp.zeros((8,), jnp.int32)
+    state2, obs2, reward, done = env.step(state, action, jax.random.key(1))
+    assert obs2.shape == (8, env.obs_dim)
+    assert reward.shape == done.shape == (8,)
+    # pure: same (state, action, key) in, same bits out
+    _, obs3, reward3, _ = env.step(state, action, jax.random.key(1))
+    np.testing.assert_array_equal(np.asarray(obs2), np.asarray(obs3))
+    np.testing.assert_array_equal(np.asarray(reward), np.asarray(reward3))
+
+
+def test_bandit_reward_is_chosen_arm_mean():
+    env = make_env("bandit", num_envs=4)
+    state, obs = env.reset(jax.random.key(0))
+    action = jnp.argmax(obs, axis=-1).astype(jnp.int32)
+    _, _, reward, done = env.step(state, action, jax.random.key(1))
+    np.testing.assert_allclose(np.asarray(reward),
+                               np.max(np.asarray(obs), axis=-1), rtol=1e-6)
+    assert bool(jnp.all(done))  # 1-step episodes, auto-reset
+
+
+def test_gridworld_reaches_goal():
+    env = make_env("gridworld", num_envs=1)
+    state, obs = env.reset(jax.random.key(3))
+    total = 0.0
+    for _ in range(2 * env.size):
+        row, col, gr, gc = [float(v) * (env.size - 1) for v in obs[0]]
+        if row < gr:
+            a = 1  # down
+        elif row > gr:
+            a = 0  # up
+        elif col < gc:
+            a = 3  # right
+        else:
+            a = 2  # left
+        state, obs, reward, done = env.step(
+            state, jnp.array([a], jnp.int32), jax.random.key(7))
+        total += float(reward[0])
+        if bool(done[0]):
+            break
+    assert bool(done[0])
+    assert total > 0  # goal bonus beats living cost on the direct path
+
+
+# -- replay queue -----------------------------------------------------------
+
+
+def _slab(v, shape=(4, 3)):
+    return {"x": jnp.full(shape, float(v)), "n": jnp.full((4,), v,
+                                                          jnp.int32)}
+
+
+def test_replay_fifo_order():
+    q = ReplayQueue(capacity=3)
+    st = q.init_state(_slab(0))
+    for v in (1, 2, 3):
+        st = q.push(st, _slab(v))
+    assert q.size(st) == 3
+    for v in (1, 2, 3):
+        st, item = q.pop(st)
+        assert float(item["x"][0, 0]) == v
+    assert q.size(st) == 0
+    with pytest.raises(RuntimeError):
+        q.pop(st)
+
+
+def test_replay_counters_track_sequence():
+    q = ReplayQueue(capacity=2)
+    st = q.init_state(_slab(0))
+    st = q.push(st, _slab(1))
+    st, _ = q.pop(st)
+    st = q.push(st, _slab(2))
+    assert int(st["pushed"]) == 2 and int(st["popped"]) == 1
+
+
+def test_replay_spill_preserves_order():
+    q = ReplayQueue(capacity=2)
+    st = q.init_state(_slab(0))
+    for v in (1, 2, 3, 4, 5):  # 3..5 spill to host
+        st = q.push(st, _slab(v))
+    assert q.spilled_total == 3
+    assert q.size(st) == 5
+    with pytest.raises(RuntimeError):  # spill outstanding: no ckpt allowed
+        q.assert_quiescent()
+    got = []
+    for _ in range(5):
+        st, item = q.pop(st)
+        got.append(int(item["n"][0]))
+    assert got == [1, 2, 3, 4, 5]
+    q.assert_quiescent()  # drained: quiescent again
+
+
+def test_replay_spill_disabled_raises():
+    q = ReplayQueue(capacity=1, spill=False)
+    st = q.init_state(_slab(0))
+    st = q.push(st, _slab(1))
+    with pytest.raises(RuntimeError, match="spill is disabled"):
+        q.push(st, _slab(2))
+
+
+# -- actor + learner --------------------------------------------------------
+
+
+def test_actor_rollout_shapes_and_determinism(mesh):
+    env = make_env("bandit", num_envs=8)
+    learner = RLLearner(mesh, env)
+    actor = Actor(env, learner.apply_fn, unroll=5)
+    state = learner.init(jax.random.key(0))
+    params = learner.refresh(state)
+    es, obs = actor.reset(jax.random.key(1))
+    es1, obs1, traj1 = actor.rollout(params, es, obs, jax.random.key(2))
+    assert traj1["obs"].shape == (8, 5, env.obs_dim)
+    assert traj1["action"].shape == traj1["reward"].shape == (8, 5)
+    assert traj1["bootstrap"].shape == (8,)
+    assert actor.steps_per_rollout == 40
+    # pure function of (params, env_state, obs, key): bit-identical replay
+    _, _, traj2 = actor.rollout(params, es, obs, jax.random.key(2))
+    for k in traj1:
+        np.testing.assert_array_equal(np.asarray(traj1[k]),
+                                      np.asarray(traj2[k]))
+
+
+def test_refresh_survives_donated_step(mesh):
+    """The device-to-device refresh copy must keep actors valid across a
+    donating learner step (the whole reason refresh copies)."""
+    env = make_env("bandit", num_envs=8)
+    learner = RLLearner(mesh, env)
+    actor = Actor(env, learner.apply_fn, unroll=4)
+    state = learner.init(jax.random.key(0))
+    params = learner.refresh(state)
+    before = jax.tree.map(np.asarray, params)
+    es, obs = actor.reset(jax.random.key(1))
+    _, _, traj = actor.rollout(params, es, obs, jax.random.key(2))
+    state, _ = learner.step(state, traj)  # donates old state buffers
+    after = jax.tree.map(np.asarray, params)  # still readable, unchanged
+    jax.tree.map(np.testing.assert_array_equal, before, after)
+
+
+@pytest.mark.slow
+def test_learner_improves_bandit(mesh):
+    """A2C on the bandit: mean reward strictly beats the uniform-policy
+    baseline (the per-slab mean of all arm means) after training."""
+    env = make_env("bandit", num_envs=8)
+    learner = RLLearner(mesh, env, lr=5e-2)
+    actor = Actor(env, learner.apply_fn, unroll=16)
+    state = learner.init(jax.random.key(0))
+    es, obs = actor.reset(jax.random.key(1))
+    root = jax.random.key(7)
+    edge = []
+    for it in range(40):
+        params = learner.refresh(state)
+        es, obs, traj = actor.rollout(params, es, obs,
+                                      jax.random.fold_in(root, it))
+        # bandit obs IS the arm-mean vector: uniform baseline per slab
+        baseline = float(jnp.mean(traj["obs"]))
+        state, metrics = learner.step(state, traj)
+        edge.append(float(metrics["reward_mean"]) - baseline)
+    assert np.mean(edge[-10:]) > np.mean(edge[:10]) + 0.05
+    assert np.mean(edge[-10:]) > 0.1
+
+
+# -- loop determinism -------------------------------------------------------
+
+
+def _rows(run_dir):
+    out = {}
+    for line in (Path(run_dir) / "rl-host000.jsonl").read_text().splitlines():
+        r = json.loads(line)
+        out[r["iter"]] = (r["loss"], r["reward_mean"], r["entropy"])
+    return out
+
+
+@pytest.mark.slow
+def test_loop_same_seed_bit_identical(tmp_path):
+    a = run_rl_loop(RLConfig(run_dir=str(tmp_path / "a"), iters=5,
+                             ckpt_every=100, log_every=100, fresh=True))
+    b = run_rl_loop(RLConfig(run_dir=str(tmp_path / "b"), iters=5,
+                             ckpt_every=100, log_every=100, fresh=True))
+    assert _rows(tmp_path / "a") == _rows(tmp_path / "b")
+    assert a["loss"] == b["loss"] and a["reward_mean"] == b["reward_mean"]
+
+
+@pytest.mark.slow
+def test_loop_resume_bit_identical(tmp_path):
+    """Interrupt at iteration 4, resume, finish: every post-resume row
+    (loss, reward, entropy) matches the uninterrupted reference bit for
+    bit — the in-process half of the chaos-coherence contract."""
+    ref = tmp_path / "ref"
+    res = tmp_path / "res"
+    run_rl_loop(RLConfig(run_dir=str(ref), iters=8, ckpt_every=2,
+                         log_every=100, fresh=True))
+    run_rl_loop(RLConfig(run_dir=str(res), iters=8, ckpt_every=2,
+                         log_every=100, fresh=True, stop_after=4))
+    out = run_rl_loop(RLConfig(run_dir=str(res), iters=8, ckpt_every=2,
+                               log_every=100))
+    assert out["iter"] == 8
+    rref, rres = _rows(ref), _rows(res)
+    assert set(rref) == set(rres) == set(range(1, 9))
+    assert rref == rres
+    # queue sequence counters restored mid-stream, not reset
+    last = json.loads((res / "rl-host000.jsonl").read_text()
+                      .splitlines()[-1])
+    assert last["pushed"] == last["popped"] == 8
+
+
+@pytest.mark.slow
+def test_loop_different_seed_differs(tmp_path):
+    run_rl_loop(RLConfig(run_dir=str(tmp_path / "a"), iters=3, seed=0,
+                         ckpt_every=100, log_every=100, fresh=True))
+    run_rl_loop(RLConfig(run_dir=str(tmp_path / "b"), iters=3, seed=1,
+                         ckpt_every=100, log_every=100, fresh=True))
+    assert _rows(tmp_path / "a") != _rows(tmp_path / "b")
+
+
+# -- obs glue ---------------------------------------------------------------
+
+
+def test_rlobs_first_iter_charged_to_compile():
+    from tpucfn.obs.registry import MetricRegistry
+
+    class FakeLedger:
+        def __init__(self):
+            self.rows = []
+
+        def account(self, bucket, dur_s, step=None):
+            self.rows.append((bucket, step))
+
+    clock = [0.0]
+
+    def tick():
+        clock[0] += 1.0
+        return clock[0]
+
+    led = FakeLedger()
+    obs = RLObs(MetricRegistry(), ledger=led, clock=tick)
+    with obs.act(1):
+        pass
+    with obs.learn(1):
+        pass
+    with obs.refresh(1):
+        pass
+    obs.iteration_done(1, 128)
+    with obs.act(2):
+        pass
+    with obs.learn(2):
+        pass
+    buckets = [b for b, _ in led.rows]
+    assert buckets == ["compile", "compile", "compile", "act", "learn"]
+    assert obs.env_steps_total.value == 128

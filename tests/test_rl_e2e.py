@@ -1,0 +1,132 @@
+"""End-to-end RL chaos drill (ISSUE 19 acceptance): a real launch
+fan-out runs the Podracer loop on every rank, a scripted chaos kill
+lands on the actor host mid-episode (gridworld, between checkpoint
+boundaries), the gang recovers through the existing ft path, and the
+resumed learning trajectory — losses, returns, entropies, queue
+sequence counters — is bit-identical to an uninterrupted reference.
+The goodput merge over the run shows nonzero ``act``/``learn``/
+``refresh`` buckets that (with the derived fillers) sum to wall.
+
+Multi-second by construction (every rank pays a jax import plus the
+rollout/update compiles), so the module is ``slow``-marked like the
+other e2e drills.
+"""
+
+import json
+import os
+import sys
+from pathlib import Path
+
+import pytest
+
+from tpucfn.bootstrap import EnvContract
+from tpucfn.ft import (
+    ChaosEvent,
+    ChaosSpec,
+    GangCoordinator,
+    GangRestart,
+    HeartbeatMonitor,
+    MonitorConfig,
+    RestartBudget,
+)
+from tpucfn.launch import Launcher, LocalTransport
+from tpucfn.obs import MetricRegistry
+from tpucfn.obs.goodput import goodput_report
+
+pytestmark = pytest.mark.slow
+
+REPO = Path(__file__).resolve().parent.parent
+WORKER = str(REPO / "tests" / "rl_e2e_worker.py")
+
+TOTAL_ITERS = 30
+CKPT_EVERY = 5
+KILL_AT_ITER = 13  # off the checkpoint grid: mid-episode, mid-interval
+ACTOR_HOST = 1     # host 0 owns checkpoints; kill the other rank
+
+
+def _contract(tmp_path, n) -> EnvContract:
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("".join("127.0.0.1:0\n" for _ in range(n)))
+    return EnvContract(
+        workers_path=str(hostfile), workers_count=n, worker_chip_count=1,
+        coordinator="127.0.0.1:1234", host_id=0, storage=str(tmp_path),
+        generation=1)
+
+
+def _run(tmp_path, name, *, chaos=None):
+    run_dir = tmp_path / name
+    ft_dir = run_dir / "ft"
+    run_dir.mkdir()
+    env = {"RL_E2E_RUN_DIR": str(run_dir),
+           "RL_E2E_ITERS": str(TOTAL_ITERS),
+           "RL_E2E_CKPT_EVERY": str(CKPT_EVERY)}
+    os.environ.update(env)
+    launcher = Launcher(_contract(run_dir, 2), LocalTransport(),
+                        ft_dir=str(ft_dir), ft_heartbeat_s=0.2)
+    registry = MetricRegistry()
+    monitor = HeartbeatMonitor(
+        ft_dir, expected_hosts=2,
+        config=MonitorConfig(interval_s=0.2, startup_grace_s=300.0))
+    coord = GangCoordinator(
+        launcher, [sys.executable, WORKER],
+        policy=GangRestart(RestartBudget(1)), monitor=monitor,
+        registry=registry, ft_dir=ft_dir, ckpt_dir=run_dir / "ckpt",
+        poll_interval=0.02, term_grace_s=1.0, chaos=chaos)
+    rc = coord.run()
+    return rc, run_dir, registry, coord
+
+
+def _rows(run_dir, host=0):
+    """Per-iteration rows, resumed re-execution winning on overlap."""
+    p = Path(run_dir) / f"rl-host{host:03d}.jsonl"
+    out = {}
+    for line in p.read_text().splitlines():
+        if line.strip():
+            r = json.loads(line)
+            out[r["iter"]] = r
+    return out
+
+
+def test_chaos_kill_recovers_bit_identical_with_goodput(tmp_path):
+    chaos = ChaosSpec(events=(
+        ChaosEvent(action="kill", at_step=KILL_AT_ITER, host=ACTOR_HOST),))
+    rc, run_a, registry, coord = _run(tmp_path, "interrupted", chaos=chaos)
+    assert rc == 0, "gang must finish cleanly after one recovery"
+    assert coord.chaos.done(), "the scripted kill must have fired"
+
+    # -- detected + restarted through the existing ft path ---------------
+    m = registry.varz()["metrics"]
+    assert m["ft_failures_detected_total"] >= 1
+    assert m["ft_gang_restarts_total"] == 1
+    events = [json.loads(s) for s in
+              (run_a / "ft" / "events.jsonl").read_text().splitlines()]
+    kinds = [e["kind"] for e in events]
+    for k in ("rl_run_start", "detect", "recovered", "rl_resumed", "done"):
+        assert k in kinds, kinds
+    resumed_ev = next(e for e in events if e["kind"] == "rl_resumed")
+    # it rejoined from a real mid-run snapshot, not from scratch
+    assert resumed_ev["ckpt_step"] >= CKPT_EVERY
+    assert resumed_ev["iteration"] % CKPT_EVERY == 0
+
+    # -- the kill interrupted work, and recovery re-ran it ---------------
+    rows = _rows(run_a)
+    pids = {r["pid"] for r in rows.values()}
+    assert len(pids) == 2, "expected exactly one gang restart"
+
+    # -- bit-identical learning trajectory vs uninterrupted reference ----
+    rc_b, run_b, reg_b, _ = _run(tmp_path, "uninterrupted", chaos=None)
+    assert rc_b == 0
+    assert reg_b.varz()["metrics"]["ft_restarts_total"] == 0
+    ref = _rows(run_b)
+    assert set(rows) == set(ref) == set(range(1, TOTAL_ITERS + 1))
+    for it in range(1, TOTAL_ITERS + 1):
+        for k in ("loss", "reward_mean", "entropy", "pushed", "popped"):
+            assert rows[it][k] == ref[it][k], (it, k)
+
+    # -- goodput: act/learn/refresh carry the run, merge stays closed ----
+    rep = goodput_report(run_a / "goodput",
+                         ft_events_path=run_a / "ft" / "events.jsonl")
+    b = rep["buckets"]
+    for k in ("act", "learn", "refresh"):
+        assert b[k] > 0, (k, b)
+    assert abs(sum(b.values()) - rep["wall_s"]) < 1e-6

@@ -1,0 +1,205 @@
+"""``net_partition`` drill for the serve router's hedging path
+(ISSUE 19 satellite): a :class:`~tpucfn.net.proxy.ChaosProxy` sits in
+front of ONE replica's (real, TCP) engine backend and silently drops
+its bytes — the gray failure where the connection stays up but answers
+never come.  The drill pins that
+
+* a **hedge** fired onto the healthy replica delivers the bit-identical
+  answer inside the deadline bound while the partitioned attempt is
+  still hanging, and
+* without hedging, the partitioned attempt's timeout converts into a
+  **failover** retry that also lands the identical answer in budget.
+
+The router runs unthreaded (scripted pumps, FakeClock for hedge
+scheduling) so the interleaving is deterministic; the partition itself
+is real — engine calls genuinely block on a socket until their recv
+timeout fires.
+"""
+
+import socket
+import socketserver
+import threading
+import time
+
+import pytest
+
+from tpucfn.net.proxy import ChaosProxy
+from tpucfn.obs import MetricRegistry
+from tpucfn.serve import ReplicaFailed, ReplicaRouter, Server
+
+RECV_TIMEOUT_S = 0.3
+DEADLINE_S = 5.0
+
+
+class _TokenHandler(socketserver.StreamRequestHandler):
+    """One request line per connection: ``P <ids...>`` -> prefill token,
+    ``D <slot:tok,...>`` -> decode tokens.  Same deterministic math as
+    the router tests' FakeEngine, just on the far side of a socket."""
+
+    def handle(self):
+        line = self.rfile.readline().decode().strip()
+        if not line:
+            return
+        op, _, rest = line.partition(" ")
+        if op == "P":
+            out = str(sum(int(t) for t in rest.split()) % 97)
+        else:
+            pairs = (p.split(":") for p in rest.split(",") if p)
+            out = ",".join(f"{s}:{(int(t) * 7 + 1) % 97}" for s, t in pairs)
+        self.wfile.write((out + "\n").encode())
+
+
+class _TokenServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class NetEngine:
+    """Engine whose prefill/decode are REAL TCP round-trips to the token
+    server — through whatever address it is given, which is where the
+    chaos proxy slots in.  A partition upstream shows up here exactly as
+    it would in production: the call hangs, then times out."""
+
+    def __init__(self, address, max_batch=4, cache_len=64):
+        host, _, port = address.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self.max_batch = max_batch
+        self.cache_len = cache_len
+
+    def _ask(self, line):
+        with socket.create_connection(self._addr,
+                                      timeout=RECV_TIMEOUT_S) as s:
+            s.sendall((line + "\n").encode())
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = s.recv(256)  # raises socket.timeout if partitioned
+                if not chunk:
+                    raise RuntimeError("token server hung up")
+                buf += chunk
+        return buf.decode().strip()
+
+    def prefill(self, slot, prefix, bucket, temperature=0.0):
+        return int(self._ask("P " + " ".join(str(t) for t in prefix)))
+
+    def decode(self, tokens_by_slot):
+        line = "D " + ",".join(f"{s}:{t}" for s, t in tokens_by_slot.items())
+        return {int(s): int(t) for s, t in
+                (p.split(":") for p in self._ask(line).split(","))}
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture()
+def token_server():
+    srv = _TokenServer(("127.0.0.1", 0), _TokenHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield f"127.0.0.1:{srv.server_address[1]}"
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def _make_router(addresses, clock, **kw):
+    engines = [NetEngine(a) for a in addresses]
+
+    def factory(i):
+        return Server(engines[i], num_blocks=64, block_size=8)
+
+    kw.setdefault("registry", MetricRegistry())
+    return ReplicaRouter(factory, len(addresses), clock=clock, **kw)
+
+
+def pump(router, i):
+    try:
+        router.replicas[i].server.run_until_idle()
+    except ReplicaFailed:
+        pass
+
+
+def _reference_tokens(upstream, prompt, n):
+    """The clean-path answer: both replicas straight at the server."""
+    router = _make_router([upstream, upstream], FakeClock())
+    req = router.submit(prompt, max_new_tokens=n, deadline_s=DEADLINE_S)
+    pump(router, req.attempts[0].replica)
+    assert req.status == "ok"
+    return list(req.tokens)
+
+
+PROMPT = [3, 1, 4, 1, 5]
+N_NEW = 4
+
+
+def test_hedge_beats_partition_inside_deadline(token_server):
+    ref = _reference_tokens(token_server, PROMPT, N_NEW)
+
+    with ChaosProxy(token_server).start() as proxy:
+        clk = FakeClock()
+        # replica 0 talks through the proxy, replica 1 goes direct
+        router = _make_router([proxy.address, token_server], clk,
+                              hedge_ms=100.0)
+        proxy.inject("partition", direction="both")  # answers stop dead
+        t0 = time.monotonic()
+        req = router.submit(PROMPT, max_new_tokens=N_NEW,
+                            deadline_s=DEADLINE_S)
+        primary = req.attempts[0]
+        clk.advance(0.2)  # straggler threshold passes -> hedge is due
+        assert router._fire_due_hedges() == 1
+        hedge = next(a for a in req.attempts if a.hedge)
+        assert hedge.replica != primary.replica
+        # the healthy replica races ahead while the partitioned attempt
+        # is still queued behind a dead socket
+        pump(router, hedge.replica)
+        elapsed = time.monotonic() - t0
+        assert req.status == "ok" and req.done.is_set()
+        assert list(req.tokens) == ref, "hedged answer must be bit-identical"
+        assert router.hedges_c.value == 1
+        assert router.hedges_won_c.value == 1
+        assert elapsed < DEADLINE_S, "hedge must deliver inside the deadline"
+        assert elapsed < RECV_TIMEOUT_S, \
+            "the win must not have waited out the partition timeout"
+        # the partitioned loser genuinely hits the timeout and cannot
+        # re-deliver or change the answer
+        pump(router, primary.replica)
+        assert list(req.tokens) == ref
+        assert router.completed_c.value == 1
+
+
+def test_partition_timeout_fails_over_inside_deadline(token_server):
+    ref = _reference_tokens(token_server, PROMPT, N_NEW)
+
+    with ChaosProxy(token_server).start() as proxy:
+        clk = FakeClock()
+        router = _make_router([proxy.address, token_server], clk)
+        proxy.inject("partition", direction="both")
+        t0 = time.monotonic()
+        req = router.submit(PROMPT, max_new_tokens=N_NEW,
+                            deadline_s=DEADLINE_S)
+        first = req.attempts[0]
+        assert first.replica == 0
+        # pump the partitioned replica FIRST: its engine call must hang
+        # until the socket timeout, fail the attempt, and trigger the
+        # router's deadline-budgeted failover to the healthy replica
+        pump(router, 0)
+        assert req.status != "ok"
+        retry = req.attempts[-1]
+        assert retry.replica == 1 and req.retries >= 1
+        pump(router, 1)
+        elapsed = time.monotonic() - t0
+        assert req.status == "ok"
+        assert list(req.tokens) == ref, "failover answer must be identical"
+        assert elapsed >= RECV_TIMEOUT_S, \
+            "the partition must actually have been waited out"
+        assert elapsed < DEADLINE_S
+        assert router.retries_c.value >= 1
+        assert proxy.dropped_c.value > 0, "partition never dropped bytes"

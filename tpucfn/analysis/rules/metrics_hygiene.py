@@ -45,7 +45,7 @@ RULE_ID = "metric-hygiene"
 
 PREFIXES = ("serve", "train", "ft", "router", "obs", "device", "jit",
             "supervisor", "input", "coordinator", "compilecache", "net",
-            "provision")
+            "provision", "rl")
 PREFIX_RE = re.compile(r"^(%s)_" % "|".join(PREFIXES))
 REF_RE = re.compile(
     r"^(%s)_[a-z0-9_]*_(total|seconds|bytes|rate|ratio)$" % "|".join(PREFIXES))

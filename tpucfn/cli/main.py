@@ -1753,6 +1753,30 @@ def cmd_ft_status(args) -> int:
     return 0
 
 
+def cmd_rl_train(args) -> int:
+    """Run one host's Podracer RL loop (tpucfn.rl): co-located jitted
+    actors + a Trainer-backed A2C learner on ONE mesh, trajectories
+    through the on-device replay queue, param refresh as a device-to-
+    device copy.  The third workload class next to ``launch`` (training)
+    and ``serve`` — and like them it is fan-out-ready: run it as the
+    command under ``tpucfn launch`` and every rank gets heartbeats
+    (``TPUCFN_FT_DIR``), fleet warm start (``TPUCFN_COMPILE_CACHE_*``),
+    goodput ledgers with the ``act``/``learn``/``refresh`` buckets, and
+    chaos-coherent resume from the latest checkpoint."""
+    from tpucfn.rl.loop import RLConfig, run_rl_loop
+
+    cfg = RLConfig(
+        run_dir=args.run_dir, env=args.env, num_envs=args.num_envs,
+        unroll=args.unroll, iters=args.iters, hidden=args.hidden,
+        lr=args.lr, gamma=args.gamma, entropy_coef=args.entropy_coef,
+        seed=args.seed, ckpt_every=args.ckpt_every,
+        log_every=args.log_every, queue_capacity=args.queue_capacity,
+        stop_after=args.stop_after, fresh=args.fresh,
+        iter_sleep_s=args.iter_sleep_s)
+    run_rl_loop(cfg)
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tpucfn", description=__doc__)
     p.add_argument("--state-dir", default=os.environ.get("TPUCFN_STATE_DIR", "~/.tpucfn"))
@@ -1980,6 +2004,54 @@ def build_parser() -> argparse.ArgumentParser:
     fs.add_argument("--json", action="store_true",
                     help="emit the full fleet report as one JSON object")
     fs.set_defaults(fn=cmd_ft_status)
+
+    rl = sub.add_parser(
+        "rl", help="RL plane (Podracer: co-located actors + learner on "
+                   "one mesh, on-device replay, chaos-coherent resume)")
+    rlsub = rl.add_subparsers(dest="rl_command", required=True)
+    rt = rlsub.add_parser(
+        "train",
+        help="run one host's actor/learner/refresh loop (fan out with "
+             "`tpucfn launch -- tpucfn rl train ...` for the full drill)")
+    rt.add_argument("--run-dir", default="/tmp/tpucfn-rl",
+                    help="per-run state: ckpt/, rl-host*.jsonl rows")
+    rt.add_argument("--env", choices=["bandit", "gridworld"],
+                    default="bandit",
+                    help="built-in pure-jax vectorized env (the whole "
+                         "rollout stays one device program)")
+    rt.add_argument("--num-envs", type=int, default=8,
+                    help="vectorized env copies = learner batch size "
+                         "(must divide the mesh's data-parallel degree)")
+    rt.add_argument("--unroll", type=int, default=16,
+                    help="env steps per jitted rollout (lax.scan length)")
+    rt.add_argument("--iters", type=int, default=100,
+                    help="act→learn→refresh iterations to run")
+    rt.add_argument("--hidden", type=int, default=64,
+                    help="policy/value MLP hidden width")
+    rt.add_argument("--lr", type=float, default=1e-2)
+    rt.add_argument("--gamma", type=float, default=0.99)
+    rt.add_argument("--entropy-coef", type=float, default=0.01)
+    rt.add_argument("--seed", type=int, default=0,
+                    help="root PRNG seed; every per-iteration choice is "
+                         "fold_in(root, iteration), so same seed = "
+                         "bit-identical run, including across restores")
+    rt.add_argument("--ckpt-every", type=int, default=25,
+                    help="whole-stack snapshot interval (learner state + "
+                         "env state + queue ring + iteration)")
+    rt.add_argument("--log-every", type=int, default=10)
+    rt.add_argument("--queue-capacity", type=int, default=4,
+                    help="on-device replay ring slots (host spill is the "
+                         "overflow fallback)")
+    rt.add_argument("--stop-after", type=int, default=0,
+                    help="halt after this iteration (0 = run to --iters); "
+                         "the planned-interruption hook drills use")
+    rt.add_argument("--fresh", action="store_true",
+                    help="ignore existing checkpoints (default: resume "
+                         "from latest)")
+    rt.add_argument("--iter-sleep-s", type=float, default=0.0,
+                    help="host-side pacing between iterations (chaos "
+                         "drills use it to land mid-episode kills)")
+    rt.set_defaults(fn=cmd_rl_train)
 
     ch = sub.add_parser(
         "chaos",

@@ -64,6 +64,12 @@ EVENT_KINDS = (
     "provision_decision",
     "provision_actuated",
     "provision_flagged",
+    # RL plane (tpucfn.rl.loop): Podracer actors+learner on one mesh.
+    # rl_run_start marks a fresh loop; rl_resumed a post-restore
+    # continuation (carries the iteration and ckpt step it rejoined at,
+    # so the chaos drill can pin the recovery boundary).
+    "rl_run_start",
+    "rl_resumed",
 )
 
 

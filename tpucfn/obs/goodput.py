@@ -72,11 +72,15 @@ from typing import Iterable
 # column, so the fleet warm-start plane's effect is visible per run
 # (old ledgers that only ever wrote ``compile`` merge unchanged).
 RECORDED_BUCKETS = ("step", "compile", "compile_cached", "compile_fetched",
-                    "data_wait", "ckpt")
+                    "data_wait", "ckpt", "act", "learn", "refresh")
 DERIVED_BUCKETS = ("idle", "lost_work", "restart_downtime")
+# ``act``/``learn``/``refresh`` are the RL plane's phases (tpucfn.rl):
+# acting slab on-device, A2C update, device-to-device param copy to the
+# actors.  An RL run records those instead of ``step``, so its
+# productive_step stays 0 and the three RL columns carry the wall.
 REPORT_BUCKETS = ("productive_step", "compile", "compile_cached",
-                  "compile_fetched", "data_wait", "ckpt", "lost_work",
-                  "idle", "restart_downtime")
+                  "compile_fetched", "data_wait", "ckpt", "act", "learn",
+                  "refresh", "lost_work", "idle", "restart_downtime")
 
 LEDGER_GLOB = "goodput-host*.jsonl"
 

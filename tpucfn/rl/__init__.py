@@ -1,0 +1,38 @@
+"""Podracer RL plane: co-located actors + sharded learner on one mesh.
+
+The third scenario class after supervised training and LLM serving
+(PAPERS.md "Podracer architectures for scalable Reinforcement
+Learning", arXiv:2104.06272 — the Anakin layout): a jitted env-step +
+policy-decode rollout runs on the SAME mesh as a Trainer-backed A2C
+learner, trajectories flow through an on-device replay queue, and
+parameter refresh to the actors is a device-to-device copy.  The loop
+is wired into every fleet plane — goodput buckets ``act``/``learn``/
+``refresh``, ``rl_*`` metrics and trace spans, heartbeats, checkpoint
+resume, fleet warm start — and ``tpucfn rl train`` fans it out.
+
+Import discipline matches the rest of the package: importing
+``tpucfn.rl`` pulls jax, so the CLI imports it lazily inside the
+``rl train`` command.
+"""
+
+from tpucfn.rl.actor import Actor
+from tpucfn.rl.env import ENVS, BanditEnv, GridWorldEnv, make_env
+from tpucfn.rl.learner import RLLearner, make_a2c_loss, mlp_apply, mlp_init
+from tpucfn.rl.loop import RLConfig, RLObs, run_rl_loop
+from tpucfn.rl.replay import ReplayQueue
+
+__all__ = [
+    "Actor",
+    "BanditEnv",
+    "ENVS",
+    "GridWorldEnv",
+    "RLConfig",
+    "RLLearner",
+    "RLObs",
+    "ReplayQueue",
+    "make_a2c_loss",
+    "make_env",
+    "mlp_apply",
+    "mlp_init",
+    "run_rl_loop",
+]

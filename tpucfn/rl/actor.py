@@ -1,0 +1,107 @@
+"""Actor plane: jitted env-step + policy-decode rollout on the mesh.
+
+One ``rollout`` call is one XLA program: ``unroll`` iterations of
+(policy forward → categorical sample → env step) under ``lax.scan``,
+exactly the Anakin shape from PAPERS.md arXiv:2104.06272 — acting is a
+device program co-located with the learner, not a host loop driving
+the device one step at a time.  The policy forward here is the same
+pure ``(params, inputs) -> outputs`` discipline as ``ServeEngine``'s
+prefill/decode step functions; the sampling mirrors the engine's
+``_sample`` (categorical over logits from a fold_in'd key).
+
+Determinism contract: the rollout is a pure function of
+``(params, env_state, obs, key)``.  The loop derives ``key`` from
+``fold_in(root, iteration)``, so a resumed run (post chaos-kill
+restore) replays the exact bit pattern of the uninterrupted one.
+
+Like the trainer's jits, the rollout program routes through
+``tpucfn.compilecache.maybe_warm`` so a launch fan-out with the fleet
+artifact plane configured compiles it once per fleet, not once per
+host.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def _maybe_warm(jitted, label: str):
+    """Fleet warm start (same shim as Trainer/ServeEngine): with no
+    compile-cache client configured this returns ``jitted`` unchanged."""
+    from tpucfn.compilecache.jit import maybe_warm
+
+    return maybe_warm(jitted, label=label)
+
+
+class Actor:
+    """Co-located actor: jitted ``unroll``-step rollout over a pure env.
+
+    ``apply_fn(params, obs) -> (logits, value)`` is the policy/value
+    forward; ``env`` follows the contract in :mod:`tpucfn.rl.env`.
+    :meth:`rollout` returns trajectories shaped ``[num_envs, unroll,
+    ...]`` (batch-major, so the leading axis is the one the learner
+    shards over the mesh's batch axes).
+    """
+
+    def __init__(self, env: Any, apply_fn: Callable, *, unroll: int = 16):
+        if unroll < 1:
+            raise ValueError(f"unroll must be >= 1, got {unroll}")
+        self.env = env
+        self.apply_fn = apply_fn
+        self.unroll = unroll
+        self._jit_rollout = None
+        self._jit_reset = None
+
+    # -- device programs ---------------------------------------------------
+
+    def _rollout_fn(self, params, env_state, obs, key):
+        def body(carry, k):
+            env_state, obs = carry
+            logits, value = self.apply_fn(params, obs)
+            k_act, k_env = jax.random.split(k)
+            action = jax.random.categorical(k_act, logits)
+            env_state, next_obs, reward, done = self.env.step(
+                env_state, action, k_env)
+            out = {"obs": obs, "action": action, "reward": reward,
+                   "done": done, "value": value}
+            return (env_state, next_obs), out
+
+        keys = jax.random.split(key, self.unroll)
+        (env_state, obs), traj = jax.lax.scan(body, (env_state, obs), keys)
+        # scan stacks time-major [T, B, ...]; the learner shards on the
+        # leading (batch) axis, so hand it batch-major slabs
+        traj = jax.tree.map(lambda x: jnp.swapaxes(x, 0, 1), traj)
+        # bootstrap value for the truncated tail of each env's episode
+        _, bootstrap = self.apply_fn(params, obs)
+        traj["bootstrap"] = bootstrap
+        return env_state, obs, traj
+
+    # -- host API ----------------------------------------------------------
+
+    def reset(self, key: jax.Array):
+        """Jitted initial ``(env_state, obs)``."""
+        if self._jit_reset is None:
+            self._jit_reset = _maybe_warm(
+                jax.jit(self.env.reset), "rl_env_reset")
+        return self._jit_reset(key)
+
+    def rollout(self, params, env_state, obs, key):
+        """One fully on-device acting slab.
+
+        Returns ``(env_state, obs, traj)`` where ``traj`` carries
+        ``obs/action/reward/done/value`` as ``[num_envs, unroll, ...]``
+        plus ``bootstrap`` ``[num_envs]`` — the learner batch, already
+        in the layout ``Trainer`` shards over the batch axes.
+        """
+        if self._jit_rollout is None:
+            self._jit_rollout = _maybe_warm(
+                jax.jit(self._rollout_fn), "rl_rollout")
+        return self._jit_rollout(params, env_state, obs, key)
+
+    @property
+    def steps_per_rollout(self) -> int:
+        """Env steps advanced by one rollout call (all envs)."""
+        return self.unroll * self.env.num_envs

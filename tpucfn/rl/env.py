@@ -1,0 +1,145 @@
+"""Built-in vectorized pure-jax environments for the Podracer RL plane.
+
+Anakin's whole premise (PAPERS.md, arXiv:2104.06272) is that the env
+step is a jitted function living on the SAME mesh as policy decode and
+the learner update — no host round-trip anywhere in the acting loop.
+That only works if the env itself is a pure jax function, so the plane
+ships two: a K-armed contextual bandit (the observation IS the arm-mean
+vector, so the optimal policy is learnable in a handful of updates —
+the smoke/bench workload) and a small gridworld (multi-step credit
+assignment for the A2C path).
+
+Contract (both envs, and anything user-supplied to the actor):
+
+* ``reset(key) -> (state, obs)`` — ``state`` is a pytree of arrays with
+  leading dim ``num_envs``; ``obs`` is ``[num_envs, obs_dim]`` float32.
+* ``step(state, action, key) -> (state, obs, reward, done)`` — pure,
+  shape-static, **auto-resetting**: a done env is reseeded from ``key``
+  inside the same call (the lax.scan rollout never branches on done).
+* Everything is a deterministic function of ``(state, action, key)``,
+  which is what makes episode trajectories bit-identical across runs
+  and across a chaos-kill resume (the recovery drill's pin).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class BanditEnv:
+    """Vectorized K-armed bandit with observable arm means.
+
+    Every episode is one step: the observation is the per-arm mean
+    vector (drawn uniform [0,1) at reset), reward is the chosen arm's
+    mean, and the episode ends immediately — auto-reset redraws the
+    means.  The optimal policy ("pick the argmax of the obs") is
+    learnable by a linear layer, so return curves move within tens of
+    updates: the canonical smoke/bench workload.
+    """
+
+    num_envs: int = 8
+    num_arms: int = 4
+
+    @property
+    def obs_dim(self) -> int:
+        return self.num_arms
+
+    @property
+    def num_actions(self) -> int:
+        return self.num_arms
+
+    def _draw(self, key: jax.Array) -> jax.Array:
+        return jax.random.uniform(key, (self.num_envs, self.num_arms),
+                                  jnp.float32)
+
+    def reset(self, key: jax.Array):
+        means = self._draw(key)
+        return {"means": means}, means
+
+    def step(self, state, action: jax.Array, key: jax.Array):
+        means = state["means"]
+        reward = jnp.take_along_axis(means, action[:, None], axis=1)[:, 0]
+        done = jnp.ones((self.num_envs,), jnp.bool_)
+        # one-step episodes: auto-reset IS the transition
+        new_means = self._draw(key)
+        return {"means": new_means}, new_means, reward, done
+
+
+@dataclasses.dataclass(frozen=True)
+class GridWorldEnv:
+    """Vectorized ``size``×``size`` gridworld: reach the goal cell.
+
+    Observation is ``[row, col, goal_row, goal_col] / (size-1)`` (4
+    floats); actions are up/down/left/right with wall clamping; reward
+    is +1 on reaching the goal (episode done) and a -0.05 living cost
+    otherwise; episodes also time out at ``horizon`` steps.  Done envs
+    auto-reset to a fresh random start/goal drawn from the step key.
+    """
+
+    num_envs: int = 8
+    size: int = 5
+    horizon: int = 20
+
+    @property
+    def obs_dim(self) -> int:
+        return 4
+
+    @property
+    def num_actions(self) -> int:
+        return 4
+
+    def _spawn(self, key: jax.Array):
+        kp, kg = jax.random.split(key)
+        pos = jax.random.randint(kp, (self.num_envs, 2), 0, self.size)
+        goal = jax.random.randint(kg, (self.num_envs, 2), 0, self.size)
+        # a spawn on the goal would be a zero-length episode; shift one
+        # column (wrapping) so start != goal always holds
+        clash = jnp.all(pos == goal, axis=1, keepdims=True)
+        pos = jnp.where(clash, (pos + jnp.array([0, 1])) % self.size, pos)
+        return pos, goal
+
+    def _obs(self, state):
+        denom = jnp.float32(max(self.size - 1, 1))
+        return jnp.concatenate(
+            [state["pos"].astype(jnp.float32) / denom,
+             state["goal"].astype(jnp.float32) / denom], axis=1)
+
+    def reset(self, key: jax.Array):
+        pos, goal = self._spawn(key)
+        state = {"pos": pos, "goal": goal,
+                 "t": jnp.zeros((self.num_envs,), jnp.int32)}
+        return state, self._obs(state)
+
+    def step(self, state, action: jax.Array, key: jax.Array):
+        moves = jnp.array([[-1, 0], [1, 0], [0, -1], [0, 1]], jnp.int32)
+        pos = jnp.clip(state["pos"] + moves[action], 0, self.size - 1)
+        at_goal = jnp.all(pos == state["goal"], axis=1)
+        t = state["t"] + 1
+        done = at_goal | (t >= self.horizon)
+        reward = jnp.where(at_goal, 1.0, -0.05).astype(jnp.float32)
+        # auto-reset: done lanes get a fresh spawn and a zeroed clock
+        new_pos, new_goal = self._spawn(key)
+        d2 = done[:, None]
+        state = {
+            "pos": jnp.where(d2, new_pos, pos),
+            "goal": jnp.where(d2, new_goal, state["goal"]),
+            "t": jnp.where(done, 0, t),
+        }
+        return state, self._obs(state), reward, done
+
+
+ENVS = {"bandit": BanditEnv, "gridworld": GridWorldEnv}
+
+
+def make_env(name: str, num_envs: int):
+    """Build one of the built-in envs by registry name."""
+    try:
+        cls = ENVS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown rl env {name!r}; built-ins: {sorted(ENVS)}") from None
+    return cls(num_envs=num_envs)

@@ -1,0 +1,160 @@
+"""Learner glue: a Trainer-backed A2C policy-gradient update.
+
+The learner IS a :class:`tpucfn.train.Trainer` — same sharding rules
+engine, same jit/donation discipline, same checkpoint layout, same
+``maybe_warm`` fleet warm-start hook — bound to an actor-critic loss
+over the trajectory slabs the replay queue hands over.  Nothing about
+the train plane had to change to host an RL workload; that is the
+point of the exercise.
+
+Parameter refresh to the actors is a **device-to-device copy** (one
+jitted identity program), never a checkpoint round-trip.  The copy is
+not an optimization nicety — it is required for correctness: the
+trainer's step donates the state buffers, so actors holding the raw
+``state.params`` references would read freed memory one update later.
+``refresh`` gives the actor plane its own buffers in the actor-side
+(replicated) sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpucfn.parallel.sharding import ShardingRules
+from tpucfn.train.trainer import Trainer, TrainerConfig
+
+from tpucfn.rl.actor import _maybe_warm
+
+
+# -- policy/value network (pure-jax MLP; no framework dependency) ----------
+
+def mlp_init(key: jax.Array, obs_dim: int, num_actions: int,
+             hidden: int = 64):
+    """Two-layer torso with separate policy and value heads."""
+    k1, k2, k3 = jax.random.split(key, 3)
+
+    def dense(k, n_in, n_out):
+        scale = jnp.sqrt(2.0 / n_in)
+        return {"kernel": jax.random.normal(k, (n_in, n_out),
+                                            jnp.float32) * scale,
+                "bias": jnp.zeros((n_out,), jnp.float32)}
+
+    return {"torso": dense(k1, obs_dim, hidden),
+            "pi": dense(k2, hidden, num_actions),
+            "v": dense(k3, hidden, 1)}
+
+
+def mlp_apply(params, obs):
+    """``obs [..., obs_dim] -> (logits [..., A], value [...])``."""
+    h = jnp.tanh(obs @ params["torso"]["kernel"] + params["torso"]["bias"])
+    logits = h @ params["pi"]["kernel"] + params["pi"]["bias"]
+    value = (h @ params["v"]["kernel"] + params["v"]["bias"])[..., 0]
+    return logits, value
+
+
+# -- A2C loss over [B, T] trajectory slabs ---------------------------------
+
+def make_a2c_loss(gamma: float = 0.99, value_coef: float = 0.5,
+                  entropy_coef: float = 0.01):
+    """Loss in the Trainer's ``(params, model_state, batch, rng)``
+    signature.  ``batch`` is one replay slab: ``obs [B,T,obs_dim]``,
+    ``action/reward/done [B,T]``, ``bootstrap [B]``.  Returns are
+    n-step discounted-to-go with the bootstrap value closing the
+    truncated tail; ``done`` cuts the discount chain at episode ends.
+    """
+
+    def loss_fn(params, model_state, batch, rng):
+        del rng  # the update is deterministic given the slab
+        obs, action = batch["obs"], batch["action"]
+        reward, done = batch["reward"], batch["done"]
+        logits, values = mlp_apply(params, obs)  # [B,T,A], [B,T]
+
+        def disc(carry, xs):
+            r, d = xs
+            ret = r + gamma * jnp.where(d, 0.0, carry)
+            return ret, ret
+
+        # reverse-time scan per env: time axis to front, flip, scan
+        r_t = jnp.swapaxes(reward, 0, 1)[::-1]  # [T,B]
+        d_t = jnp.swapaxes(done, 0, 1)[::-1]
+        _, rets = jax.lax.scan(disc, batch["bootstrap"], (r_t, d_t))
+        returns = jnp.swapaxes(rets[::-1], 0, 1)  # [B,T]
+
+        logp = jax.nn.log_softmax(logits)
+        logp_a = jnp.take_along_axis(logp, action[..., None],
+                                     axis=-1)[..., 0]
+        adv = jax.lax.stop_gradient(returns - values)
+        pg_loss = -jnp.mean(logp_a * adv)
+        v_loss = jnp.mean((returns - values) ** 2)
+        entropy = -jnp.mean(jnp.sum(jnp.exp(logp) * logp, axis=-1))
+        loss = pg_loss + value_coef * v_loss - entropy_coef * entropy
+        aux = {"pg_loss": pg_loss, "v_loss": v_loss, "entropy": entropy,
+               "reward_mean": jnp.mean(reward)}
+        return loss, (aux, model_state)
+
+    return loss_fn
+
+
+class RLLearner:
+    """Binds env shape + A2C loss into a Trainer, plus the refresh copy.
+
+    The tiny policy net replicates across the mesh (catch-all ``P()``
+    rule); the trajectory batch shards over the batch axes exactly like
+    a supervised batch — ``num_envs`` must divide the mesh's
+    data-parallel degree.
+    """
+
+    def __init__(self, mesh, env, *, hidden: int = 64, lr: float = 1e-2,
+                 gamma: float = 0.99, value_coef: float = 0.5,
+                 entropy_coef: float = 0.01, seed_split: int = 0):
+        del seed_split  # reserved for multi-learner variants
+        self.mesh = mesh
+        self.env = env
+        self.apply_fn = mlp_apply
+
+        def init_fn(rng):
+            return mlp_init(rng, env.obs_dim, env.num_actions, hidden), {}
+
+        self.trainer = Trainer(
+            mesh, ShardingRules(((r".*", P()),)),
+            make_a2c_loss(gamma, value_coef, entropy_coef),
+            optax.adam(lr), init_fn, TrainerConfig(donate_state=True))
+        self._jit_refresh = None
+
+    # -- Trainer pass-throughs --------------------------------------------
+
+    def init(self, rng: jax.Array):
+        return self.trainer.init(rng)
+
+    def step(self, state, slab):
+        """One A2C update on a replay slab; Trainer's jitted/donating/
+        warm-startable step underneath.  The slab leaves the replay ring
+        with the actor-side layout; resharding onto the trainer's batch
+        spec is a device-to-device move, never a host bounce."""
+        slab = jax.device_put(slab, self.trainer.batch_sharding())
+        return self.trainer.step(state, slab)
+
+    def abstract_state(self) -> Any:
+        return self.trainer.abstract_state()
+
+    # -- actor param refresh ----------------------------------------------
+
+    def refresh(self, state):
+        """Actor-side copy of the current policy params.
+
+        One jitted elementwise copy, device to device, output pinned to
+        the replicated actor sharding — fresh XLA buffers, so the
+        trainer's donation of ``state`` cannot invalidate what the
+        actors hold, and no checkpoint (or host) round-trip happens on
+        the refresh path."""
+        if self._jit_refresh is None:
+            repl = NamedSharding(self.mesh, P())
+            self._jit_refresh = _maybe_warm(jax.jit(
+                lambda p: jax.tree.map(jnp.copy, p),
+                out_shardings=repl), "rl_refresh")
+        return self._jit_refresh(state.params)

@@ -1,0 +1,405 @@
+"""The fleet-integrated Podracer loop: act → push → pop → learn → refresh.
+
+This is the RL analogue of ``examples/common.run_train_loop`` — one
+host's whole life, wired into every plane the harness has:
+
+* **obs** — registry metrics (``rl_*`` on the per-host ``/metrics``
+  endpoint), trace spans per phase, a flight-recorder ring, and the
+  goodput ledger with the RL phases first-class: ``act`` / ``learn`` /
+  ``refresh`` buckets next to ``compile`` and ``ckpt``, so
+  ``tpucfn obs goodput`` decomposes an RL run's wall clock the same way
+  it does a supervised one.
+* **ft** — heartbeats (``TPUCFN_FT_DIR`` fan-out), resume-from-latest
+  on startup, ``RESTORE_FAILED_RC`` on a corrupt checkpoint (the
+  coordinator's blacklist-and-retry path), drain-request honoring, and
+  ``rl_run_start`` / ``rl_resumed`` event rows.
+* **chaos coherence** — everything the next iteration depends on
+  (learner TrainState, env state + obs, queue ring, iteration counter)
+  is ONE checkpointed pytree, and every per-iteration random choice is
+  derived from ``fold_in(root, iteration)``; a gang-killed host that
+  restores at iteration k replays iterations k+1..N bit-for-bit.
+
+Per-iteration results append to ``rl-host{NNN}.jsonl`` (loss, return,
+queue counters, pid) — the pinned trajectory the recovery drill diffs
+against an uninterrupted reference.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import time
+from pathlib import Path
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class RLConfig:
+    """One RL run, as the CLI / examples / benches configure it."""
+
+    run_dir: str = "/tmp/tpucfn-rl"
+    env: str = "bandit"          # tpucfn.rl.env.ENVS name
+    num_envs: int = 8            # must divide the mesh's dp degree
+    unroll: int = 16             # env steps per rollout slab
+    iters: int = 100             # learner updates (the run budget)
+    hidden: int = 64
+    lr: float = 1e-2
+    gamma: float = 0.99
+    entropy_coef: float = 0.01
+    seed: int = 0
+    ckpt_every: int = 25
+    log_every: int = 10
+    queue_capacity: int = 4
+    stop_after: int = 0          # halt at iter N without changing budget
+    fresh: bool = False
+    iter_sleep_s: float = 0.0    # drill pacing: sleep per iter (idle time)
+
+
+class RLObs:
+    """TrainerObs's phase discipline for the RL loop's phases.
+
+    ``act`` / ``learn`` / ``refresh`` each land a registry metric, a
+    trace span, a goodput-ledger row, and a flight sample.  The first
+    iteration's act+learn wall time is compile-dominated and charged to
+    the ``compile`` bucket (the StepTimer warmup-exclusion rule), so
+    steady-state ``act``/``learn`` shares stay honest.
+    """
+
+    def __init__(self, registry=None, tracer=None, *, ledger=None,
+                 flight=None, clock=time.monotonic):
+        from tpucfn.obs.goodput import GoodputLedger
+        from tpucfn.obs.registry import default_registry
+        from tpucfn.obs.trace import Tracer
+
+        r = self.registry = (registry if registry is not None
+                             else default_registry())
+        self.tracer = tracer if tracer is not None else Tracer(None)
+        self.ledger = ledger if ledger is not None else GoodputLedger(None)
+        self.flight = flight
+        self.clock = clock
+        self.act_time = r.histogram(
+            "rl_act_seconds", "actor rollout wall time (one slab)")
+        self.learn_time = r.histogram(
+            "rl_learn_seconds", "learner update wall time (one slab)")
+        self.refresh_time = r.summary(
+            "rl_refresh_seconds",
+            "actor param refresh wall time (device-to-device copy)")
+        self.iters_total = r.counter(
+            "rl_iterations_total", "completed act+learn+refresh iterations")
+        self.env_steps_total = r.counter(
+            "rl_env_steps_total", "env steps advanced across all envs")
+        self.spilled_total = r.counter(
+            "rl_spilled_total",
+            "trajectory slabs spilled to host memory (queue overflow)")
+        self.return_g = r.gauge(
+            "rl_episode_return", "mean per-step reward of the last slab")
+        self.last_iter_g = r.gauge("rl_last_iter", "most recent iteration")
+        self.queue_depth_g = r.gauge(
+            "rl_queue_depth", "slabs queued (device ring + host spill)")
+        self._iters_seen = 0
+        self._compile_s = 0.0
+
+    @contextlib.contextmanager
+    def phase(self, name: str, metric, it: int | None):
+        t0 = self.clock()
+        try:
+            yield
+        finally:
+            dt = self.clock() - t0
+            metric.observe(dt)
+            self.tracer.record(name, start=t0, dur_s=dt, trace_id=it)
+            if self._iters_seen == 0 and name in ("act", "learn", "refresh"):
+                # first iteration: compile-dominated, charged as compile
+                self.ledger.account("compile", dt, step=it)
+            else:
+                self.ledger.account(name, dt, step=it)
+            if self.flight is not None:
+                self.flight.record(name, step=it, dur_s=dt)
+
+    def act(self, it):
+        return self.phase("act", self.act_time, it)
+
+    def learn(self, it):
+        return self.phase("learn", self.learn_time, it)
+
+    def refresh(self, it):
+        return self.phase("refresh", self.refresh_time, it)
+
+    def ckpt(self, it):
+        return self.phase("ckpt", self.ckpt_time, it)
+
+    @property
+    def ckpt_time(self):
+        return self.registry.summary(
+            "rl_ckpt_seconds", "checkpoint save-call time")
+
+    def iteration_done(self, it: int, env_steps: int) -> None:
+        self._iters_seen += 1
+        self.iters_total.add()
+        self.env_steps_total.add(env_steps)
+        self.last_iter_g.set(it)
+
+
+def _host_id() -> int:
+    """Rank inside a launch fan-out: the launcher's env contract wins
+    (each fanned-out process runs its own jax runtime on CPU drills, so
+    ``jax.process_index()`` alone cannot tell ranks apart there)."""
+    env = os.environ.get("TPUCFN_HOST_ID", "").strip()
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    return jax.process_index()
+
+
+def _abstract_like(tree):
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(
+            x.shape, x.dtype, sharding=getattr(x, "sharding", None)),
+        tree)
+
+
+def actor_plane_shardings(mesh, num_envs):
+    """Placement of the actor plane on the mesh, Anakin layout.
+
+    Returns ``(env_sh, slot_sh, repl)``: env state/obs SHARDED over the
+    batch axes (each device acts its own env slice; params stay
+    replicated, so the rollout has no cross-device traffic) and queue
+    ring slots sharded the same way on their post-capacity axis — which
+    also makes the popped slab already match the trainer's batch
+    sharding.  Falls back to replicated when ``num_envs`` doesn't divide
+    the data-parallel degree.  Pinning these is not just layout hygiene:
+    un-pinned (uncommitted, single-device) inputs make GSPMD re-shard
+    the rollout around every call, and the checkpoint manager
+    rematerializes the saved tree in one jit, which rejects mixed
+    single-device/mesh trees.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from tpucfn.mesh import BATCH_AXES
+
+    repl = NamedSharding(mesh, PartitionSpec())
+    dp = 1
+    for ax in BATCH_AXES:
+        dp *= mesh.shape[ax]
+    if num_envs % dp == 0:
+        env_sh = NamedSharding(mesh, PartitionSpec(BATCH_AXES))
+        slot_sh = NamedSharding(mesh, PartitionSpec(None, BATCH_AXES))
+    else:
+        env_sh = slot_sh = repl
+    return env_sh, slot_sh, repl
+
+
+def run_rl_loop(cfg: RLConfig):
+    """Run one host's Podracer loop to completion; returns final stats."""
+    import jax.numpy as jnp
+
+    from tpucfn.compilecache import configure_from_env
+    from tpucfn.mesh import MeshSpec, build_mesh
+    from tpucfn.obs import (FlightRecorder, Tracer, set_default_labels,
+                            start_obs_server)
+    from tpucfn.obs.goodput import GoodputLedger
+    from tpucfn.rl.actor import Actor
+    from tpucfn.rl.env import make_env
+    from tpucfn.rl.learner import RLLearner
+    from tpucfn.rl.replay import ReplayQueue
+
+    host = _host_id()
+    run_dir = Path(cfg.run_dir)
+    run_dir.mkdir(parents=True, exist_ok=True)
+    ft_dir = os.environ.get("TPUCFN_FT_DIR", "").strip()
+
+    mesh = build_mesh(MeshSpec.for_devices(jax.device_count()))
+    env = make_env(cfg.env, cfg.num_envs)
+    learner = RLLearner(mesh, env, hidden=cfg.hidden, lr=cfg.lr,
+                        gamma=cfg.gamma, entropy_coef=cfg.entropy_coef)
+    actor = Actor(env, learner.apply_fn, unroll=cfg.unroll)
+    queue = ReplayQueue(cfg.queue_capacity)
+
+    tracer = obs_srv = hb = ledger = None
+    registry = set_default_labels(host=str(host), role="rl")
+    try:
+        tracer = Tracer(run_dir / "trace", host_id=host, role="rl")
+        ledger = GoodputLedger(run_dir / "goodput", host_id=host, role="rl")
+        flight = FlightRecorder(host_id=host, role="rl")
+        flight.install_dump_handlers(run_dir / "flight")
+        configure_from_env(tracer=tracer, registry=registry)
+        obs = RLObs(registry, tracer, ledger=ledger, flight=flight)
+        obs_srv = start_obs_server(
+            registry, role="rl", host_id=host,
+            health_fn=lambda: (True, {"iter": obs.last_iter_g.value}),
+            flight=flight)
+        if ft_dir:
+            from tpucfn.ft import HeartbeatWriter
+
+            try:
+                hb_s = float(os.environ.get("TPUCFN_FT_HEARTBEAT_S", "")
+                             or 1.0)
+            except ValueError:
+                hb_s = 1.0
+            hb = HeartbeatWriter(ft_dir, host_id=host, interval_s=hb_s,
+                                 role="rl").start()
+        return _rl_loop_body(cfg, host, run_dir, ft_dir, mesh, env, learner,
+                             actor, queue, obs, hb, jnp)
+    finally:
+        if hb is not None:
+            hb.stop()
+        if tracer is not None:
+            tracer.close()
+        if ledger is not None:
+            ledger.close()
+        if obs_srv is not None:
+            obs_srv.close()
+
+
+def _rl_loop_body(cfg, host, run_dir, ft_dir, mesh, env, learner, actor,
+                  queue, obs, hb, jnp):
+    from tpucfn.ckpt import CheckpointManager
+    from tpucfn.ft import RESTORE_FAILED_RC, drain_requested
+
+    root = jax.random.key(cfg.seed)
+    # One checkpointable pytree per host: learner TrainState + actor-side
+    # env state/obs + queue ring + the iteration counter.  Saves are
+    # synchronous (tiny states) so a finalized step on disk is always a
+    # coherent whole-stack snapshot — the chaos drill's resume anchor.
+    with CheckpointManager(run_dir / "ckpt", async_save=False,
+                           save_interval_steps=cfg.ckpt_every) as ckpt:
+        state = learner.init(jax.random.fold_in(root, 0))
+        env_state, env_obs = actor.reset(jax.random.fold_in(root, 1))
+        env_sh, slot_sh, repl = actor_plane_shardings(mesh, env.num_envs)
+        env_state, env_obs = jax.device_put((env_state, env_obs), env_sh)
+        qstate = queue.init_state(_example_slab(actor, learner, state,
+                                                env_state, env_obs, root))
+        qstate = jax.device_put(qstate, {
+            k: (jax.tree.map(lambda _: slot_sh, v) if k == "slots" else repl)
+            for k, v in qstate.items()})
+        full = {"train": state, "env": env_state, "obs": env_obs,
+                "queue": qstate,
+                "iter": jax.device_put(jnp.zeros((), jnp.int32), repl)}
+        latest = None if cfg.fresh else ckpt.latest_step()
+        resumed = None
+        if latest is not None:
+            try:
+                full = ckpt.restore(_abstract_like(full))
+            except Exception as e:  # noqa: BLE001 — corrupt artifact
+                # Distinguishable rc: the coordinator blacklists the bad
+                # step and relaunches to retry from the previous one.
+                print(f"rl checkpoint restore of step {latest} failed: {e}",
+                      flush=True)
+                raise SystemExit(RESTORE_FAILED_RC)
+            resumed = latest
+            print(f"rl resumed from iteration {int(full['iter'])}",
+                  flush=True)
+        if ft_dir and host == 0:
+            from tpucfn.ft.events import append_event
+
+            if resumed is None:
+                append_event(ft_dir, "rl_run_start", env=cfg.env,
+                             iters=cfg.iters, num_envs=cfg.num_envs,
+                             unroll=cfg.unroll)
+            else:
+                append_event(ft_dir, "rl_resumed",
+                             iteration=int(full["iter"]), ckpt_step=resumed)
+
+        state, env_state, env_obs, qstate = (
+            full["train"], full["env"], full["obs"], full["queue"])
+        it = int(full["iter"])
+        halt = min(cfg.iters, cfg.stop_after) if cfg.stop_after else cfg.iters
+        rows = run_dir / f"rl-host{host:03d}.jsonl"
+        metrics = {}
+        # actors start from the current learner params — on a resumed run
+        # that is the RESTORED policy, not a fresh one (refresh is the
+        # only path params ever take to the actor plane)
+        actor_params = learner.refresh(state)
+        with open(rows, "a") as rows_f:
+            while it < halt:
+                it += 1
+                # -- act: one on-device rollout slab -----------------------
+                with obs.act(it):
+                    env_state, env_obs, traj = actor.rollout(
+                        actor_params, env_state, env_obs,
+                        jax.random.fold_in(root, 2 + it))
+                    jax.block_until_ready(traj["reward"])
+                qstate = queue.push(qstate, traj)
+                obs.queue_depth_g.set(queue.size(qstate))
+                if queue.spilled_total > obs.spilled_total.value:
+                    obs.spilled_total.add(queue.spilled_total
+                                          - obs.spilled_total.value)
+                # -- learn: pop oldest slab, one A2C update ----------------
+                qstate, slab = queue.pop(qstate)
+                with obs.learn(it):
+                    state, metrics = learner.step(state, slab)
+                    jax.block_until_ready(metrics["loss"])
+                # -- refresh: device-to-device param copy to the actors ----
+                with obs.refresh(it):
+                    actor_params = learner.refresh(state)
+                    jax.block_until_ready(actor_params)
+                obs.return_g.set(float(metrics["reward_mean"]))
+                obs.iteration_done(it, actor.steps_per_rollout)
+                if hb is not None:
+                    hb.update_step(it)
+                rows_f.write(json.dumps({
+                    "iter": it, "pid": os.getpid(),
+                    "loss": float(metrics["loss"]),
+                    "reward_mean": float(metrics["reward_mean"]),
+                    "entropy": float(metrics["entropy"]),
+                    "pushed": int(qstate["pushed"]),
+                    "popped": int(qstate["popped"])}) + "\n")
+                rows_f.flush()
+                if it % cfg.log_every == 0 or it == halt:
+                    print(f"iter={it} loss={float(metrics['loss']):.4f} "
+                          f"reward={float(metrics['reward_mean']):.4f}",
+                          flush=True)
+                # -- checkpoint: whole-stack snapshot at queue quiescence --
+                if host == 0:
+                    queue.assert_quiescent()
+                    full = {"train": state, "env": env_state,
+                            "obs": env_obs, "queue": qstate,
+                            "iter": jax.device_put(
+                                jnp.asarray(it, jnp.int32), repl)}
+                    t0 = time.monotonic()
+                    if ckpt.save(it, full):
+                        obs.ckpt_time.observe(time.monotonic() - t0)
+                        obs.tracer.record("ckpt", start=t0,
+                                          dur_s=time.monotonic() - t0,
+                                          trace_id=it)
+                        obs.ledger.account("ckpt", time.monotonic() - t0,
+                                           step=it)
+                if cfg.iter_sleep_s:
+                    time.sleep(cfg.iter_sleep_s)
+                if ft_dir and drain_requested(ft_dir, it):
+                    print(f"preemption drain: stopping cleanly at "
+                          f"iteration {it}", flush=True)
+                    break
+            if host == 0:
+                queue.assert_quiescent()
+                full = {"train": state, "env": env_state, "obs": env_obs,
+                        "queue": qstate,
+                        "iter": jax.device_put(
+                            jnp.asarray(it, jnp.int32), repl)}
+                ckpt.save(it, full, force=True)
+
+    loss = float(metrics.get("loss", float("nan"))) if metrics else \
+        float("nan")
+    reward = float(metrics.get("reward_mean", float("nan"))) if metrics \
+        else float("nan")
+    print(f"final: step={it} loss={loss:.4f} reward={reward:.4f}",
+          flush=True)
+    return {"iter": it, "loss": loss, "reward_mean": reward,
+            "spilled": queue.spilled_total}
+
+
+def _example_slab(actor, learner, state, env_state, env_obs, root):
+    """Shape template for the queue ring — one abstract rollout, no
+    device work (eval_shape), materialized as zeros by the queue."""
+    import jax.numpy as jnp
+
+    params = jax.eval_shape(lambda s: s.params, state)
+    out = jax.eval_shape(actor._rollout_fn, params, env_state, env_obs,
+                         jax.random.fold_in(root, 2))
+    traj = out[2]
+    return jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype), traj)

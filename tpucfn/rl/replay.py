@@ -1,0 +1,145 @@
+"""On-device trajectory queue with host-side spill as the fallback.
+
+The actor→learner hand-off in the Anakin layout is a queue of fixed-
+shape trajectory slabs.  Keeping it ON DEVICE means the learner batch
+never round-trips through the host (the whole point of co-location);
+the device ring here is a preallocated pytree of ``[capacity, ...]``
+slots with jitted write/read programs, so push and pop are dispatches,
+not transfers.
+
+**Bit-identical-sequence discipline** (the input plane's rule applied
+to this plane): slabs leave the queue in exactly arrival order, and the
+``pushed``/``popped`` counters are part of the queue state — which is
+checkpointed with the learner state, so a chaos-killed run restores
+the queue mid-stream and replays the identical batch sequence.
+
+**Host spill** is strictly the fallback: when the device ring is full,
+``push`` moves the slab to host memory (one transfer, counted) and
+re-injects it FIFO as pops free device slots.  The spill deque is
+transient by construction — the loop drains the queue every iteration
+— and :meth:`assert_quiescent` is the checkpoint-boundary guard: saves
+only happen with the spill empty, so queue state stays a fixed-shape
+checkpointable pytree.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+class ReplayQueue:
+    """FIFO queue of fixed-shape trajectory slabs, device-resident.
+
+    ``capacity`` is the device ring size (slots are preallocated from
+    the example slab's shapes).  ``spill=True`` enables the host-side
+    overflow deque; with ``spill=False`` a push into a full ring
+    raises — the strict on-device mode benches use.
+    """
+
+    def __init__(self, capacity: int = 4, *, spill: bool = True):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.spill_enabled = spill
+        self._spill: deque = deque()
+        self.spilled_total = 0
+        self._jit_push = None
+        self._jit_pop = None
+
+    # -- state -------------------------------------------------------------
+
+    def init_state(self, example: Any) -> dict:
+        """Fresh queue state: zeroed ``[capacity, ...]`` slots plus the
+        head/tail/sequence counters (all device scalars, so the whole
+        state checkpoints as one pytree)."""
+        slots = jax.tree.map(
+            lambda x: jnp.zeros((self.capacity,) + tuple(x.shape), x.dtype),
+            example)
+        return {"slots": slots,
+                "head": jnp.zeros((), jnp.int32),
+                "tail": jnp.zeros((), jnp.int32),
+                "count": jnp.zeros((), jnp.int32),
+                "pushed": jnp.zeros((), jnp.int32),
+                "popped": jnp.zeros((), jnp.int32)}
+
+    # -- device programs ---------------------------------------------------
+
+    def _push_fn(self, state, item):
+        idx = jnp.mod(state["head"], self.capacity)
+        slots = jax.tree.map(
+            lambda s, x: jax.lax.dynamic_update_index_in_dim(s, x, idx, 0),
+            state["slots"], item)
+        return {**state, "slots": slots,
+                "head": state["head"] + 1,
+                "count": state["count"] + 1,
+                "pushed": state["pushed"] + 1}
+
+    def _pop_fn(self, state):
+        idx = jnp.mod(state["tail"], self.capacity)
+        item = jax.tree.map(
+            lambda s: jax.lax.dynamic_index_in_dim(s, idx, 0,
+                                                   keepdims=False),
+            state["slots"])
+        new = {**state, "tail": state["tail"] + 1,
+               "count": state["count"] - 1,
+               "popped": state["popped"] + 1}
+        return new, item
+
+    # -- host API ----------------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._spill)
+
+    def size(self, state) -> int:
+        """Slabs queued (device ring + host spill)."""
+        return int(state["count"]) + len(self._spill)
+
+    def push(self, state, item):
+        """Enqueue one slab; returns the new queue state.
+
+        Full ring + spill enabled → the slab is copied to host and
+        queued there (arrival order preserved: spilled slabs re-enter
+        the ring only behind everything already spilled).  Full ring
+        without spill raises."""
+        if self._jit_push is None:
+            self._jit_push = jax.jit(self._push_fn, donate_argnums=(0,))
+        if int(state["count"]) >= self.capacity or self._spill:
+            if not self.spill_enabled:
+                raise RuntimeError(
+                    f"ReplayQueue full (capacity={self.capacity}) and "
+                    "host spill is disabled")
+            self._spill.append(jax.device_get(item))
+            self.spilled_total += 1
+            return state
+        return self._jit_push(state, item)
+
+    def pop(self, state):
+        """Dequeue the oldest slab; returns ``(state, slab)``.
+
+        Pops always come off the device ring (FIFO); a freed slot is
+        immediately backfilled from the host spill so spilled slabs
+        flow back in order.  Raises on an empty queue."""
+        if self._jit_pop is None:
+            self._jit_pop = jax.jit(self._pop_fn, donate_argnums=(0,))
+        if int(state["count"]) == 0:
+            if not self._spill:
+                raise RuntimeError("ReplayQueue is empty")
+            # ring drained while slabs sit spilled: re-inject then pop
+            state = self._jit_push(state, self._spill.popleft())
+        state, item = self._jit_pop(state)
+        while self._spill and int(state["count"]) < self.capacity:
+            state = self._jit_push(state, self._spill.popleft())
+        return state, item
+
+    def assert_quiescent(self) -> None:
+        """Checkpoint-boundary guard: the host spill must be empty, or
+        the fixed-shape device state under-describes the queue and a
+        restore would drop slabs (sequence discipline broken)."""
+        if self._spill:
+            raise RuntimeError(
+                f"{len(self._spill)} spilled slab(s) outstanding at a "
+                "checkpoint boundary — drain the queue before saving")
