@@ -6,7 +6,7 @@
 # `set -o pipefail` in the tier1 recipe needs bash, not POSIX sh.
 SHELL := /bin/bash
 
-.PHONY: check tier1 verify bench-smoke bench-rl
+.PHONY: check tier1 verify bench-smoke bench-rl trace-smoke
 
 # Static analysis over the files changed vs origin/main (the whole
 # package is still parsed, so cross-module rules keep context).  Falls
@@ -36,6 +36,15 @@ verify: check tier1
 bench-smoke:
 	timeout -k 10 600 env JAX_PLATFORMS=cpu \
 		python benches/flagship_bench.py --quick
+
+# Fleet timeline plane (ISSUE 20): launch fan-out (1 input host +
+# trainer), merged Perfetto export — rc-gated on >=95% of remote
+# data_wait spans resolving a cross-host parent link and critical-path
+# plane shares summing to within 10% of step wall.  CPU-only, ~15s;
+# `--repeat 3` is the acceptance run.
+trace-smoke:
+	timeout -k 10 300 env JAX_PLATFORMS=cpu \
+		python benches/trace_smoke.py --quick
 
 # Podracer RL plane (ISSUE 19): co-located act->learn->refresh vs the
 # host-roundtrip reference on the same mesh — rc-gated on the

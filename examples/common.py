@@ -257,7 +257,8 @@ def run_train_loop(trainer, ds, mesh, args, *, items_per_step, extra_axes=(),
             registry, role="trainer", host_id=host,
             health_fn=lambda: (True, {"step": obs.last_step.value}),
             flight=flight,
-            profiler=ProfileCapture(run_dir / "profile", tracer=tracer))
+            profiler=ProfileCapture(run_dir / "profile", tracer=tracer),
+            tracer=tracer)
         # The fault-tolerance plane (ISSUE 4): when the gang coordinator
         # assigned a heartbeat dir, a daemon thread beats liveness every
         # interval and the loop keeps the step fresh (update_step) so
@@ -340,6 +341,12 @@ def _train_loop_body(trainer, ds, mesh, args, items_per_step, extra_axes,
                     flush=True))
             batches = iter(prefetch_to_mesh(stream, mesh,
                                             extra_axes=extra_axes))
+            # Cross-host causality (ISSUE 20): the resilient stream
+            # queues one wire context per batch it yields; popping
+            # exactly one per batch CONSUMED here keeps the FIFO
+            # pairing exact through any prefetch depth.  Local loaders
+            # have no pop_link — every wait is then a local wait.
+            pop_link = getattr(stream, "pop_link", None)
             _end = object()
             while True:
                 # data_wait vs step vs ckpt: the three spans that say WHY
@@ -353,7 +360,9 @@ def _train_loop_body(trainer, ds, mesh, args, items_per_step, extra_axes,
                 t_wait = time.monotonic() - t0_wait
                 if batch is _end or step >= halt:
                     break
-                obs.record_data_wait(step + 1, t0_wait, t_wait)
+                obs.record_data_wait(
+                    step + 1, t0_wait, t_wait,
+                    link=pop_link() if pop_link is not None else None)
                 with obs.step(step + 1):
                     state, metrics = trainer.step(state, batch)
                     step = int(state.step)  # blocks -> honest step timing

@@ -1306,6 +1306,53 @@ def emit(flight):
     assert check(tmp_path, {"obs.py": src}, rules=["span-balance"]) == []
 
 
+SPAN_CROSSHOST = '''
+import time
+
+CROSS_HOST_SPAN_NAMES = ("data_wait",)
+
+
+def emit(tracer, link):
+    t0 = time.monotonic()
+    tracer.record("data_wait", start=t0, dur_s=0.1, remote_parent=link)
+
+
+def view(events):
+    return [e for e in events if e.get("name") in CROSS_HOST_SPAN_NAMES]
+'''
+
+
+def test_span_crosshost_carrier_pinned_is_silent(tmp_path):
+    assert check(tmp_path, {"obs.py": SPAN_CROSSHOST},
+                 rules=["span-balance"]) == []
+
+
+def test_span_crosshost_carrier_unpinned_fires(tmp_path):
+    """ISSUE 20: a remote_parent= carrier outside CROSS_HOST_SPAN_NAMES
+    vanishes from link-coverage accounting — flagged."""
+    src = SPAN_CROSSHOST.replace('tracer.record("data_wait"',
+                                 'tracer.record("ghost_wait"')
+    src += '''
+
+def view2(events):
+    return [e for e in events if e.get("name") == "ghost_wait"]
+'''
+    keys = {f.key for f in check(tmp_path, {"obs.py": src},
+                                 rules=["span-balance"])}
+    assert "unpinned-crosshost:ghost_wait" in keys
+
+
+def test_span_crosshost_stale_pin_fires(tmp_path):
+    """The reverse drift: a pinned name no emission site records."""
+    src = SPAN_CROSSHOST.replace(
+        'CROSS_HOST_SPAN_NAMES = ("data_wait",)',
+        'CROSS_HOST_SPAN_NAMES = ("data_wait", "retired_span")')
+    keys = {f.key for f in check(tmp_path, {"obs.py": src},
+                                 rules=["span-balance"])}
+    assert "stale-pin:retired_span" in keys
+    assert "stale-pin:data_wait" not in keys
+
+
 # -- net-deadline (ISSUE 15) ------------------------------------------------
 
 # The gray-failure shape the rule encodes: a blocking socket op with no

@@ -200,8 +200,8 @@ def test_fetch_failure_mid_transfer_degrades_to_local_compile(tmp_path):
                 # claim a 1 MiB HIT payload, ship only the first bytes
                 head = json.dumps(entry_meta).encode()
                 blob = struct.pack("<I", len(head)) + head + b"x" * 64
-                conn.sendall(struct.pack("<4scI", CC_MAGIC, CC_HIT,
-                                         len(blob) + (1 << 20)))
+                conn.sendall(struct.pack("<4scIQQQ", CC_MAGIC, CC_HIT,
+                                         len(blob) + (1 << 20), 0, 0, 0))
                 conn.sendall(blob)
                 conn.close()  # mid-transfer death
             except (OSError, ServiceError, AssertionError):

@@ -212,6 +212,44 @@ def test_resilient_stream_degrades_to_local_bit_identically(tmp_path):
     assert stream.degraded and len(reasons) == 1
 
 
+def test_resilient_stream_pop_link_pairs_fifo_with_batches(tmp_path):
+    """Span causality (ISSUE 20): one pop_link() per consumed batch, in
+    FIFO order, surviving the degrade-to-local seam — remote batches
+    yield the server's (cursor, span_id, origin) context, local ones
+    yield None, and cursors stay strictly sequential."""
+    from tpucfn.obs.trace import Tracer, origin_id, read_trace_file
+
+    shards = _shards(tmp_path)
+    tracer = Tracer(tmp_path / "trace", host_id=9, role="input")
+    svc = InputService(shards, num_trainers=1, batch_size_per_process=4,
+                       seed=3, host="127.0.0.1", tracer=tracer).start()
+    stream = ResilientBatchStream(
+        [svc.address], 0,
+        local_factory=lambda skip: itertools.islice(
+            _local(shards).batches(2), skip, None),
+        process_count=1, batch_size=4, seed=3, num_epochs=2)
+    links = []
+    for _ in range(4):  # remote half
+        next(stream)
+        links.append(stream.pop_link())
+    svc.close()
+    tracer.close()
+    for _ in stream:  # local continuation
+        links.append(stream.pop_link())
+    assert stream.degraded
+    remote = [l for l in links if l is not None]
+    assert len(remote) >= 4 and links[:len(remote)] == remote
+    # server cursors are 1-based and strictly sequential in FIFO order
+    assert [c for c, _sid, _org in remote] == list(range(1, len(remote) + 1))
+    assert all(org == origin_id("input", 9) for _c, _sid, org in remote)
+    assert all(l is None for l in links[len(remote):])
+    # every handed-out link names a real input_serve span on the server
+    served = {e["span_id"] for e in read_trace_file(
+        tmp_path / "trace" / "trace-input-host009.jsonl")
+        if e.get("name") == "input_serve"}
+    assert {sid for _c, sid, _org in remote} <= served
+
+
 def test_resilient_stream_with_no_reachable_host_goes_local(tmp_path):
     shards = _shards(tmp_path)
     ref = list(_local(shards).batches(1))

@@ -1,0 +1,400 @@
+"""Fleet timeline plane (ISSUE 20): clock-offset estimation with
+injectable clocks, cross-host link resolution, Perfetto export and
+critical-path determinism (same span files ⇒ byte-identical report),
+the goodput cross-check, and the deadline-autotune advisory.
+
+Everything is synthetic span dicts / JSONL in tmp dirs — no sockets,
+no jax, milliseconds per test."""
+
+import json
+import struct
+
+import pytest
+
+from tpucfn.net.autotune import suggest_deadlines
+from tpucfn.obs.timeline import (
+    CROSS_HOST_SPAN_NAMES,
+    PLANES,
+    ClockProbe,
+    critical_path,
+    crosscheck_goodput,
+    export_chrome_trace,
+    fleet_skew,
+    merge_timeline,
+    probe_clock,
+    read_clock_offsets,
+    render_critpath,
+    resolve_links,
+    write_chrome_trace,
+)
+from tpucfn.obs.trace import Tracer, origin_id, read_trace_file
+
+
+# -- clock probes (injectable clocks, zero sockets) -------------------------
+
+def _fake_clocks(mono_seq, wall_t):
+    """mono() pops from mono_seq; wall() returns the fixed wall_t."""
+    seq = list(mono_seq)
+    return (lambda: seq.pop(0)), (lambda: wall_t)
+
+
+def test_probe_clock_offset_and_uncertainty():
+    mono, wall = _fake_clocks([10.0, 10.2], 1000.0)
+    pr = probe_clock("http://x/clock",
+                     fetch=lambda u: {"wall": 1005.0, "host_id": 3,
+                                      "role": "trainer"},
+                     mono=mono, wall=wall)
+    # local midpoint 1000.1; server 1005.0 -> offset 4.9, unc = rtt/2
+    assert pr.offset_s == pytest.approx(4.9)
+    assert pr.unc_s == pytest.approx(0.1)
+    assert pr.rtt_s == pytest.approx(0.2)
+    assert (pr.host, pr.role) == (3, "trainer")
+
+
+def test_probe_clock_error_bounded_by_uncertainty():
+    """Worst-case asymmetric halves: the estimate may be wrong, but by
+    no more than the reported unc_s — the bound is the contract."""
+    true_offset = 3.0
+    for req_s, rsp_s in [(0.08, 0.02), (0.01, 0.09), (0.05, 0.05)]:
+        rtt = req_s + rsp_s
+        mono, wall = _fake_clocks([0.0, rtt], 100.0)
+        # the server's wall read happens req_s after the local send
+        server_wall = 100.0 + req_s + true_offset
+        pr = probe_clock("http://x/clock",
+                         fetch=lambda u, w=server_wall: {"wall": w},
+                         mono=mono, wall=wall)
+        assert abs(pr.offset_s - true_offset) <= pr.unc_s + 1e-12
+        assert pr.unc_s == pytest.approx(rtt / 2)
+
+
+def test_read_clock_offsets_min_uncertainty_wins(tmp_path):
+    p = tmp_path / "clock-offsets.jsonl"
+    rows = [
+        {"kind": "clock_probe", "host": 0, "role": "trainer",
+         "offset_s": 1.5, "unc_s": 0.20, "rtt_s": 0.4, "t": 1.0},
+        {"kind": "clock_probe", "host": 0, "role": "trainer",
+         "offset_s": 1.1, "unc_s": 0.05, "rtt_s": 0.1, "t": 2.0},
+        {"kind": "other_record", "host": 0, "offset_s": 9.9, "unc_s": 0.0},
+        {"kind": "clock_probe", "host": 9, "role": "input",
+         "offset_s": -0.3, "unc_s": 0.02, "rtt_s": 0.04, "t": 2.0},
+    ]
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows)
+                 + "torn{line\n")
+    offs = read_clock_offsets(p)
+    assert offs["host0"]["offset_s"] == pytest.approx(1.1)  # tighter probe
+    assert offs["host0"]["probes"] == 2
+    assert offs["host9"]["offset_s"] == pytest.approx(-0.3)
+    assert read_clock_offsets(tmp_path / "missing.jsonl") == {}
+
+
+def _span(host, role, name, trace_id, span_id, ts, dur, rp=None, **attrs):
+    e = {"kind": "span", "name": name, "trace_id": trace_id,
+         "span_id": span_id, "parent_id": None, "start": ts, "dur_s": dur,
+         "ts": ts, "mono": ts, "host": host, "role": role, "attrs": attrs}
+    if rp is not None:
+        e["rp"] = rp
+    return e
+
+
+def _step_spans(host, base, steps=3, shift=0.0):
+    out = []
+    for k in range(1, steps + 1):
+        out.append(_span(host, "trainer", "step", k, 20 + k,
+                         base + k + 0.2 + shift, 0.7))
+    return out
+
+
+def test_fleet_skew_probe_overrides_and_rebases():
+    """Probes are relative to the prober's clock, the estimator to the
+    fleet median — mixing must preserve relative drift while adopting
+    the probes' reference."""
+    events = _step_spans(0, 1000.0) + _step_spans(1, 1000.0, shift=0.5)
+    est = fleet_skew(events)
+    assert est["host1"] - est["host0"] == pytest.approx(0.5)
+    probed = fleet_skew(events, {"host0": {"offset_s": 1.0, "unc_s": 0.01,
+                                           "role": "trainer"}})
+    assert probed["host0"] == pytest.approx(1.0)  # the measurement wins
+    # the unprobed host keeps its relative drift, re-based to the probe
+    assert probed["host1"] - probed["host0"] == pytest.approx(0.5)
+
+
+# -- cross-host link resolution ---------------------------------------------
+
+def _fleet_events(base=1000.0, steps=3, input_host=9):
+    """One trainer (host 0) + one input host: per step a data_wait with
+    an rp naming the input host's input_serve span, then step + ckpt —
+    contiguous, so each step's attributed time equals its wall."""
+    org = origin_id("input", input_host)
+    ev = []
+    for k in range(1, steps + 1):
+        t0 = base + k
+        ev.append(_span(input_host, "input", "input_serve", k - 1, 100 + k,
+                        t0 - 0.05, 0.04, trainer=0))
+        ev.append(_span(0, "trainer", "data_wait", k, 10 + k, t0, 0.2,
+                        rp={"trace_id": k - 1, "span_id": 100 + k,
+                            "origin": org}))
+        ev.append(_span(0, "trainer", "step", k, 20 + k, t0 + 0.2, 0.7))
+        ev.append(_span(0, "trainer", "ckpt", k, 30 + k, t0 + 0.9, 0.1))
+    return ev
+
+
+def _write_trace_dir(d, events):
+    d.mkdir(parents=True, exist_ok=True)
+    by = {}
+    for e in events:
+        by.setdefault((e["role"], e["host"]), []).append(e)
+    for (role, host), evs in by.items():
+        p = d / f"trace-{role}-host{host:03d}.jsonl"
+        p.write_text("".join(json.dumps(e) + "\n" for e in evs))
+
+
+def test_resolve_links_matches_rp_against_origin_index():
+    events = _fleet_events(steps=3)
+    links, stats = resolve_links(events)
+    assert stats["carriers"] == 3 and stats["resolved"] == 3
+    assert stats["unpinned"] == 0
+    assert stats["by_name"]["data_wait"] == {"carriers": 3, "resolved": 3}
+    for pi, ci in links:
+        assert events[pi]["name"] == "input_serve"
+        assert events[ci]["name"] == "data_wait"
+        assert events[ci]["rp"]["span_id"] == events[pi]["span_id"]
+
+
+def test_resolve_links_counts_unresolved_and_unpinned():
+    events = _fleet_events(steps=2)
+    events[1]["rp"]["span_id"] = 999  # dangling parent
+    events.append(_span(0, "trainer", "mystery", 1, 77, 2000.0, 0.1,
+                        rp={"trace_id": 1, "span_id": 101,
+                            "origin": origin_id("input", 9)}))
+    assert "mystery" not in CROSS_HOST_SPAN_NAMES
+    links, stats = resolve_links(events)
+    assert stats["carriers"] == 3
+    assert stats["resolved"] == 2  # the dangler stays a carrier only
+    assert stats["unpinned"] == 1
+
+
+# -- critical path ----------------------------------------------------------
+
+def test_critpath_attribution_planes_and_coverage(tmp_path):
+    _write_trace_dir(tmp_path / "trace", _fleet_events(steps=3))
+    merged = merge_timeline(tmp_path / "trace")
+    cp = critical_path(merged)
+    assert len(cp["steps"]) == 3
+    for row in cp["steps"]:
+        assert row["remote-serve"] == pytest.approx(0.2)
+        assert row["input-local"] == 0.0
+        assert row["compute"] == pytest.approx(0.7)
+        assert row["ckpt"] == pytest.approx(0.1)
+        assert row["bounded_by"] == "compute"
+        # attributed within 10% of measured step wall (the rc gate)
+        assert abs(row["coverage"] - 1.0) <= 0.10
+    assert abs(cp["coverage_median"] - 1.0) <= 0.10
+    # shares: 0.2/0.7/0.1 of each step
+    assert cp["shares"]["compute"] == pytest.approx(0.7, abs=1e-3)
+    assert cp["shares"]["remote-serve"] == pytest.approx(0.2, abs=1e-3)
+
+
+def test_critpath_local_wait_without_link(tmp_path):
+    events = [e for e in _fleet_events(steps=2) if e["host"] == 0]
+    for e in events:
+        e.pop("rp", None)  # local loader: no wire context
+    _write_trace_dir(tmp_path / "trace", events)
+    cp = critical_path(merge_timeline(tmp_path / "trace"))
+    for row in cp["steps"]:
+        assert row["input-local"] == pytest.approx(0.2)
+        assert row["remote-serve"] == 0.0
+
+
+def test_critpath_report_is_byte_identical(tmp_path):
+    """Satellite 4's pin: same span files ⇒ byte-identical report and
+    byte-identical Chrome trace (two directories, two invocations)."""
+    events = _fleet_events(steps=3)
+    outs = []
+    for name in ("a", "b"):
+        d = tmp_path / name
+        _write_trace_dir(d / "trace", events)
+        merged = merge_timeline(d / "trace")
+        cp = critical_path(merged)
+        text = render_critpath(cp, crosscheck_goodput(
+            cp, {"buckets": {"productive_step": 7.0, "data_wait": 2.0,
+                             "ckpt": 1.0, "compile_fetched": 0.0}}))
+        trace_path = write_chrome_trace(merged, d / "timeline.json")
+        outs.append((text, trace_path.read_bytes()))
+    assert outs[0][0] == outs[1][0]
+    assert outs[0][1] == outs[1][1]
+    # and a re-run over the SAME dir reproduces itself
+    merged2 = merge_timeline(tmp_path / "a" / "trace")
+    assert render_critpath(critical_path(merged2)) == \
+        render_critpath(critical_path(merge_timeline(tmp_path / "a" / "trace")))
+
+
+def test_export_chrome_trace_flow_arrows_and_lanes(tmp_path):
+    _write_trace_dir(tmp_path / "trace", _fleet_events(steps=3))
+    merged = merge_timeline(tmp_path / "trace")
+    doc = export_chrome_trace(merged)
+    evs = doc["traceEvents"]
+    lanes = {(e["pid"], e["args"]["name"]) for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert lanes == {(0, "host0 (trainer)"), (9, "host9 (input)")}
+    starts = [e for e in evs if e["ph"] == "s"]
+    finishes = [e for e in evs if e["ph"] == "f"]
+    assert len(starts) == len(finishes) == 3
+    assert {e["id"] for e in starts} == {e["id"] for e in finishes}
+    for f in finishes:
+        assert f["bp"] == "e" and f["pid"] == 0  # arrowhead on the trainer
+    assert doc["otherData"]["link_stats"]["resolved"] == 3
+
+
+def test_crosscheck_goodput_agrees_on_matching_shares(tmp_path):
+    _write_trace_dir(tmp_path / "trace", _fleet_events(steps=3))
+    cp = critical_path(merge_timeline(tmp_path / "trace"))
+    # ledger with the same 0.7/0.2/0.1 proportions -> near-zero deltas
+    rows = crosscheck_goodput(cp, {"buckets": {
+        "productive_step": 70.0, "data_wait": 20.0, "ckpt": 10.0,
+        "compile_fetched": 0.0, "restart_downtime": 55.0}})
+    assert {r["bucket"] for r in rows} == {"productive_step", "data_wait",
+                                           "ckpt", "compile_fetched"}
+    for r in rows:
+        assert abs(r["delta"]) < 0.01  # renormalized over shared buckets
+
+
+# -- deadline autotune advisory ---------------------------------------------
+
+def test_autotune_suggests_below_default_never_above():
+    events = [_span(9, "input", "input_serve", k, k, 0.0, 0.010 + k * 1e-4)
+              for k in range(40)]
+    rows = {r["spans"]: r for r in suggest_deadlines(events)}
+    r = rows["input_serve"]
+    assert r["n"] == 40
+    # p99*8 << default 120 but below the 1s floor -> floor wins
+    assert r["suggested_s"] == pytest.approx(1.0)
+    assert r["suggested_s"] <= r["current_default_s"]
+    # a plane with huge observed frames never suggests above default
+    slow = [_span(9, "input", "input_serve", k, k, 0.0, 60.0)
+            for k in range(40)]
+    r2 = {x["spans"]: x for x in suggest_deadlines(slow)}["input_serve"]
+    assert r2["suggested_s"] == r2["current_default_s"]
+
+
+def test_autotune_withholds_verdict_below_min_samples():
+    events = [_span(9, "input", "input_serve", k, k, 0.0, 0.01)
+              for k in range(3)]
+    r = {x["spans"]: x for x in suggest_deadlines(events)}["input_serve"]
+    assert r["n"] == 3 and r["suggested_s"] is None
+
+
+# -- wire contract ----------------------------------------------------------
+
+def test_frame_header_carries_trace_context():
+    import socket
+
+    from tpucfn.data.service import (FRAME_BATCH, MAGIC, PROTOCOL_VERSION,
+                                     recv_frame_ctx, send_frame)
+
+    assert PROTOCOL_VERSION == 2
+    a, b = socket.socketpair()
+    try:
+        send_frame(a, FRAME_BATCH, b"payload", ctx=(7, 42, 0xDEAD))
+        kind, payload, ctx = recv_frame_ctx(b, magic=MAGIC)
+        assert (kind, payload) == (FRAME_BATCH, b"payload")
+        assert ctx == (7, 42, 0xDEAD)
+        send_frame(a, FRAME_BATCH, b"bare")  # no context -> zeros -> None
+        _, _, ctx2 = recv_frame_ctx(b, magic=MAGIC)
+        assert ctx2 is None
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_header_layout_is_the_documented_contract():
+    """The wire contract pinned as bytes: magic(4s) kind(c) len(I) then
+    trace_id/span_id/origin as little-endian u64s, zeros = no context."""
+    from tpucfn.data.service import _HEADER, MAGIC
+
+    assert _HEADER.format == "<4scIQQQ"
+    raw = _HEADER.pack(MAGIC, b"B", 5, 7, 42, 0xDEAD)
+    assert len(raw) == _HEADER.size == 4 + 1 + 4 + 8 * 3
+    assert struct.unpack("<4scIQQQ", raw) == (MAGIC, b"B", 5, 7, 42, 0xDEAD)
+
+
+def test_tracer_records_remote_parent():
+    d_org = origin_id("input", 9)
+    assert d_org != 0 and d_org == origin_id("input", 9)
+    assert origin_id("input", 9) != origin_id("trainer", 9)
+
+
+def test_tracer_rp_roundtrip(tmp_path):
+    tr = Tracer(tmp_path, host_id=0, role="trainer")
+    tr.record("data_wait", start=0.0, dur_s=0.1, trace_id=5,
+              remote_parent=(4, 101, origin_id("input", 9)))
+    tr.record("data_wait", start=0.0, dur_s=0.1, trace_id=6,
+              remote_parent=(0, 0, 0))  # peer with tracing off
+    tr.close()
+    evs = read_trace_file(tmp_path / "trace-trainer-host000.jsonl")
+    assert evs[0]["rp"] == {"trace_id": 4, "span_id": 101,
+                            "origin": origin_id("input", 9)}
+    assert "rp" not in evs[1]
+
+
+# -- forensics diff ---------------------------------------------------------
+
+def _bundle(d, incident, action, downtime, buckets, hb, spans_per_host):
+    d.mkdir(parents=True)
+    (d / "incident.json").write_text(json.dumps({
+        "incident": {"incident": incident, "action": action,
+                     "planned": False, "downtime_s": downtime,
+                     "detection_s": 0.5, "lost_steps": 4},
+        "window": {"window_s": 15.0}}))
+    (d / "goodput.json").write_text(json.dumps({"buckets": buckets}))
+    (d / "heartbeats.json").write_text(json.dumps(
+        [{"host": h, "age_at_detect_s": age} for h, age in hb.items()]))
+    with open(d / "timeline.jsonl", "w") as f:
+        for h, n in spans_per_host.items():
+            for i in range(n):
+                f.write(json.dumps({"kind": "span", "host": h,
+                                    "name": "step", "ts_adj": i}) + "\n")
+    return d
+
+
+def test_diff_bundles_same_class_deltas(tmp_path):
+    from tpucfn.obs.postmortem import diff_bundles, render_bundle_diff
+
+    a = _bundle(tmp_path / "a", 1, "restart", 2.0,
+                {"productive_step": 8.0, "data_wait": 2.0},
+                {0: 0.1, 1: 0.2}, {0: 10, 1: 10})
+    b = _bundle(tmp_path / "b", 2, "restart", 3.5,
+                {"productive_step": 5.0, "data_wait": 5.0},
+                {0: 0.1, 1: 1.4}, {0: 10, 1: 2})
+    diff = diff_bundles(a, b)
+    assert diff["incident"]["class_match"] is True
+    assert diff["incident"]["downtime_s"]["delta"] == pytest.approx(1.5)
+    by_bucket = {r["bucket"]: r for r in diff["buckets"]}
+    # shares: data_wait 0.2 -> 0.5
+    assert by_bucket["data_wait"]["delta"] == pytest.approx(0.3)
+    host1 = next(r for r in diff["hosts"] if r["host"] == 1)
+    assert host1["hb_age_delta_s"] == pytest.approx(1.2)
+    assert host1["span_delta"] == -8
+    text = render_bundle_diff(diff)
+    assert "WARNING" not in text and "data_wait" in text
+
+
+def test_diff_bundles_flags_differing_incident_class(tmp_path):
+    from tpucfn.obs.postmortem import diff_bundles, render_bundle_diff
+
+    a = _bundle(tmp_path / "a", 1, "restart", 2.0, {}, {}, {})
+    b = _bundle(tmp_path / "b", 2, "shrink", 9.0, {}, {}, {})
+    diff = diff_bundles(a, b)
+    assert diff["incident"]["class_match"] is False
+    assert any("classes differ" in n for n in diff["notes"])
+    assert "WARNING" in render_bundle_diff(diff)
+
+
+# -- plane vocabulary stays closed ------------------------------------------
+
+def test_planes_and_crosshost_vocabulary():
+    assert set(CROSS_HOST_SPAN_NAMES) == {"data_wait", "input_serve",
+                                          "compile_fetch", "artifact_serve"}
+    assert "compute" in PLANES and "coordinator" in PLANES
+    # ClockProbe is the probe_clock return contract
+    pr = ClockProbe(host=0, role="x", offset_s=0.0, unc_s=0.0, rtt_s=0.0)
+    assert pr.host == 0

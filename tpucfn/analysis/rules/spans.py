@@ -17,6 +17,14 @@ ship a write-only span).  Two rots, both silent at runtime:
   consumed by no reader in the package (``obs.aggregate``'s views, the
   postmortem, anything matching on the record's ``name``): the span
   costs a JSONL line per occurrence and tells nobody anything.
+* **unpinned cross-host span** (ISSUE 20) — an emission passing
+  ``remote_parent=`` (a cross-host causal link) whose name is not in
+  the package's ``CROSS_HOST_SPAN_NAMES`` tuple: the merged timeline's
+  link stats and the trace-smoke gate select carriers by that
+  vocabulary, so an unpinned carrier's flow arrows silently vanish
+  from the coverage accounting.  The reverse drifts too: a name pinned
+  in the tuple that no emission site carries is a stale vocabulary
+  entry — same contract as event kinds.
 
 Emitters are ``X.record("lit", ..., start=...)`` and ``X.span("lit",
 ...)`` call sites (the ``start=`` keyword is what distinguishes a
@@ -57,8 +65,9 @@ def _kw(call: ast.Call, name: str) -> ast.expr | None:
 
 
 def _span_emissions(analysis: Analysis):
-    """``(mod, call, name, balanced, is_event)`` for every literal-named
-    trace-span emission in the package."""
+    """``(mod, call, name, balanced, is_event, is_carrier)`` for every
+    literal-named trace-span emission in the package (``is_carrier``:
+    the call passes ``remote_parent=`` — a cross-host link)."""
     for mod in analysis.modules:
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Call) \
@@ -68,6 +77,7 @@ def _span_emissions(analysis: Analysis):
             name = _literal_str(node.args[0])
             if name is None:
                 continue
+            carrier = _kw(node, "remote_parent") is not None
             if node.func.attr == "record":
                 if _kw(node, "start") is None:
                     continue  # flight-ring / SLO record, not a trace span
@@ -76,10 +86,10 @@ def _span_emissions(analysis: Analysis):
                             if kind is not None else False)
                 balanced = (_kw(node, "end") is not None
                             or _kw(node, "dur_s") is not None)
-                yield mod, node, name, balanced, is_event
+                yield mod, node, name, balanced, is_event, carrier
             elif node.func.attr == "span":
                 # context-managed spans time their own end
-                yield mod, node, name, True, False
+                yield mod, node, name, True, False, carrier
 
 
 def _module_str_tuples(analysis: Analysis) -> dict[str, list[str]]:
@@ -144,8 +154,25 @@ def check(analysis: Analysis):
     if not emissions:
         return findings
     consumed = _consumed_names(analysis)
+    pinned = _module_str_tuples(analysis).get("CROSS_HOST_SPAN_NAMES", [])
     flagged_unconsumed: set[str] = set()
-    for mod, call, name, balanced, is_event in emissions:
+    flagged_unpinned: set[str] = set()
+    carried: set[str] = set()
+    for mod, call, name, balanced, is_event, carrier in emissions:
+        if carrier:
+            carried.add(name)
+            if pinned and name not in pinned \
+                    and name not in flagged_unpinned:
+                flagged_unpinned.add(name)
+                findings.append(Finding(
+                    RULE_ID, mod.rel, call.lineno,
+                    f"span {name!r} carries remote_parent= (a cross-host "
+                    "causal link) but is not pinned in "
+                    "CROSS_HOST_SPAN_NAMES — the merged timeline's link "
+                    "stats count carriers by that vocabulary, so this "
+                    "span's flow arrows silently vanish from coverage "
+                    "accounting (add the name to the tuple)",
+                    key=f"unpinned-crosshost:{name}"))
         if not is_event and not balanced:
             findings.append(Finding(
                 RULE_ID, mod.rel, call.lineno,
@@ -167,4 +194,31 @@ def check(analysis: Analysis):
                 "(consume it in an obs.aggregate view, or stop emitting "
                 "it)",
                 key=f"unconsumed:{name}"))
+    # Reverse drift: a name pinned in CROSS_HOST_SPAN_NAMES that no
+    # emission site in the package carries or even emits is a stale
+    # vocabulary entry (the forward check above keeps carriers pinned;
+    # this keeps the pin honest).  Emitted-but-not-carrying is fine —
+    # e.g. data_wait carries remote_parent only on remote batches.
+    emitted = {name for _m, _c, name, _b, _e, _cr in emissions}
+    for stale in pinned:
+        if stale in emitted:
+            continue
+        for mod in analysis.modules:
+            loc = None
+            for stmt in mod.tree.body:
+                if isinstance(stmt, ast.Assign) and any(
+                        isinstance(t, ast.Name)
+                        and t.id == "CROSS_HOST_SPAN_NAMES"
+                        for t in stmt.targets):
+                    loc = stmt.lineno
+                    break
+            if loc is not None:
+                findings.append(Finding(
+                    RULE_ID, mod.rel, loc,
+                    f"CROSS_HOST_SPAN_NAMES pins {stale!r} but no "
+                    "emission site in the package records a span by "
+                    "that name — stale vocabulary entry (drop it, or "
+                    "restore the emitter)",
+                    key=f"stale-pin:{stale}"))
+                break
     return findings

@@ -656,11 +656,20 @@ def cmd_data_serve(args) -> int:
         port = int(os.environ.get(INPUT_PORT_ENV, "0") or 0)
 
     from tpucfn.obs import MetricRegistry, start_obs_server
+    from tpucfn.obs.trace import Tracer
 
     host_id = int(os.environ.get("TPUCFN_HOST_ID", "0") or 0)
     registry = MetricRegistry(labels={"role": "input",
                                       "host": str(host_id)})
     hb = obs_srv = None
+    # Fleet timeline (ISSUE 20): with a trace dir (flag, or the
+    # launcher's TPUCFN_TRACE_DIR fan-out) every served batch lands an
+    # input_serve span whose (trace_id, span_id, origin) context rides
+    # the batch frame's header — the remote parent of the trainer's
+    # data_wait.  Unset ⇒ Tracer(None), zero wire or file cost.
+    trace_dir = (args.trace_dir
+                 or os.environ.get("TPUCFN_TRACE_DIR", "").strip() or None)
+    tracer = Tracer(trace_dir, host_id=host_id, role="input")
     service = InputService(
         shards, num_trainers=num_trainers,
         batch_size_per_process=args.batch_size, seed=args.seed,
@@ -670,14 +679,15 @@ def cmd_data_serve(args) -> int:
         send_deadline_s=args.send_deadline,
         registry=registry, shuffle=not args.no_shuffle,
         cache_in_memory=not args.stream,
-        num_workers=args.workers)
+        num_workers=args.workers, tracer=tracer)
     try:
         service.start()
         print(f"input service listening on {service.address} "
               f"({len(shards)} shards, {num_trainers} trainer stream(s))",
               file=sys.stderr)
         obs_srv = start_obs_server(registry, port=args.obs_port,
-                                   role="input", host_id=host_id)
+                                   role="input", host_id=host_id,
+                                   tracer=tracer)
         if obs_srv is not None:
             print(f"obs endpoint: {obs_srv.url()}", file=sys.stderr)
         # Under the ft fan-out an input host is a first-class fleet
@@ -709,6 +719,7 @@ def cmd_data_serve(args) -> int:
         service.wait_idle(args.idle_exit if args.idle_exit > 0 else None)
     finally:
         service.close()
+        tracer.close()
         if hb is not None:
             hb.stop()
         if obs_srv is not None:
@@ -743,13 +754,21 @@ def cmd_compilecache_serve(args) -> int:
     host_id = int(os.environ.get("TPUCFN_HOST_ID", "0") or 0)
     registry = MetricRegistry(labels={"role": "compilecache",
                                       "host": str(host_id)})
+    # Fleet timeline (ISSUE 20): artifact_serve spans record the
+    # requesting trainer's compile_fetch context as their remote
+    # parent.  Unset ⇒ Tracer(None), no cost.
+    from tpucfn.obs.trace import Tracer
+
+    trace_dir = (getattr(args, "trace_dir", None)
+                 or os.environ.get("TPUCFN_TRACE_DIR", "").strip() or None)
+    tracer = Tracer(trace_dir, host_id=host_id, role="compilecache")
     server = ArtifactServer(
         args.dir or default_store_dir(), host=args.host,
         port=args.port if args.port is not None
         else DEFAULT_COMPILE_CACHE_PORT,
         device_kind=args.device_kind or None,
         jax_version=args.jax_version or None,
-        registry=registry)
+        registry=registry, tracer=tracer)
     stop = [False]
 
     def _on_term(signum, frame):
@@ -773,6 +792,7 @@ def cmd_compilecache_serve(args) -> int:
             _time.sleep(0.2)
     finally:
         server.close()
+        tracer.close()
     m = registry.varz()["metrics"]
     print(_json.dumps({
         "served_s": round(_time.monotonic() - t0, 3),
@@ -1527,6 +1547,141 @@ def cmd_obs_diff(args) -> int:
     return 0
 
 
+def _trace_merge(args):
+    """Shared load for the trace subcommands: merge the run's per-host
+    span files onto the fleet clock, preferring the coordinator's
+    measured /clock probes when the run has them."""
+    from tpucfn.obs.timeline import merge_timeline
+
+    run_dir = Path(args.run_dir).expanduser()
+    trace_dir = Path(args.trace_dir) if args.trace_dir \
+        else run_dir / "trace"
+    if not trace_dir.is_dir():
+        print(f"error: no trace dir at {trace_dir} (run with tracing "
+              "enabled, or pass --trace-dir)", file=sys.stderr)
+        return None, None
+    offsets = Path(args.offsets) if args.offsets \
+        else run_dir / "ft" / "clock-offsets.jsonl"
+    merged = merge_timeline(
+        trace_dir, offsets_path=offsets if offsets.is_file() else None)
+    if not merged["events"]:
+        print(f"error: no span events under {trace_dir}", file=sys.stderr)
+        return None, None
+    return merged, run_dir
+
+
+def cmd_trace_export(args) -> int:
+    """Merge a run's per-host span files into one clock-aligned
+    Chrome/Perfetto trace (ISSUE 20 tentpole): process lanes per
+    (host, role), flow arrows on every resolved cross-host link —
+    load the output in https://ui.perfetto.dev or chrome://tracing."""
+    import json as _json
+
+    from tpucfn.obs.timeline import write_chrome_trace
+
+    merged, run_dir = _trace_merge(args)
+    if merged is None:
+        return 1
+    out = Path(args.out) if args.out else run_dir / "trace" / "timeline.json"
+    write_chrome_trace(merged, out)
+    stats = merged["link_stats"]
+    summary = {
+        "out": str(out), "events": len(merged["events"]),
+        "links_resolved": stats["resolved"],
+        "link_carriers": stats["carriers"],
+        "by_name": stats["by_name"],
+        "hosts_probed": sorted(merged["offsets"]),
+    }
+    if args.json:
+        print(_json.dumps(summary))
+    else:
+        print(f"wrote {out}: {summary['events']} events, "
+              f"{stats['resolved']}/{stats['carriers']} cross-host links "
+              f"resolved ({len(merged['offsets'])} host(s) on measured "
+              "clock offsets)")
+    return 0
+
+
+def cmd_trace_critpath(args) -> int:
+    """Per-step critical-path attribution (ISSUE 20 tentpole): walk
+    each trainer step's merged span tree, attribute wall time to planes
+    (compute / remote-serve / input-local / artifact-fetch / ckpt /
+    coordinator), print per-step "bounded by" verdicts — and cross-check
+    the aggregate shares against the goodput ledger when the run has
+    one."""
+    import json as _json
+
+    from tpucfn.obs.timeline import (critical_path, crosscheck_goodput,
+                                     render_critpath)
+
+    merged, run_dir = _trace_merge(args)
+    if merged is None:
+        return 1
+    cp = critical_path(merged)
+    if not cp["steps"]:
+        print("error: no trainer step spans in the merged timeline — "
+              "nothing to attribute", file=sys.stderr)
+        return 1
+    crosscheck = None
+    gp_dir = Path(args.goodput) if args.goodput else run_dir / "goodput"
+    if gp_dir.is_dir():
+        from tpucfn.obs.goodput import goodput_report
+
+        ev = run_dir / "ft" / "events.jsonl"
+        report = goodput_report(gp_dir, ev if ev.is_file() else None)
+        if report.get("num_hosts"):
+            crosscheck = crosscheck_goodput(cp, report)
+    if args.json:
+        print(_json.dumps({**cp, "crosscheck": crosscheck}))
+    else:
+        print(render_critpath(cp, crosscheck), end="")
+    return 0
+
+
+def cmd_trace_advise(args) -> int:
+    """Per-plane deadline autotune ADVISORY (ISSUE 20 satellite):
+    observed frame-time percentiles from the merged span timeline →
+    suggested deadline values, report-only — the operator changes the
+    flag, nothing auto-applies."""
+    import json as _json
+
+    from tpucfn.net.autotune import render_advice, suggest_deadlines
+
+    merged, _run_dir = _trace_merge(args)
+    if merged is None:
+        return 1
+    rows = suggest_deadlines(merged["events"], headroom=args.headroom,
+                             min_samples=args.min_samples)
+    if args.json:
+        print(_json.dumps(rows))
+    else:
+        print(render_advice(rows), end="")
+    return 0
+
+
+def cmd_forensics_diff(args) -> int:
+    """Diff two postmortem bundles of the same incident class
+    (ISSUE 20 satellite): same-window goodput bucket shares, per-host
+    heartbeat-age and span-count deltas — what did the second incident
+    do differently?"""
+    import json as _json
+
+    from tpucfn.obs.postmortem import diff_bundles, render_bundle_diff
+
+    for d in (args.bundle_a, args.bundle_b):
+        if not (Path(d) / "incident.json").is_file():
+            print(f"error: {d} is not a postmortem bundle (no "
+                  "incident.json — make one with `tpucfn obs "
+                  "postmortem`)", file=sys.stderr)
+            return 2
+    diff = diff_bundles(args.bundle_a, args.bundle_b)
+    if args.json:
+        print(_json.dumps(diff))
+    else:
+        print(render_bundle_diff(diff))
+    return 0
+
+
 def cmd_check(args) -> int:
     """Static analysis (ISSUE 10): run the concurrency/fleet-invariant
     rule pack over the package — jax-free, seconds, rc 1 on findings —
@@ -2173,6 +2328,10 @@ def build_parser() -> argparse.ArgumentParser:
                           "freed) after this long instead of pinning the "
                           "stream; must exceed the trainers' worst-case "
                           "step time (0 = disabled)")
+    dsv.add_argument("--trace-dir", default=None, metavar="DIR",
+                     help="write input_serve trace spans here (default: "
+                          "TPUCFN_TRACE_DIR; unset = tracing off) — the "
+                          "input-host half of the fleet timeline")
     dsv.set_defaults(fn=cmd_data_serve)
 
     cc = sub.add_parser(
@@ -2200,6 +2359,9 @@ def build_parser() -> argparse.ArgumentParser:
                      metavar="SECONDS",
                      help="exit cleanly after this long (0 = until "
                           "SIGTERM)")
+    ccs.add_argument("--trace-dir", default=None, metavar="DIR",
+                     help="write artifact_serve trace spans here "
+                          "(default: TPUCFN_TRACE_DIR; unset = off)")
     ccs.set_defaults(fn=cmd_compilecache_serve)
     ccg = ccsub.add_parser(
         "gc",
@@ -2456,6 +2618,78 @@ def build_parser() -> argparse.ArgumentParser:
     df.add_argument("--json", action="store_true", default=argparse.SUPPRESS,
                     help="emit the diff as one JSON object")
     df.set_defaults(fn=cmd_obs_diff)
+
+    tr = sub.add_parser(
+        "trace",
+        help="fleet timeline plane: clock-aligned Perfetto export, "
+             "per-step critical-path attribution, deadline advice")
+    trsub = tr.add_subparsers(dest="trace_command", required=True)
+
+    def _trace_common(tp):
+        tp.add_argument("--run-dir", required=True, metavar="DIR",
+                        help="the training run directory (traces under "
+                             "DIR/trace, clock probes under "
+                             "DIR/ft/clock-offsets.jsonl)")
+        tp.add_argument("--trace-dir", default=None, metavar="DIR",
+                        help="span-file directory (default: "
+                             "<run-dir>/trace)")
+        tp.add_argument("--offsets", default=None, metavar="FILE",
+                        help="coordinator clock-offsets.jsonl (default: "
+                             "<run-dir>/ft/clock-offsets.jsonl when "
+                             "present; absent = step-anchored estimate "
+                             "only)")
+        tp.add_argument("--json", action="store_true",
+                        help="emit machine-readable JSON")
+
+    te = trsub.add_parser(
+        "export",
+        help="merge per-host span files into one Chrome/Perfetto "
+             "trace-event JSON with cross-host flow arrows")
+    _trace_common(te)
+    te.add_argument("--out", default=None, metavar="FILE",
+                    help="output path (default: "
+                         "<run-dir>/trace/timeline.json)")
+    te.set_defaults(fn=cmd_trace_export)
+    tc = trsub.add_parser(
+        "critpath",
+        help="per-step critical-path attribution: which plane bounded "
+             "each step, with a goodput-ledger cross-check")
+    _trace_common(tc)
+    tc.add_argument("--goodput", default=None, metavar="DIR",
+                    help="goodput ledger dir for the aggregate "
+                         "cross-check (default: <run-dir>/goodput when "
+                         "present)")
+    tc.set_defaults(fn=cmd_trace_critpath)
+    ta = trsub.add_parser(
+        "advise",
+        help="deadline autotune ADVISORY from observed frame-time "
+             "percentiles (report-only)")
+    _trace_common(ta)
+    ta.add_argument("--headroom", type=float, default=8.0,
+                    help="suggested = clamp(p99 * headroom, 1s, "
+                         "current default)")
+    ta.add_argument("--min-samples", type=int, default=8,
+                    help="suggest nothing below this many observed "
+                         "frames")
+    ta.set_defaults(fn=cmd_trace_advise)
+
+    fo = sub.add_parser(
+        "forensics",
+        help="postmortem bundle tooling (diff two incidents)")
+    fosub = fo.add_subparsers(dest="forensics_command", required=True)
+    fd = fosub.add_parser(
+        "diff",
+        help="diff two postmortem bundles of the same incident class: "
+             "goodput-share and per-host deltas over each bundle's "
+             "window")
+    fd.add_argument("bundle_a", metavar="BUNDLE_A",
+                    help="earlier bundle dir (from `tpucfn obs "
+                         "postmortem`)")
+    fd.add_argument("bundle_b", metavar="BUNDLE_B",
+                    help="later bundle dir")
+    fd.add_argument("--json", action="store_true",
+                    help="emit the diff as one JSON object")
+    fd.set_defaults(fn=cmd_forensics_diff)
 
     return p
 

@@ -41,6 +41,7 @@ from typing import Callable, Sequence
 from tpucfn.data.service import (
     ServiceError,
     recv_frame,
+    recv_frame_ctx,
     send_frame,
 )
 from tpucfn.net.deadline import (
@@ -74,7 +75,13 @@ def cache_addrs_from_env(env: dict | None = None) -> list[str]:
 # -- wire protocol ----------------------------------------------------------
 
 CC_MAGIC = b"TPCC"  # tpucfn compile cache
-CC_PROTOCOL_VERSION = 1
+# v2 (ISSUE 20): the shared frame header (see data.service._HEADER)
+# grew three u64 trace-context fields — (trace_id, span_id, origin),
+# all-zero = none.  The client injects its compile_fetch span context
+# into the op frame; the server's artifact_serve span records it as
+# its remote parent, which is what lets the merged fleet timeline draw
+# the trainer-step -> artifact-fetch edge.
+CC_PROTOCOL_VERSION = 2
 
 # frame kinds (1 byte); HELLO/ERROR mirror the input plane's roles
 CC_HELLO = b"H"    # client -> server: JSON identity handshake
@@ -128,6 +135,7 @@ class ArtifactServer:
                  claim_ttl_s: float = 600.0,
                  send_deadline_s: float = 60.0,
                  registry=None,
+                 tracer=None,
                  clock: Callable[[], float] = time.monotonic):
         self.store = ArtifactStore(store_dir)
         self._bind_host = host
@@ -143,6 +151,10 @@ class ArtifactServer:
         # would otherwise pin this connection's thread for as long as
         # per-chunk timeouts keep resetting.
         self.send_deadline_s = float(send_deadline_s)
+        # Fleet timeline (ISSUE 20): one ``artifact_serve`` span per op,
+        # remote-parented on the requesting client's span context from
+        # the op frame header (its compile_fetch span).
+        self.tracer = tracer
         self.clock = clock
         self._claims: dict[str, float] = {}  # key -> expiry
         self._lock = threading.Lock()
@@ -277,15 +289,20 @@ class ArtifactServer:
                 return
             self._send(conn, CC_OK,
                        json.dumps({"v": CC_PROTOCOL_VERSION}).encode())
-            kind, payload = recv_frame(conn, magic=CC_MAGIC)
+            kind, payload, ctx = recv_frame_ctx(conn, magic=CC_MAGIC)
+            t_op = time.monotonic()
+            key = None
             if kind == CC_GET:
-                self._op_get(conn, bytes(payload).decode())
+                key = bytes(payload).decode()
+                self._op_get(conn, key)
             elif kind == CC_CLAIM:
-                self._op_claim(conn, bytes(payload).decode())
+                key = bytes(payload).decode()
+                self._op_claim(conn, key)
             elif kind == CC_PUT:
                 self._op_put(conn, payload)
             elif kind == CC_RELEASE:
-                self._op_release(conn, bytes(payload).decode())
+                key = bytes(payload).decode()
+                self._op_release(conn, key)
             elif kind == CC_STAT:
                 self._send(conn, CC_OK, json.dumps({
                     "entries": len(self.store.keys()),
@@ -296,6 +313,15 @@ class ArtifactServer:
             else:
                 self._send(conn, CC_ERROR,
                            f"unknown op {kind!r}".encode())
+            if self.tracer is not None and self.tracer.enabled:
+                # trace_id adopts the client's (the trainer step that
+                # triggered the fetch) so the server-side work lands in
+                # that step's tree on the merged timeline.
+                self.tracer.record(
+                    "artifact_serve", start=t_op, end=time.monotonic(),
+                    trace_id=(ctx[0] if ctx and ctx[0] else None),
+                    remote_parent=ctx, op=kind.decode(errors="replace"),
+                    **({"key": key} if key else {}))
         except DeadlineExceeded:
             # a response outlived its send deadline: the client is
             # stalled or trickling — drop the connection (it is one-op;
@@ -484,11 +510,13 @@ class ArtifactClient:
                     pass
             raise
 
-    def _op(self, kind: bytes, payload: bytes) -> tuple[bytes, bytearray]:
+    def _op(self, kind: bytes, payload: bytes,
+            ctx: tuple[int, int, int] | None = None
+            ) -> tuple[bytes, bytearray]:
         deadline = Deadline(self.op_deadline_s, label="compilecache op")
         sock = self._dial(deadline)
         try:
-            send_frame(sock, kind, payload, magic=CC_MAGIC,
+            send_frame(sock, kind, payload, magic=CC_MAGIC, ctx=ctx,
                        deadline=deadline)
             resp, body = recv_frame(sock, magic=CC_MAGIC, deadline=deadline)
         except DeadlineExceeded as e:
@@ -510,11 +538,16 @@ class ArtifactClient:
                 f"{bytes(body).decode(errors='replace')}")
         return resp, body
 
-    def get(self, key: str) -> tuple[bytes, dict] | None:
-        """``(payload, meta)`` or None on a miss.  The payload is
-        re-verified against the meta's sha256 HERE — a fetch torn
-        mid-transfer (or a lying server) raises, it never deserializes."""
-        resp, body = self._op(CC_GET, key.encode())
+    def get(self, key: str,
+            ctx: tuple[int, int, int] | None = None
+            ) -> tuple[bytes, dict] | None:
+        """``(payload, meta)`` or None on a miss.  ``ctx`` is the
+        caller's span context for the op frame header (ISSUE 20) —
+        the server's artifact_serve span remote-parents on it.  The
+        payload is re-verified against the meta's sha256 HERE — a fetch
+        torn mid-transfer (or a lying server) raises, it never
+        deserializes."""
+        resp, body = self._op(CC_GET, key.encode(), ctx=ctx)
         if resp == CC_MISS:
             return None
         if resp != CC_HIT:
@@ -702,8 +735,17 @@ class CompileCacheClient:
     def _fetch(self, clients, key: str, deserialize_fn):
         for c in clients:
             t0 = self.clock()
+            # Pre-mint the compile_fetch span id so the op frame can
+            # carry it (ISSUE 20): the server's artifact_serve span
+            # remote-parents on (origin, sid) and the merged timeline
+            # draws the fetch edge.  Failed attempts burn an id each —
+            # ids are plentiful, alignment is not.
+            sid = (self.tracer.next_span_id()
+                   if self.tracer is not None and self.tracer.enabled
+                   else None)
             try:
-                got = c.get(key)
+                got = c.get(key, ctx=((0, sid, self.tracer.origin)
+                                      if sid is not None else None))
             except ServiceError:
                 self.fetch_failures_c.add()
                 continue
@@ -722,6 +764,7 @@ class CompileCacheClient:
                     pass
             if self.tracer is not None:
                 self.tracer.record("compile_fetch", start=t0, dur_s=dt,
+                                   span_id=sid,
                                    key=key, label=label_or(meta, ""),
                                    addr=c.addr, bytes=len(payload))
             self.fetch_hits_c.add()

@@ -93,7 +93,7 @@ def input_addrs_from_env(env: dict | None = None) -> list[str]:
 # -- wire protocol ----------------------------------------------------------
 
 MAGIC = b"TPIB"  # tpucfn input batch
-PROTOCOL_VERSION = 1
+PROTOCOL_VERSION = 2  # v2 (ISSUE 20): trace context joined the header
 
 # frame kinds (1 byte)
 FRAME_HELLO = b"H"  # client -> server: JSON handshake
@@ -101,7 +101,25 @@ FRAME_BATCH = b"B"  # server -> client: one encoded batch
 FRAME_END = b"E"    # server -> client: stream complete (clean)
 FRAME_ERROR = b"X"  # server -> client: utf-8 reason, stream is dead
 
-_HEADER = struct.Struct("<4scI")  # magic, kind, payload length
+# Wire contract (shared by every plane built on this framing — input
+# batches here, compiled artifacts in ``compilecache.service``):
+#
+#     magic      4s   plane identity (TPIB / TPCC)
+#     kind       c    frame kind byte
+#     length     I    payload byte count
+#     trace_id   Q    \  sender's span context at send time (ISSUE 20):
+#     span_id    Q     } all-zero = no context.  (origin, span_id)
+#     origin     Q    /  names the sender-side span fleet-uniquely
+#                        (origin = obs.trace.origin_id(role, host_id));
+#                        trace_id is the step / batch cursor / request
+#                        that triggered the frame, 0 = none.
+#
+# The receiver's span that consumes or answers the frame records the
+# triple as its ``rp`` (remote parent) so the offline merger can draw
+# the cross-host edge.  The header grew 24 bytes in protocol v2; mixed
+# fleets fail the HELLO version check (and misframe loudly before it).
+_HEADER = struct.Struct("<4scIQQQ")
+_NO_CTX = (0, 0, 0)
 MAX_FRAME_BYTES = 1 << 31  # sanity bound: a torn header must not OOM us
 
 
@@ -157,25 +175,42 @@ def decode_batch(payload: bytes | bytearray) -> dict[str, np.ndarray]:
 
 def send_frame(sock: socket.socket, kind: bytes, payload: bytes, *,
                magic: bytes = MAGIC,
-               deadline: Deadline | None = None) -> None:
+               deadline: Deadline | None = None,
+               ctx: tuple[int, int, int] | None = None) -> None:
     """Length-prefixed framing.  ``magic`` distinguishes the planes that
     share this idiom (input batches here; compiled-artifact frames in
     :mod:`tpucfn.compilecache.service`) so a client dialed at the wrong
     port fails the handshake loudly instead of mis-parsing payloads.
+
+    ``ctx`` is the sender's span context ``(trace_id, span_id, origin)``
+    riding the header (ISSUE 20) — None sends all-zero, meaning "no
+    context"; a trace_id of None maps to 0 the same way.
 
     ``deadline`` bounds the WHOLE frame end to end (ISSUE 15): without
     it, a stalled or trickling receiver pins ``sendall`` for as long as
     the socket timeout keeps resetting — with it the send is chunked
     and every chunk draws from the one shrinking budget, raising
     :class:`~tpucfn.net.deadline.DeadlineExceeded` on expiry."""
+    tid, sid, org = ctx if ctx is not None else _NO_CTX
+    head = _HEADER.pack(magic, kind, len(payload),
+                        _wire_u64(tid), _wire_u64(sid), _wire_u64(org))
     if deadline is None:
-        sock.sendall(_HEADER.pack(magic, kind, len(payload)))
+        sock.sendall(head)
         if payload:
             sock.sendall(payload)
         return
-    sendall_deadline(sock, _HEADER.pack(magic, kind, len(payload)), deadline)
+    sendall_deadline(sock, head, deadline)
     if payload:
         sendall_deadline(sock, payload, deadline)
+
+
+def _wire_u64(v) -> int:
+    """Clamp a context component onto the header's u64: None and
+    non-int trace_ids (serve request strings) ride as 0 — the wire
+    carries only resolvable numeric identities."""
+    if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+        return 0
+    return v & 0xFFFFFFFFFFFFFFFF
 
 
 def _recv_exact(sock: socket.socket, n: int,
@@ -208,14 +243,27 @@ def _recv_exact(sock: socket.socket, n: int,
 
 def recv_frame(sock: socket.socket, *, magic: bytes = MAGIC,
                deadline: Deadline | None = None) -> tuple[bytes, bytearray]:
+    kind, payload, _ctx = recv_frame_ctx(sock, magic=magic,
+                                         deadline=deadline)
+    return kind, payload
+
+
+def recv_frame_ctx(
+    sock: socket.socket, *, magic: bytes = MAGIC,
+    deadline: Deadline | None = None,
+) -> tuple[bytes, bytearray, tuple[int, int, int] | None]:
+    """:func:`recv_frame` plus the header's span context —
+    ``(trace_id, span_id, origin)``, or None when the sender carried no
+    context (all-zero span/origin)."""
     head = _recv_exact(sock, _HEADER.size, deadline)
-    got_magic, kind, length = _HEADER.unpack(bytes(head))
+    got_magic, kind, length, tid, sid, org = _HEADER.unpack(bytes(head))
     if got_magic != magic:
         raise ServiceError(f"bad frame magic {got_magic!r}")
     if length > MAX_FRAME_BYTES:
         raise ServiceError(f"frame length {length} exceeds sanity bound")
+    ctx = (tid, sid, org) if sid and org else None
     return kind, (_recv_exact(sock, length, deadline) if length
-                  else bytearray())
+                  else bytearray()), ctx
 
 
 # -- the service (input-host side) ------------------------------------------
@@ -250,6 +298,7 @@ class InputService:
                  sndbuf_bytes: int | None = None,
                  send_deadline_s: float = 120.0,
                  hello_timeout_s: float = 30.0,
+                 tracer=None,
                  **ds_kwargs):
         if num_trainers < 1:
             raise ValueError(f"num_trainers must be >= 1, got {num_trainers}")
@@ -280,6 +329,12 @@ class InputService:
         # while a step runs) — it bounds the half-dead, not the slow.
         self.send_deadline_s = float(send_deadline_s)
         self.hello_timeout_s = float(hello_timeout_s)
+        # Fleet timeline (ISSUE 20): one ``input_serve`` span per BATCH
+        # frame — encode start through send complete, trace_id = the
+        # batch cursor — whose pre-minted span id rides the frame
+        # header so the trainer's data_wait records it as its remote
+        # parent.  Tracer is thread-safe; streams share it.
+        self.tracer = tracer
         self.ds_kwargs = dict(ds_kwargs)
         if self.mp_workers > 0 and self.ds_kwargs.get("num_workers"):
             # Two decode axes at once is a config error, not a silent
@@ -522,7 +577,8 @@ class _Stream:
                     # the skipped batches (the augmentation RNG advances
                     # with them), it just doesn't ship them.
                     continue
-                self._enqueue(encode_batch(batch))
+                t_enc = time.monotonic()
+                self._enqueue(("batch", cursor, t_enc, encode_batch(batch)))
             self._enqueue(None)  # clean end marker
         except Exception as e:  # noqa: BLE001 — surfaced as an error frame
             svc.stream_errors_c.add()
@@ -591,12 +647,27 @@ class _Stream:
                 if item is None:
                     self._send(FRAME_END, b"")
                     return
-                if isinstance(item, tuple):  # ("error", reason)
+                if item[0] == "error":  # ("error", reason)
                     self._send(FRAME_ERROR, item[1].encode())
                     return
-                self._send(FRAME_BATCH, item)
+                _tag, cursor, t_enc, payload = item
+                tr = svc.tracer
+                if tr is not None and tr.enabled:
+                    # Span id minted BEFORE the send so the frame header
+                    # carries it; the span itself (encode start → send
+                    # complete, i.e. serve work plus backpressure wait)
+                    # is written after, under the same id.
+                    sid = tr.next_span_id()
+                    self._send(FRAME_BATCH, payload,
+                               ctx=(cursor, sid, tr.origin))
+                    tr.record("input_serve", start=t_enc,
+                              end=time.monotonic(), span_id=sid,
+                              trace_id=cursor, trainer=trainer,
+                              frame_bytes=len(payload))
+                else:
+                    self._send(FRAME_BATCH, payload)
                 svc.batches_c.add()
-                svc.bytes_c.add(len(item))
+                svc.bytes_c.add(len(payload))
         except DeadlineExceeded:
             # One frame exceeded its end-to-end send deadline: the
             # trainer is stalled or blackholed, not merely busy — drop
@@ -627,14 +698,15 @@ class _Stream:
             with svc._lock:
                 svc._last_activity = time.monotonic()
 
-    def _send(self, kind: bytes, payload: bytes) -> None:
+    def _send(self, kind: bytes, payload: bytes,
+              ctx: tuple[int, int, int] | None = None) -> None:
         """One frame under its own end-to-end deadline (ISSUE 15
         satellite: the bound on how long a gray trainer can pin this
         stream).  0 disables the bound — the sibling-knob convention
         (``--serve-for 0``, ``duration_s=0``) — rather than minting an
         already-expired deadline that drops every stream at frame 1."""
         s = self.service.send_deadline_s
-        send_frame(self.conn, kind, payload,
+        send_frame(self.conn, kind, payload, ctx=ctx,
                    deadline=(Deadline(s, label="input send")
                              if s > 0 else None))
 
@@ -739,6 +811,12 @@ class ServiceBatchStream:
             self.close()
             raise ServiceError(f"handshake to {addr}: {e}") from None
         self._ended = False
+        # span context of the most recent BATCH frame (ISSUE 20): the
+        # input host's (cursor, input_serve span_id, origin), read off
+        # the frame header — what the consumer's data_wait span records
+        # as its remote parent.  None until a batch arrives or when the
+        # serving host traces nothing.
+        self.last_ctx: tuple[int, int, int] | None = None
 
     def __iter__(self):
         return self
@@ -747,7 +825,7 @@ class ServiceBatchStream:
         if self._ended:
             raise StopIteration
         try:
-            kind, payload = recv_frame(
+            kind, payload, ctx = recv_frame_ctx(
                 self._sock,
                 deadline=Deadline(self.op_deadline_s, label="input batch"))
         except DeadlineExceeded as e:
@@ -762,6 +840,7 @@ class ServiceBatchStream:
             self.close()
             raise ServiceError(f"stream from {self.addr}: {e}") from None
         if kind == FRAME_BATCH:
+            self.last_ctx = ctx
             return decode_batch(payload)
         if kind == FRAME_END:
             self._ended = True
@@ -849,6 +928,18 @@ class ResilientBatchStream:
             seed=self.trainer)
         self.cursor = 0  # batches already yielded
         self.degraded = False
+        # Cross-host link FIFO (ISSUE 20): one entry per yielded batch —
+        # the serving host's span context for a served batch, None for a
+        # locally loaded one.  Consumers that care (the train loop) call
+        # :meth:`pop_link` once per consumed batch; because every buffer
+        # between here and the consumer (AdaptivePrefetcher,
+        # prefetch_to_mesh) is strictly FIFO, position alone pairs link
+        # to batch.  Bounded: an integration that never pops (benches,
+        # rl) must not leak one tuple per batch forever — past the cap
+        # the FIFO poisons itself and pop_link returns None for the
+        # rest of the run (an honest "no link" beats a misaligned one).
+        self._links: deque = deque()
+        self._links_poisoned = False
         self._local: Iterator[dict] | None = None
         self._stream: ServiceBatchStream | None = None
         self._tried = 0  # next index into _addrs to try
@@ -906,6 +997,7 @@ class ResilientBatchStream:
             if self._local is not None:
                 batch = next(self._local)  # StopIteration propagates
                 self.cursor += 1
+                self._push_link(None)
                 return batch
             if self._stream is None:
                 self._stream = self._next_stream()
@@ -920,7 +1012,28 @@ class ResilientBatchStream:
                 self._stream = None
                 continue  # failover (remaining addrs) or degrade
             self.cursor += 1
+            self._push_link(self._stream.last_ctx)
             return batch
+
+    _LINKS_CAP = 4096
+
+    def _push_link(self, ctx) -> None:
+        if self._links_poisoned:
+            return
+        if len(self._links) >= self._LINKS_CAP:
+            self._links.clear()
+            self._links_poisoned = True
+            return
+        self._links.append(ctx)
+
+    def pop_link(self) -> tuple[int, int, int] | None:
+        """The span context paired with the OLDEST not-yet-claimed
+        yielded batch (None for a local/untraced one).  Call exactly
+        once per consumed batch; FIFO buffering between this stream and
+        the consumer keeps the pairing exact at any prefetch depth."""
+        if self._links_poisoned or not self._links:
+            return None
+        return self._links.popleft()
 
     def close(self) -> None:
         if self._stream is not None:
@@ -1091,6 +1204,14 @@ class AdaptivePrefetcher:
         self.controller.observe(now - t0, busy)
         self._last_return = now
         return item
+
+    def pop_link(self) -> tuple[int, int, int] | None:
+        """Delegate to the wrapped stream's link FIFO (ISSUE 20).  The
+        buffer here is strictly FIFO, so link/batch pairing survives
+        any prefetch depth; None when the source has no links (local
+        loader, untraced service)."""
+        pop = getattr(self.it, "pop_link", None)
+        return pop() if pop is not None else None
 
     def close(self) -> None:
         self._stop.set()

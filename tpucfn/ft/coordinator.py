@@ -72,6 +72,7 @@ from __future__ import annotations
 import json
 import os
 import signal
+import threading
 import time
 from pathlib import Path
 from typing import Callable, Sequence
@@ -154,6 +155,10 @@ class GangCoordinator(ChaosTarget):
         sleep: Callable[[float], None] = time.sleep,
         capture_flight: bool = True,
         flight_timeout_s: float = 2.0,
+        capture_spans: bool = True,
+        span_tail_lines: int = 500,
+        profile_on_incident_s: float = 0.0,
+        clock_probe_interval_s: float = 30.0,
         drain_grace_s: float = 30.0,
         drain_step_margin: int = 2,
         allow_shrink: bool = True,
@@ -198,6 +203,20 @@ class GangCoordinator(ChaosTarget):
         self.sleep = sleep
         self.capture_flight = capture_flight
         self.flight_timeout_s = flight_timeout_s
+        # Fleet timeline plane (ISSUE 20): at detect time the survivors'
+        # span tails (and optionally a short jax profile) join the
+        # flight rings in the forensics pull; on the heartbeat cadence
+        # the coordinator probes every live obs /clock endpoint so the
+        # merged timeline aligns hosts on MEASURED offsets instead of
+        # the step-anchored estimate.  profile_on_incident_s is 0 (off)
+        # by default — a profile capture blocks the incident path for
+        # its whole duration, which is an operator's call, not ours.
+        self.capture_spans = capture_spans
+        self.span_tail_lines = span_tail_lines
+        self.profile_on_incident_s = profile_on_incident_s
+        self.clock_probe_interval_s = float(clock_probe_interval_s)
+        self._next_clock_probe = 0.0
+        self._clock_probe_thread: threading.Thread | None = None
         self.drain_grace_s = drain_grace_s
         self.drain_step_margin = drain_step_margin
         self.allow_shrink = allow_shrink
@@ -579,6 +598,158 @@ class GangCoordinator(ChaosTarget):
             self._event("flight_capture", incident=incident,
                         hosts=captured, errors=errors)
 
+    # -- span-tail + profile capture (ISSUE 20) ---------------------------
+
+    def _capture_spans(self, incident: int, failed: set[int]) -> None:
+        """Pull every surviving host's span tail (``GET /tracetail``)
+        — and, when ``profile_on_incident_s`` > 0, a short
+        ``POST /profile`` — into ``<ft_dir>/spans/`` BEFORE the gang is
+        stopped.  Same concurrency contract as :meth:`_capture_flight`
+        (one worker per survivor, one shared deadline): span tails are
+        the causal half of the flight rings — the rings say what each
+        host was doing, the tails say which remote spans CAUSED it —
+        and both die with the restart."""
+        base = getattr(self.launcher, "obs_base_port", None)
+        if not base or self.ft_dir is None or not self.capture_spans:
+            return
+        import concurrent.futures
+        import urllib.request
+
+        hosts = self.launcher.contract.hosts()[
+            : self.launcher.contract.workers_count]
+        targets = [(h, hosts[h].rsplit(":", 1)[0])
+                   for h, p in sorted(self._procs.items())
+                   if h not in failed and p.poll() is None]
+        if not targets:
+            return
+        profile_s = self.profile_on_incident_s
+        deadline = self.flight_timeout_s + max(0.0, profile_s)
+
+        def fetch(host_id: int, addr: str) -> dict:
+            port = base + 1 + host_id
+            url = (f"http://{addr}:{port}/tracetail"
+                   f"?lines={self.span_tail_lines}")
+            with urllib.request.urlopen(
+                    url, timeout=self.flight_timeout_s) as r:
+                body = json.loads(r.read().decode())
+            if profile_s > 0:
+                try:
+                    req = urllib.request.Request(
+                        f"http://{addr}:{port}/profile?seconds={profile_s}",
+                        method="POST")
+                    with urllib.request.urlopen(
+                            req, timeout=deadline) as r:
+                        body["profile"] = json.loads(r.read().decode())
+                except Exception:  # noqa: BLE001 — profile is optional
+                    pass
+            return body
+
+        out_dir = self.ft_dir / "spans"
+        captured, errors = [], 0
+        pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=len(targets), thread_name_prefix="span-capture")
+        try:
+            futs = {pool.submit(fetch, h, addr): h for h, addr in targets}
+            done, pending = concurrent.futures.wait(
+                futs, timeout=deadline + 0.5)
+            errors += len(pending)
+            for f in done:
+                host_id = futs[f]
+                try:
+                    body = f.result()
+                except Exception:  # noqa: BLE001 — best-effort
+                    errors += 1
+                    continue
+                events = body.get("events") if isinstance(body, dict) \
+                    else None
+                if not isinstance(events, list):
+                    errors += 1
+                    continue
+                out_dir.mkdir(parents=True, exist_ok=True)
+                # One JSON line per event — the same shape as the source
+                # trace file, so read_trace_file / the postmortem's
+                # timeline merge ingest a tail exactly like a full file.
+                path = out_dir / (f"incident{incident:03d}"
+                                  f"-host{host_id:03d}.jsonl")
+                with open(path, "w") as fh:
+                    for e in events:
+                        fh.write(json.dumps(e) + "\n")
+                if isinstance(body.get("profile"), dict):
+                    (out_dir / (f"incident{incident:03d}"
+                                f"-host{host_id:03d}-profile.json")
+                     ).write_text(json.dumps(body["profile"], indent=2))
+                captured.append(host_id)
+        finally:
+            pool.shutdown(wait=False)
+        captured.sort()
+        if captured or errors:
+            self._event("span_capture", incident=incident,
+                        hosts=captured, errors=errors,
+                        profiled=bool(profile_s > 0))
+
+    # -- clock probes (ISSUE 20) ------------------------------------------
+
+    def _clock_probe_tick(self, now: float) -> None:
+        """On the probe cadence, measure every live host's wall-clock
+        offset over its obs ``/clock`` route and append the probes to
+        ``<ft_dir>/clock-offsets.jsonl`` — the measured half of the
+        merged timeline's fleet clock (``obs.timeline.fleet_skew``).
+        Probing runs on a background daemon thread (skipped while the
+        previous round is still in flight) so a slow endpoint can never
+        stretch the supervise loop's poll cadence."""
+        base = getattr(self.launcher, "obs_base_port", None)
+        if (not base or self.ft_dir is None
+                or self.clock_probe_interval_s <= 0
+                or now < self._next_clock_probe):
+            return
+        t = self._clock_probe_thread
+        if t is not None and t.is_alive():
+            return  # previous round still probing — keep its cadence
+        self._next_clock_probe = now + self.clock_probe_interval_s
+        hosts = self.launcher.contract.hosts()[
+            : self.launcher.contract.workers_count]
+        targets = [(h, hosts[h].rsplit(":", 1)[0])
+                   for h, p in sorted(self._procs.items())
+                   if p.poll() is None]
+        if not targets:
+            return
+        path = self.ft_dir / "clock-offsets.jsonl"
+
+        def probe_round() -> None:
+            from tpucfn.obs.timeline import probe_clock
+
+            rows = []
+            for host_id, addr in targets:
+                url = f"http://{addr}:{base + 1 + host_id}/clock"
+                try:
+                    pr = probe_clock(url, timeout_s=self.flight_timeout_s)
+                except Exception:  # noqa: BLE001 — a dead endpoint is
+                    continue       # the incident path's problem, not ours
+                rows.append({"kind": "clock_probe",
+                             "host": host_id if pr.host is None else pr.host,
+                             "role": pr.role,
+                             "offset_s": round(pr.offset_s, 9),
+                             "unc_s": round(pr.unc_s, 9),
+                             "rtt_s": round(pr.rtt_s, 9),
+                             "t": time.time()})
+            if rows:
+                with open(path, "a") as f:
+                    for r in rows:
+                        f.write(json.dumps(r) + "\n")
+            else:
+                # nothing answered — almost always startup: the workers'
+                # obs servers aren't bound yet.  Retry soon instead of
+                # burning the whole cadence (a short run would otherwise
+                # never land a single probe).
+                self._next_clock_probe = min(
+                    self._next_clock_probe,
+                    time.monotonic() + min(5.0, self.clock_probe_interval_s))
+
+        self._clock_probe_thread = threading.Thread(
+            target=probe_round, daemon=True,
+            name="tpucfn-clock-probe")
+        self._clock_probe_thread.start()
+
     # -- event / snapshot plumbing ---------------------------------------
 
     def _event(self, kind: str, **fields) -> None:
@@ -595,10 +766,17 @@ class GangCoordinator(ChaosTarget):
     def _j(self, kind: str, **fields) -> None:
         """Append one write-ahead journal record (no-op without a
         journal — ft_dir unset, or a ctor-only coordinator that never
-        entered run())."""
+        entered run()).  The fsync'd commit is timed as a
+        ``journal_commit`` span (ISSUE 20): on the merged timeline the
+        coordinator plane's cost per incident is visible next to the
+        recovery spans it gates."""
         if self._journal is None:
             return
+        t0 = time.monotonic()
         self._journal.append(kind, **fields)
+        if self.tracer is not None:
+            self.tracer.record("journal_commit", start=t0,
+                               end=time.monotonic(), journal_kind=kind)
         self.coord_journal_c.add()
 
     def _write_snapshot(self) -> None:
@@ -861,6 +1039,7 @@ class GangCoordinator(ChaosTarget):
                         self._event("done", rc=rc)
                         return rc
                     self._provision_tick(now)
+                    self._clock_probe_tick(now)
                     continue
                 rc = self._handle_incident(failures)
                 if rc is not None:
@@ -1414,9 +1593,11 @@ class GangCoordinator(ChaosTarget):
             self.tracer.event("ft_detect", trace_id=incident,
                               failures=fail_json)
         if real:
-            # Forensics before recovery: the survivors' flight rings are
-            # about to be killed with the gang (ISSUE 6 tentpole).
+            # Forensics before recovery: the survivors' flight rings
+            # and span tails are about to be killed with the gang
+            # (ISSUE 6 tentpole; span tails ISSUE 20).
             self._capture_flight(incident, {f.host_id for f in real})
+            self._capture_spans(incident, {f.host_id for f in real})
         # Checkpoint-corruption retry (ISSUE 7): a gang whose ranks exit
         # with the restore-failure rc is not a fleet failure — the
         # artifact is bad.  Retry from the previous finalized step

@@ -26,6 +26,8 @@ EVENT_KINDS = (
     "detect",          # failures observed: [{host, kind, rc, step, detail}]
     "decide",          # policy verdict for an incident
     "flight_capture",  # survivors' flight rings captured at detect time
+    "span_capture",    # survivors' span tails (+ optional profiles)
+                       # captured at detect time (ISSUE 20)
     "recovered",       # incident closed: action, mttr_s
     "give_up",         # restart budget exhausted / unrecoverable
     "goodput_incident",  # goodput attribution row (downtime, lost work)

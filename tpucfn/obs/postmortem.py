@@ -34,13 +34,14 @@ from pathlib import Path
 from typing import Iterable
 
 from tpucfn.ft.heartbeat import HB_GLOB
-from tpucfn.obs.aggregate import (apply_clock_skew, estimate_clock_skew,
-                                  render_table, window_events)
+from tpucfn.obs.aggregate import (apply_clock_skew, render_table,
+                                  window_events)
 from tpucfn.obs.flight import FLIGHT_GLOB, read_flight_dir
 from tpucfn.obs.goodput import (_incidents_from_events, host_id_from_path,
                                 merge_goodput, read_ft_events,
                                 read_goodput_dir, read_jsonl_counting)
-from tpucfn.obs.trace import read_trace_dir
+from tpucfn.obs.timeline import fleet_skew, read_clock_offsets
+from tpucfn.obs.trace import read_trace_dir, read_trace_file
 
 DEFAULT_WINDOW_S = 15.0
 
@@ -150,8 +151,34 @@ def build_postmortem(run_dir: str | Path, *,
     trace_events = read_trace_dir(trace_dir) if trace_dir.is_dir() else []
     if not trace_events:
         notes.append(f"no trace spans under {trace_dir}")
+    # Span tails (ISSUE 20): the coordinator's at-detect /tracetail
+    # captures — the survivors' last spans, pulled before the restart
+    # erased nothing (files are durable) but the postmortem may run on
+    # a machine that only has ft_dir.  They back-fill the timeline when
+    # the run dir's trace files are absent.
+    span_tail_rows = []
+    tail_events: list[dict] = []
+    for p in sorted((ft_dir / "spans").glob(
+            f"incident{inc_id:03d}-host*.jsonl")):
+        evts = read_trace_file(p)
+        tail_events.extend(evts)
+        host = host_id_from_path(p)
+        profile = p.with_name(p.stem + "-profile.json")
+        span_tail_rows.append({
+            "host": host, "events": len(evts),
+            "profile": str(profile) if profile.is_file() else None,
+            "path": str(p)})
+    if not trace_events and tail_events:
+        trace_events = sorted(tail_events,
+                              key=lambda e: (e.get("ts", 0.0),
+                                             e.get("mono", 0.0)))
+        notes.append("timeline built from the coordinator's at-detect "
+                     "span tails (no run-dir trace files)")
     hb_full = _read_heartbeats_full(ft_dir)
-    skew = estimate_clock_skew(trace_events, hb_full or None)
+    # Measured clock offsets (coordinator /clock probes) win over the
+    # step-anchored estimate wherever a probe exists.
+    offsets = read_clock_offsets(ft_dir / "clock-offsets.jsonl")
+    skew = fleet_skew(trace_events, offsets, hb_full or None)
     corrected = apply_clock_skew(trace_events, skew)
     timeline = (window_events(corrected, window[0], window[1])
                 if window[0] is not None else [])
@@ -252,9 +279,11 @@ def build_postmortem(run_dir: str | Path, *,
         "window": {"start": window[0], "end": window[1],
                    "window_s": window_s},
         "clock_skew_s": skew,
+        "clock_offsets": offsets,
         "timeline": timeline,
         "goodput": goodput,
         "flight": flight_rows,
+        "span_tails": span_tail_rows,
         "heartbeats": heartbeats,
         "skipped_event_lines": ev_skipped,
         "notes": notes,
@@ -275,6 +304,7 @@ def write_bundle(report: dict, out_dir: str | Path) -> Path:
         {"incident": report["incident"], "events": report["events"],
          "detect_ts": report["detect_ts"], "window": report["window"],
          "clock_skew_s": report["clock_skew_s"],
+         "clock_offsets": report.get("clock_offsets") or {},
          "notes": report["notes"]}, indent=2))
     (out / "goodput.json").write_text(json.dumps(report["goodput"],
                                                  indent=2))
@@ -289,6 +319,15 @@ def write_bundle(report: dict, out_dir: str | Path) -> Path:
         if src.is_file():
             flight_dir.mkdir(parents=True, exist_ok=True)
             shutil.copy(src, flight_dir / f"{row['source']}-{src.name}")
+    # Span tails + their optional profile artifacts (ISSUE 20) ride
+    # along the same way the flight dumps do: the bundle must stay
+    # readable after ft_dir is cleaned.
+    spans_dir = out / "spans"
+    for row in report.get("span_tails") or []:
+        for src in (row.get("path"), row.get("profile")):
+            if src and Path(src).is_file():
+                spans_dir.mkdir(parents=True, exist_ok=True)
+                shutil.copy(src, spans_dir / Path(src).name)
     (out / "report.md").write_text(render_postmortem(report) + "\n")
     return out
 
@@ -339,6 +378,12 @@ def render_postmortem(report: dict) -> str:
         lines.append(render_table(
             report["flight"],
             ["host", "source", "samples", "dropped", "gap_to_detect_s"]))
+    if report.get("span_tails"):
+        lines += ["", "## span tails captured at detect"]
+        lines.append(render_table(
+            [{**r, "profiled": bool(r.get("profile"))}
+             for r in report["span_tails"]],
+            ["host", "events", "profiled"]))
     gp = report["goodput"]
     if gp["num_hosts"]:
         lines += ["", f"## goodput over the window "
@@ -349,9 +394,170 @@ def render_postmortem(report: dict) -> str:
         lines.append(render_table(rows, ["bucket", "seconds"]))
     n = len(report["timeline"])
     skewed = sum(1 for s in report["clock_skew_s"].values() if s)
+    probed = len(report.get("clock_offsets") or {})
     lines += ["", f"## timeline: {n} events in window "
-                  f"(skew-corrected; {skewed} host(s) adjusted) — "
+                  f"(skew-corrected; {skewed} host(s) adjusted, "
+                  f"{probed} from measured /clock probes) — "
                   "timeline.jsonl"]
     for note in report["notes"]:
+        lines.append(f"NOTE: {note}")
+    return "\n".join(lines)
+
+
+# -- bundle diffing (ISSUE 20 satellite) ------------------------------------
+
+def _read_bundle(d: str | Path) -> dict:
+    """One :func:`write_bundle` directory parsed back (missing pieces
+    degrade to empty, same contract as assembly — a diff of two bundles
+    must survive either being partial)."""
+    d = Path(d)
+
+    def _json(name: str, default):
+        p = d / name
+        if not p.is_file():
+            return default
+        try:
+            return json.loads(p.read_text())
+        except json.JSONDecodeError:
+            return default
+
+    timeline = []
+    p = d / "timeline.jsonl"
+    if p.is_file():
+        for line in p.read_text().splitlines():
+            try:
+                timeline.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return {"path": str(d),
+            "incident": _json("incident.json", {}),
+            "goodput": _json("goodput.json", {}),
+            "heartbeats": _json("heartbeats.json", []),
+            "timeline": timeline}
+
+
+def diff_bundles(a_dir: str | Path, b_dir: str | Path) -> dict:
+    """Two postmortem bundles of the SAME incident class diffed like
+    goodput ledger rows (``b - a``): what did the second incident do
+    differently?  Sections:
+
+    * ``incident`` — action/planned/downtime/detection/lost-step deltas,
+      with ``class_match`` False (and a note) when the two incidents
+      are different classes (action+planned differ) — the deltas are
+      still printed, the caller just can't read them as a regression;
+    * ``buckets`` — the windows' goodput bucket seconds, normalized to
+      shares of each bundle's own window so different window widths
+      still compare;
+    * ``hosts`` — per-host heartbeat-age-at-detect and timeline span
+      count deltas (union of hosts, either side may miss one).
+    """
+    a, b = _read_bundle(a_dir), _read_bundle(b_dir)
+    ia = (a["incident"].get("incident") or {})
+    ib = (b["incident"].get("incident") or {})
+    cls_a = (ia.get("action"), bool(ia.get("planned")))
+    cls_b = (ib.get("action"), bool(ib.get("planned")))
+    notes = []
+    if cls_a != cls_b:
+        notes.append(
+            f"incident classes differ ({cls_a[0]}/planned={cls_a[1]} vs "
+            f"{cls_b[0]}/planned={cls_b[1]}) — deltas below compare "
+            "unlike incidents")
+
+    def _delta(key):
+        x, y = ia.get(key), ib.get(key)
+        return {"a": x, "b": y,
+                "delta": (round(y - x, 6)
+                          if isinstance(x, (int, float))
+                          and isinstance(y, (int, float)) else None)}
+
+    incident = {
+        "a_incident": ia.get("incident"), "b_incident": ib.get("incident"),
+        "class_match": cls_a == cls_b,
+        "action": {"a": cls_a[0], "b": cls_b[0]},
+        "downtime_s": _delta("downtime_s"),
+        "detection_s": _delta("detection_s"),
+        "lost_steps": _delta("lost_steps"),
+    }
+
+    def _shares(g):
+        buckets = g.get("buckets") or {}
+        total = sum(v for v in buckets.values()
+                    if isinstance(v, (int, float))) or None
+        return {k: (v / total if total else None)
+                for k, v in buckets.items()
+                if isinstance(v, (int, float))}
+
+    sa, sb = _shares(a["goodput"]), _shares(b["goodput"])
+    buckets = []
+    for name in sorted(set(sa) | set(sb)):
+        x, y = sa.get(name), sb.get(name)
+        buckets.append({"bucket": name, "a_share": x, "b_share": y,
+                        "delta": (round(y - x, 6)
+                                  if x is not None and y is not None
+                                  else None)})
+
+    def _hb_age(bundle):
+        return {h.get("host"): h.get("age_at_detect_s")
+                for h in bundle["heartbeats"] if h.get("host") is not None}
+
+    def _span_counts(bundle):
+        out: dict[int, int] = {}
+        for e in bundle["timeline"]:
+            h = e.get("host")
+            if h is not None:
+                out[h] = out.get(h, 0) + 1
+        return out
+
+    ha, hb = _hb_age(a), _hb_age(b)
+    ca, cb = _span_counts(a), _span_counts(b)
+    hosts = []
+    for h in sorted(set(ha) | set(hb) | set(ca) | set(cb)):
+        ax, bx = ha.get(h), hb.get(h)
+        hosts.append({
+            "host": h,
+            "a_hb_age_s": ax, "b_hb_age_s": bx,
+            "hb_age_delta_s": (round(bx - ax, 3)
+                               if isinstance(ax, (int, float))
+                               and isinstance(bx, (int, float)) else None),
+            "a_spans": ca.get(h, 0), "b_spans": cb.get(h, 0),
+            "span_delta": cb.get(h, 0) - ca.get(h, 0)})
+
+    return {"a": a["path"], "b": b["path"], "incident": incident,
+            "buckets": buckets, "hosts": hosts, "notes": notes,
+            "window_s": {"a": (a["incident"].get("window") or {})
+                         .get("window_s"),
+                         "b": (b["incident"].get("window") or {})
+                         .get("window_s")}}
+
+
+def render_bundle_diff(diff: dict) -> str:
+    """Human rendering of :func:`diff_bundles` (``tpucfn forensics
+    diff``)."""
+    inc = diff["incident"]
+    lines = [f"# forensics diff — incident {inc['a_incident']} "
+             f"({Path(diff['a']).name}) vs incident {inc['b_incident']} "
+             f"({Path(diff['b']).name})"]
+    if not inc["class_match"]:
+        lines.append("WARNING: different incident classes — read deltas "
+                     "as context, not regression")
+    lines.append(
+        f"action: {inc['action']['a']} vs {inc['action']['b']}")
+    for key in ("downtime_s", "detection_s", "lost_steps"):
+        d = inc[key]
+        lines.append(f"{key}: {d['a']} vs {d['b']}"
+                     + (f"  (delta {d['delta']:+g})"
+                        if d["delta"] is not None else ""))
+    if diff["buckets"]:
+        lines += ["", "## goodput bucket shares over each bundle's window"]
+        lines.append(render_table(
+            diff["buckets"], ["bucket", "a_share", "b_share", "delta"]))
+    if diff["hosts"]:
+        lines += ["", "## per-host deltas (heartbeat age at detect, "
+                      "timeline events)"]
+        lines.append(render_table(
+            diff["hosts"],
+            ["host", "a_hb_age_s", "b_hb_age_s", "hb_age_delta_s",
+             "a_spans", "b_spans", "span_delta"]))
+    for note in diff["notes"]:
         lines.append(f"NOTE: {note}")
     return "\n".join(lines)

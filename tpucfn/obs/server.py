@@ -20,6 +20,15 @@ scrapeable (ISSUE 2: the reference's answer was ssh + tail over
   the attached :class:`~tpucfn.obs.profiler.ProfileCapture`: blocks for
   S seconds, returns the artifact directory as JSON (409 while another
   capture runs, 404 when none is attached).
+* ``GET /clock`` — this host's wall + monotonic clocks in one reply
+  (ISSUE 20): the sample the coordinator's NTP-style probe brackets
+  between two of ITS monotonic reads to estimate this host's wall
+  offset with an RTT/2 uncertainty bound (``obs.timeline.probe_clock``).
+* ``GET /tracetail?lines=N`` — the last N complete lines of the
+  attached tracer's span JSONL as JSON (ISSUE 20): what the gang
+  coordinator pulls from survivors at incident detect time, span
+  siblings to the flight ring.  404 when no tracer (or an unwritten
+  one) is attached.
 
 Port convention: ``TPUCFN_OBS_PORT`` carries each process's assigned
 port (the launcher assigns ``base + 1 + host_id`` per host, keeping
@@ -52,19 +61,22 @@ class ObsServer:
     def __init__(self, registry: MetricRegistry | None = None, *,
                  port: int = 0, host: str = "0.0.0.0", role: str = "",
                  host_id: int | None = None, health_fn: HealthFn | None = None,
-                 flight=None, profiler=None):
+                 flight=None, profiler=None, tracer=None):
         """``flight`` is a :class:`~tpucfn.obs.flight.FlightRecorder`
         (or anything with ``snapshot() -> dict``) behind
         ``/flightrecorder``; ``profiler`` is a callable
         ``(seconds) -> dict`` (normally
         :class:`~tpucfn.obs.profiler.ProfileCapture`) behind
-        ``POST /profile``.  Either None leaves its route 404."""
+        ``POST /profile``; ``tracer`` is this process's
+        :class:`~tpucfn.obs.trace.Tracer` behind ``/tracetail``.
+        Any None leaves its route 404."""
         self.registry = registry if registry is not None else default_registry()
         self.role = role
         self.host_id = host_id
         self.health_fn = health_fn
         self.flight = flight
         self.profiler = profiler
+        self.tracer = tracer
         self._t0 = time.monotonic()
         obs = self
 
@@ -99,10 +111,26 @@ class ObsServer:
                         self._send(200,
                                    json.dumps(obs.flight.snapshot()).encode(),
                                    "application/json")
+                elif path == "/clock":
+                    # Both clocks read back to back: the probe's
+                    # offset math needs this host's wall time; mono is
+                    # returned for symmetry/debugging.  Kept tiny so
+                    # serve time stays well inside the RTT bound.
+                    self._send(200, json.dumps({
+                        "wall": time.time(),
+                        "mono": time.monotonic(),
+                        "host_id": obs.host_id,
+                        "role": obs.role,
+                    }).encode(), "application/json")
+                elif path == "/tracetail":
+                    body, code = obs._tracetail(self.path)
+                    self._send(code, body, "application/json"
+                               if code == 200 else "text/plain")
                 elif path == "/":
                     self._send(200,
                                b"/metrics /healthz /varz /flightrecorder "
-                               b"POST /profile\n", "text/plain")
+                               b"/clock /tracetail POST /profile\n",
+                               "text/plain")
                 else:
                     self._send(404, b"not found\n", "text/plain")
 
@@ -142,6 +170,35 @@ class ObsServer:
             target=self._httpd.serve_forever, daemon=True,
             name=f"tpucfn-obs:{self._httpd.server_address[1]}")
         self._thread.start()
+
+    def _tracetail(self, raw_path: str) -> tuple[bytes, int]:
+        """Last-N span lines of the attached tracer's file (ISSUE 20).
+        Reads the file rather than any in-memory state so it sees
+        exactly what a postmortem would; torn final lines are skipped
+        the same way ``read_trace_file`` skips them."""
+        tr = self.tracer
+        path = getattr(tr, "path", None)
+        if tr is None or path is None:
+            return b"no tracer attached\n", 404
+        from urllib.parse import parse_qs, urlparse
+
+        raw = parse_qs(urlparse(raw_path).query).get("lines", ["500"])[0]
+        try:
+            n = max(1, int(raw))
+        except ValueError:
+            return f"lines={raw!r} is not an int\n".encode(), 400
+        try:
+            from tpucfn.obs.trace import read_trace_file
+
+            events = read_trace_file(path)
+        except OSError as e:
+            return f"trace file unreadable: {e}\n".encode(), 404
+        return json.dumps({
+            "path": str(path),
+            "host_id": self.host_id,
+            "role": self.role,
+            "events": events[-n:],
+        }).encode(), 200
 
     def _health(self) -> tuple[int, dict]:
         healthy, detail = True, {}
@@ -207,7 +264,8 @@ def start_obs_server(registry: MetricRegistry | None = None, *,
                      host: str = "0.0.0.0",
                      host_id: int | None = None,
                      health_fn: HealthFn | None = None,
-                     flight=None, profiler=None) -> ObsServer | None:
+                     flight=None, profiler=None,
+                     tracer=None) -> ObsServer | None:
     """Start the endpoint for this process; ``port=None`` consults
     ``TPUCFN_OBS_PORT`` and returns None when the env opted out — the
     one-liner every role calls unconditionally."""
@@ -217,4 +275,4 @@ def start_obs_server(registry: MetricRegistry | None = None, *,
             return None
     return ObsServer(registry, port=port, host=host, role=role,
                      host_id=host_id, health_fn=health_fn,
-                     flight=flight, profiler=profiler)
+                     flight=flight, profiler=profiler, tracer=tracer)

@@ -25,6 +25,17 @@ the same shippable-file contract as the metrics JSONL), carrying:
 ``Tracer(None)`` is a full no-op writer (spans still time, nothing is
 written) so instrumentation points can call unconditionally.
 
+Cross-host causality (ISSUE 20): span_ids are only unique within one
+process, so a span on host A names a span on host B by the pair
+``(origin, span_id)`` where ``origin = origin_id(role, host_id)`` — a
+deterministic 64-bit hash of the emitting process's fleet identity
+that any reader can recompute from the ``host``/``role`` fields
+already on every line.  A receiver-side span records the sender's
+context as ``"rp": {"trace_id", "span_id", "origin"}`` (remote
+parent); ``obs.timeline`` resolves those links when merging per-host
+files onto one clock.  The three u64s ride the fleet planes' framed
+op headers — see ``data.service`` for the wire layout.
+
 Wired into the serve request lifecycle in ``serve/frontend.py``
 (queue_wait → prefill → decode_round → request_done) and into the
 trainer loop via ``train.trainer.TrainerObs`` (data_wait / step / ckpt).
@@ -50,6 +61,28 @@ SPAN_KINDS = ("span",)
 
 _current_span: contextvars.ContextVar[int | None] = contextvars.ContextVar(
     "tpucfn_current_span", default=None)
+
+
+def origin_id(role: str, host_id: int | None) -> int:
+    """Deterministic 64-bit fleet identity of one tracing process:
+    FNV-1a over ``"role:host"``.  Stable across runs and recomputable
+    from the ``role``/``host`` fields on any span line, which is what
+    makes an ``(origin, span_id)`` pair resolvable by an offline
+    merger with no registry.  Host ids are fleet-unique across roles
+    (the launcher assigns input hosts the ids AFTER the trainers), so
+    the pair never collides within one fleet."""
+    h = 0xCBF29CE484222325
+    for b in f"{role or 'proc'}:{0 if host_id is None else host_id}".encode():
+        h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    # 0 is the wire sentinel for "no context" — never a real origin.
+    return h or 1
+
+
+def current_span_id() -> int | None:
+    """The innermost open ``Tracer.span`` id on this thread (None
+    outside any span) — what a plane client injects into a framed op
+    header as the causal parent of the server-side work."""
+    return _current_span.get()
 
 
 class Tracer:
@@ -86,25 +119,44 @@ class Tracer:
     def enabled(self) -> bool:
         return self._f is not None
 
+    @property
+    def origin(self) -> int:
+        """This process's :func:`origin_id` — the third u64 of any wire
+        context it injects."""
+        return origin_id(self.role, self.host_id)
+
+    def next_span_id(self) -> int:
+        """Mint a span id BEFORE the span is written, so it can ride a
+        wire header (or be handed to children) while the span is still
+        open; pass it back via ``record(..., span_id=...)``.  Safe on a
+        disabled tracer (ids still advance, nothing is written)."""
+        return next(self._ids)
+
     # -- low level ---------------------------------------------------------
     def record(self, name: str, *, start: float, end: float | None = None,
                dur_s: float | None = None, trace_id: int | str | None = None,
                kind: str = "span", parent_id: int | None = None,
+               span_id: int | None = None,
+               remote_parent: dict | tuple | None = None,
                **attrs: Any) -> None:
         """Write one already-timed span (``start``/``end`` in
         ``time.monotonic()`` seconds; pass ``dur_s`` instead of ``end``
-        when that's what was measured)."""
+        when that's what was measured).  ``span_id`` accepts an id
+        pre-drawn with :meth:`next_span_id`; ``remote_parent`` is a
+        cross-host causal link — ``(trace_id, span_id, origin)`` as
+        carried on a plane's wire header, or the equivalent dict —
+        written as the span's ``rp`` field."""
         if self._f is None:
             return
         if dur_s is None:
             dur_s = 0.0 if end is None else end - start
         if parent_id is None:
             parent_id = _current_span.get()
-        line = json.dumps({
+        row = {
             "kind": kind,
             "name": name,
             "trace_id": trace_id,
-            "span_id": next(self._ids),
+            "span_id": next(self._ids) if span_id is None else span_id,
             "parent_id": parent_id,
             "start": start,
             "dur_s": dur_s,
@@ -118,7 +170,11 @@ class Tracer:
             "host": self.host_id,
             "role": self.role,
             "attrs": attrs,
-        })
+        }
+        rp = _normalize_rp(remote_parent)
+        if rp is not None:
+            row["rp"] = rp
+        line = json.dumps(row)
         with self._lock:
             if self._f is not None:
                 self._f.write(line + "\n")
@@ -175,6 +231,23 @@ class Tracer:
             if self._f is not None:
                 self._f.close()
                 self._f = None
+
+
+def _normalize_rp(remote_parent) -> dict | None:
+    """A wire context tuple/dict → the canonical ``rp`` dict, or None
+    when absent / all-zero (a peer with tracing off sends zeros)."""
+    if remote_parent is None:
+        return None
+    if isinstance(remote_parent, dict):
+        tid = remote_parent.get("trace_id")
+        sid = remote_parent.get("span_id")
+        org = remote_parent.get("origin")
+    else:
+        tid, sid, org = remote_parent
+    if not sid or not org:
+        return None
+    return {"trace_id": tid if tid else None,
+            "span_id": int(sid), "origin": int(org)}
 
 
 def read_trace_file(path: str | Path) -> list[dict]:

@@ -495,14 +495,20 @@ class TrainerObs:
         return self._phase("data_wait", self.data_wait_time, step)
 
     def record_data_wait(self, step: int | None, start: float,
-                         dur_s: float) -> None:
+                         dur_s: float, link=None) -> None:
         """Post-hoc form of :meth:`data_wait` (``start`` in
         ``time.monotonic()`` seconds) for loops that must first decide
         whether the fetched batch starts a real step — the end-of-data
-        drain wait must not be recorded as a phantom step's data wait."""
+        drain wait must not be recorded as a phantom step's data wait.
+        ``link`` is the batch's wire context from the input plane
+        (``ResilientBatchStream.pop_link()``), recorded as the span's
+        remote parent (ISSUE 20): on the merged timeline this wait
+        points at the input-host ``input_serve`` span that produced the
+        batch; None (local batch, tracing off upstream) records a plain
+        local wait."""
         self.data_wait_time.observe(dur_s)
         self.tracer.record("data_wait", start=start, dur_s=dur_s,
-                           trace_id=step)
+                           trace_id=step, remote_parent=link)
         self.ledger.account("data_wait", dur_s, step=step)
         if self.flight is not None:
             self.flight.record("data_wait", step=step, dur_s=dur_s)
